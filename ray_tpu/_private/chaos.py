@@ -1,0 +1,93 @@
+"""Fault-injection hooks for the event loop and RPC layer — built in from day 1.
+
+Capability parity with the reference's chaos testing
+(reference: src/ray/asio/asio_chaos.h — RAY_testing_asio_delay_us injects random
+delays into asio handlers; src/ray/rpc/rpc_chaos.h — RAY_testing_rpc_failure drops
+RPCs at request/response points). Configured by flags
+`testing_event_loop_delay_us` / `testing_rpc_failure` (env RAY_TPU_*).
+
+Formats:
+  delay:  "method:min_us:max_us[,method:min_us:max_us...]"  ('*' matches any method)
+  rpc:    "method:max_failures:req_prob:resp_prob[,...]"    (probs in [0,1])
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, Optional, Tuple
+
+from . import config
+
+
+class _DelaySpec:
+    def __init__(self, spec: str):
+        self.rules: Dict[str, Tuple[int, int]] = {}
+        for entry in filter(None, (e.strip() for e in spec.split(","))):
+            method, lo, hi = entry.rsplit(":", 2)
+            self.rules[method] = (int(lo), int(hi))
+
+    def delay_us(self, method: str) -> int:
+        rule = self.rules.get(method) or self.rules.get("*")
+        if rule is None:
+            return 0
+        lo, hi = rule
+        return random.randint(lo, hi) if hi > lo else lo
+
+
+class _RpcFailureSpec:
+    def __init__(self, spec: str):
+        self.rules: Dict[str, list] = {}
+        for entry in filter(None, (e.strip() for e in spec.split(","))):
+            method, max_failures, req_p, resp_p = entry.rsplit(":", 3)
+            self.rules[method] = [int(max_failures), float(req_p), float(resp_p)]
+
+    def roll(self, method: str) -> Optional[str]:
+        """Returns 'request' (drop before delivery), 'response' (drop reply), or None."""
+        rule = self.rules.get(method) or self.rules.get("*")
+        if rule is None or rule[0] == 0:
+            return None
+        r = random.random()
+        if r < rule[1]:
+            rule[0] -= 1
+            return "request"
+        if r < rule[1] + rule[2]:
+            rule[0] -= 1
+            return "response"
+        return None
+
+
+_lock = threading.Lock()
+_delay_cache: Optional[Tuple[str, _DelaySpec]] = None
+_rpc_cache: Optional[Tuple[str, _RpcFailureSpec]] = None
+
+
+def event_loop_delay_us(method: str) -> int:
+    """Delay (microseconds) to inject before running `method`'s handler."""
+    global _delay_cache
+    spec = config.get("testing_event_loop_delay_us")
+    if not spec:
+        return 0
+    with _lock:
+        if _delay_cache is None or _delay_cache[0] != spec:
+            _delay_cache = (spec, _DelaySpec(spec))
+        return _delay_cache[1].delay_us(method)
+
+
+def rpc_failure(method: str) -> Optional[str]:
+    """Injected failure point for an RPC, or None."""
+    global _rpc_cache
+    spec = config.get("testing_rpc_failure")
+    if not spec:
+        return None
+    with _lock:
+        if _rpc_cache is None or _rpc_cache[0] != spec:
+            _rpc_cache = (spec, _RpcFailureSpec(spec))
+        return _rpc_cache[1].roll(method)
+
+
+def reset() -> None:
+    global _delay_cache, _rpc_cache
+    with _lock:
+        _delay_cache = None
+        _rpc_cache = None

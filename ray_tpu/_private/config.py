@@ -1,0 +1,138 @@
+"""Env-overridable typed flag registry.
+
+Capability parity with the reference's RAY_CONFIG system
+(reference: src/ray/common/ray_config.h:60, ray_config_def.h — 249 flags, each
+overridable by env `RAY_<name>` or the `_system_config` dict passed at init).
+
+Here every flag declared with `_flag()` is overridable by env `RAY_TPU_<name>`
+or by `ray_tpu.init(system_config={...})`. Flags include the day-1 chaos hooks
+(`testing_event_loop_delay_us`, `testing_rpc_failure`) mirroring the reference's
+asio/rpc chaos (src/ray/asio/asio_chaos.h, src/ray/rpc/rpc_chaos.h).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    doc: str = ""
+
+
+class ConfigRegistry:
+    """Singleton registry of typed flags with env + runtime override tiers.
+
+    Priority (highest wins): runtime `system_config` > env `RAY_TPU_<name>` > default.
+    """
+
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+        self._overrides: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def declare(self, name: str, default: Any, doc: str = "") -> None:
+        self._flags[name] = _Flag(name, default, type(default), doc)
+
+    def get(self, name: str) -> Any:
+        flag = self._flags[name]
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+        env = os.environ.get(_ENV_PREFIX + name)
+        if env is not None:
+            try:
+                return _PARSERS[flag.type](env)
+            except (ValueError, KeyError):
+                raise ValueError(
+                    f"Bad value {env!r} for flag {name} (expects {flag.type.__name__})"
+                ) from None
+        return flag.default
+
+    def apply_system_config(self, system_config: Dict[str, Any]) -> None:
+        for k, v in system_config.items():
+            if k not in self._flags:
+                raise KeyError(f"Unknown system_config key: {k}")
+            flag = self._flags[k]
+            if not isinstance(v, flag.type) and not (
+                flag.type is float and isinstance(v, int)
+            ):
+                raise TypeError(
+                    f"system_config[{k!r}] expects {flag.type.__name__}, got {type(v).__name__}"
+                )
+            with self._lock:
+                self._overrides[k] = v
+
+    def serialize_overrides(self) -> str:
+        """Serialize overrides so spawned daemons/workers inherit them (the
+        reference passes --raylet_config JSON to child binaries)."""
+        with self._lock:
+            return json.dumps(self._overrides)
+
+    def load_overrides(self, payload: str) -> None:
+        self.apply_system_config(json.loads(payload))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._overrides.clear()
+
+    def all_flags(self) -> Dict[str, _Flag]:
+        return dict(self._flags)
+
+
+GLOBAL_CONFIG = ConfigRegistry()
+_flag = GLOBAL_CONFIG.declare
+
+# --- core runtime ---
+_flag("object_store_memory_bytes", 512 * 1024 * 1024, "Per-node shm object store size.")
+_flag("inline_object_max_bytes", 100 * 1024, "Objects <= this ride RPC replies inline; larger go to the shm store (reference: plasma promotion threshold, core_worker store_provider).")
+_flag("worker_pool_prestart", 0, "Workers to prestart per node.")
+_flag("worker_pool_max_idle", 4, "Idle workers cached per node before reaping.")
+_flag("worker_register_timeout_s", 30.0, "Seconds to wait for a spawned worker to register.")
+_flag("lease_spillback_max_hops", 8, "Max scheduler spillback hops for one lease request.")
+_flag("health_check_period_s", 1.0, "Control-store node liveness probe period.")
+_flag("health_check_timeout_s", 10.0, "Node declared dead after this long without heartbeat.")
+_flag("pull_retry_initial_delay_s", 0.2, "Object transfer pull retry initial backoff.")
+_flag("pull_retry_max_delay_s", 10.0, "Object transfer pull retry max backoff.")
+_flag("object_chunk_bytes", 1024 * 1024, "Chunk size for node-to-node object push.")
+_flag("max_task_retries_default", 3, "Default retries for idempotent tasks.")
+_flag("actor_max_restarts_default", 0, "Default actor restarts.")
+_flag("memory_store_max_bytes", 256 * 1024 * 1024, "Per-process in-memory store cap.")
+_flag("task_event_buffer_max", 10000, "Profile/task events buffered per worker before drop.")
+_flag("control_store_port", 0, "Port for the control store (0 = auto).")
+_flag("scheduler_spread_threshold", 0.5, "Hybrid policy: pack below this utilization, then spread (reference: hybrid_scheduling_policy.h:50).")
+_flag("log_to_driver", True, "Forward worker stdout/stderr to the driver.")
+
+# --- chaos / fault injection (day 1, per SURVEY §4) ---
+_flag("testing_event_loop_delay_us", "", "Inject delays into event-loop handlers. Format: 'method:min_us:max_us,...' ('*' matches all). Mirrors RAY_testing_asio_delay_us.")
+_flag("testing_rpc_failure", "", "Inject RPC failures. Format: 'method:max_failures:req_prob:resp_prob,...' ('*' matches all). Mirrors RAY_testing_rpc_failure.")
+
+# --- TPU ---
+_flag("tpu_chips_per_host", 0, "Override detected TPU chips per host (0 = autodetect).")
+_flag("tpu_topology", "", "Override detected TPU slice topology, e.g. '4x4'.")
+_flag("tpu_visible_chips", "", "Restrict worker to these chip ids (comma-separated). Parity: TPU_VISIBLE_CHIPS (reference: python/ray/_private/accelerators/tpu.py:42).")
+
+
+def get(name: str) -> Any:
+    return GLOBAL_CONFIG.get(name)

@@ -1,0 +1,27 @@
+"""Test harness: runs JAX on a virtual 8-device CPU mesh (no TPU needed),
+mirroring the reference's fake-multi-node strategy for cluster tests
+(reference: python/ray/tests/conftest.py:651,734 and
+python/ray/autoscaler/_private/fake_multi_node/node_provider.py).
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_config():
+    from ray_tpu._private import chaos
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    yield
+    GLOBAL_CONFIG.reset()
+    chaos.reset()

@@ -1,0 +1,136 @@
+"""Tests for the common layer: IDs, config registry, chaos specs, serialization."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import chaos, config
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+)
+from ray_tpu._private.serialization import deserialize, serialize
+
+
+class TestIds:
+    def test_random_and_hex_roundtrip(self):
+        for cls in (NodeID, ActorID, TaskID, ObjectID, PlacementGroupID):
+            a = cls.from_random()
+            assert cls.from_hex(a.hex()) == a
+            assert len(a.binary()) == cls.SIZE
+
+    def test_nil(self):
+        assert TaskID.nil().is_nil()
+        assert not TaskID.for_driver(JobID.from_int(1)).is_nil()
+
+    def test_deterministic_derivation(self):
+        job = JobID.from_int(7)
+        drv = TaskID.for_driver(job)
+        t1 = TaskID.for_task(job, drv, 0)
+        t2 = TaskID.for_task(job, drv, 0)
+        t3 = TaskID.for_task(job, drv, 1)
+        assert t1 == t2 and t1 != t3
+        o1 = ObjectID.for_task_return(t1, 0)
+        assert o1 == ObjectID.for_task_return(t1, 0)
+        assert o1 != ObjectID.for_task_return(t1, 1)
+
+    def test_kind_distinguishes(self):
+        # Same-size IDs of different kinds never collide via hash/eq.
+        a = ActorID(b"x" * 16)
+        n = NodeID(b"x" * 16)
+        assert a != n
+
+    def test_pickle(self):
+        t = TaskID.from_random()
+        assert pickle.loads(pickle.dumps(t)) == t
+
+    def test_wrong_size_raises(self):
+        with pytest.raises(ValueError):
+            NodeID(b"short")
+
+
+class TestConfig:
+    def test_default_and_env_override(self):
+        assert config.get("lease_spillback_max_hops") == 8
+        os.environ["RAY_TPU_lease_spillback_max_hops"] = "3"
+        try:
+            assert config.get("lease_spillback_max_hops") == 3
+        finally:
+            del os.environ["RAY_TPU_lease_spillback_max_hops"]
+
+    def test_system_config_wins_over_env(self):
+        os.environ["RAY_TPU_worker_pool_max_idle"] = "9"
+        try:
+            GLOBAL_CONFIG.apply_system_config({"worker_pool_max_idle": 2})
+            assert config.get("worker_pool_max_idle") == 2
+        finally:
+            del os.environ["RAY_TPU_worker_pool_max_idle"]
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(KeyError):
+            GLOBAL_CONFIG.apply_system_config({"no_such_flag": 1})
+
+    def test_type_checked(self):
+        with pytest.raises(TypeError):
+            GLOBAL_CONFIG.apply_system_config({"worker_pool_max_idle": "two"})
+
+    def test_serialize_roundtrip(self):
+        GLOBAL_CONFIG.apply_system_config({"worker_pool_max_idle": 5})
+        payload = GLOBAL_CONFIG.serialize_overrides()
+        GLOBAL_CONFIG.reset()
+        GLOBAL_CONFIG.load_overrides(payload)
+        assert config.get("worker_pool_max_idle") == 5
+
+
+class TestChaos:
+    def test_delay_spec(self):
+        GLOBAL_CONFIG.apply_system_config(
+            {"testing_event_loop_delay_us": "Heartbeat:100:100"}
+        )
+        assert chaos.event_loop_delay_us("Heartbeat") == 100
+        assert chaos.event_loop_delay_us("Other") == 0
+
+    def test_delay_wildcard(self):
+        GLOBAL_CONFIG.apply_system_config({"testing_event_loop_delay_us": "*:5:5"})
+        assert chaos.event_loop_delay_us("Anything") == 5
+
+    def test_rpc_failure_budget(self):
+        GLOBAL_CONFIG.apply_system_config({"testing_rpc_failure": "Submit:2:1.0:0.0"})
+        assert chaos.rpc_failure("Submit") == "request"
+        assert chaos.rpc_failure("Submit") == "request"
+        # budget of 2 exhausted
+        assert chaos.rpc_failure("Submit") is None
+        assert chaos.rpc_failure("Unrelated") is None
+
+
+class TestSerialization:
+    def test_roundtrip_plain(self):
+        v = {"a": [1, 2, 3], "b": "hello", "c": (4.5, None)}
+        assert deserialize(serialize(v).to_bytes()) == v
+
+    def test_numpy_out_of_band_zero_copy(self):
+        arr = np.arange(1 << 16, dtype=np.float32)
+        s = serialize(arr)
+        # the array's bytes went out-of-band, not into the pickle stream
+        assert len(s.inband) < 10_000
+        assert sum(len(b) for b in s.buffers) == arr.nbytes
+        wire = s.to_bytes()
+        out = deserialize(wire)
+        np.testing.assert_array_equal(out, arr)
+        # zero-copy: deserialized array aliases the wire buffer
+        assert not out.flags.owndata
+
+    def test_write_into_memoryview(self):
+        arr = np.ones(128, dtype=np.int64)
+        s = serialize({"x": arr})
+        buf = memoryview(bytearray(s.total_bytes))
+        s.write_into(buf)
+        out = deserialize(buf)
+        np.testing.assert_array_equal(out["x"], arr)

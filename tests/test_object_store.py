@@ -1,0 +1,146 @@
+"""Tests for the native shm object store (plasma equivalent).
+
+Mirrors the reference's plasma store tests (reference:
+src/ray/object_manager/plasma/ + fake_plasma_client.h test strategy): create/seal/
+get/release lifecycle, zero-copy reads, eviction under pressure, cross-process
+visibility.
+"""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.errors import ObjectStoreFullError
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import deserialize, serialize
+from ray_tpu.runtime.object_store import META_ERROR, ShmObjectStore
+
+
+@pytest.fixture
+def store():
+    name = f"/rtpu_test_{os.getpid()}"
+    s = ShmObjectStore(name, create=True, size=8 * 1024 * 1024, capacity=512)
+    yield s
+    s.destroy()
+
+
+def test_put_get_roundtrip(store):
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, b"hello world")
+    view, meta = store.get(oid)
+    assert bytes(view) == b"hello world"
+    assert meta == 0
+    store.release(oid)
+
+
+def test_create_seal_lifecycle(store):
+    oid = ObjectID.from_random()
+    view = store.create(oid, 4)
+    # unsealed objects are invisible to get
+    assert store.get(oid) is None
+    assert not store.contains(oid)
+    view[:] = b"abcd"
+    store.seal(oid)
+    assert store.contains(oid)
+    got, _ = store.get(oid)
+    assert bytes(got) == b"abcd"
+    store.release(oid)
+
+
+def test_zero_copy_numpy(store):
+    arr = np.arange(100_000, dtype=np.float64)
+    s = serialize(arr)
+    oid = ObjectID.from_random()
+    view = store.create(oid, s.total_bytes)
+    s.write_into(view)
+    store.seal(oid)
+    got, _ = store.get(oid)
+    out = deserialize(got)
+    np.testing.assert_array_equal(out, arr)
+    assert not out.flags.owndata  # aliases shm
+
+
+def test_duplicate_create_rejected(store):
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, b"x")
+    with pytest.raises(FileExistsError):
+        store.create(oid, 1)
+
+
+def test_delete_and_pin(store):
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, b"x" * 100)
+    view, _ = store.get(oid)  # pin
+    assert not store.delete(oid)  # pinned -> refuse
+    store.release(oid)
+    assert store.delete(oid)
+    assert store.get(oid) is None
+
+
+def test_eviction_under_pressure(store):
+    # fill the 8 MiB store with 1 MiB objects; LRU evicts unreferenced ones
+    ids = []
+    for i in range(20):
+        oid = ObjectID.from_random()
+        store.put_bytes(oid, bytes(1024 * 1024))
+        ids.append(oid)
+    # latest objects must still be present; earliest were evicted
+    assert store.contains(ids[-1])
+    assert not store.contains(ids[0])
+
+
+def test_pinned_objects_survive_eviction(store):
+    pinned = ObjectID.from_random()
+    store.put_bytes(pinned, bytes(1024 * 1024))
+    store.get(pinned)  # pin it
+    for _ in range(20):
+        store.put_bytes(ObjectID.from_random(), bytes(1024 * 1024))
+    assert store.contains(pinned)
+    store.release(pinned)
+
+
+def test_store_full_when_all_pinned(store):
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, bytes(6 * 1024 * 1024))
+    store.get(oid)  # pin
+    with pytest.raises(ObjectStoreFullError):
+        store.create(ObjectID.from_random(), 6 * 1024 * 1024)
+    store.release(oid)
+
+
+def test_error_metadata(store):
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, b"boom", metadata=META_ERROR)
+    _, meta = store.get(oid)
+    assert meta == META_ERROR
+    store.release(oid)
+
+
+def _child_reads(name, oid_hex, q):
+    s = ShmObjectStore(name)
+    res = s.get_blocking(ObjectID.from_hex(oid_hex), timeout=5)
+    q.put(bytes(res[0]) if res else None)
+    s.close()
+
+
+def test_cross_process_visibility(store):
+    oid = ObjectID.from_random()
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_reads, args=(store.name, oid.hex(), q))
+    p.start()
+    store.put_bytes(oid, b"cross-process payload")
+    got = q.get(timeout=30)
+    p.join(timeout=10)
+    assert got == b"cross-process payload"
+
+
+def test_stats(store):
+    before = store.stats()
+    store.put_bytes(ObjectID.from_random(), b"y" * 1000)
+    after = store.stats()
+    assert after["num_objects"] == before["num_objects"] + 1
+    assert after["bytes_in_use"] >= before["bytes_in_use"] + 1000
+    assert after["seal_seq"] == before["seal_seq"] + 1
