@@ -15,9 +15,10 @@ ownership-based ref counting design (reference: src/ray/core_worker/reference_co
 
 from __future__ import annotations
 
+import contextvars
 import pickle
 import struct
-from typing import Any, Callable, List, Tuple
+from typing import Any, List
 
 _HEADER = struct.Struct("<IQ")
 _LEN = struct.Struct("<Q")
@@ -68,16 +69,37 @@ class SerializedObject:
                 off += len(b)
 
 
-def serialize(value: Any, ref_serializer: Callable | None = None) -> SerializedObject:
+def serialize(value: Any) -> SerializedObject:
+    """Serialize `value`. ObjectRefs inside the value register themselves with
+    the active serialization context (see runtime/context.py) via __reduce__,
+    which appends to `contained_refs` for borrow tracking."""
     buffers: List[memoryview] = []
-    contained_refs: list = []
 
     def buffer_callback(buf: pickle.PickleBuffer) -> bool:
         buffers.append(buf.raw())
         return False  # do not also serialize in-band
 
-    inband = pickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
-    return SerializedObject(inband, buffers, contained_refs)
+    token = _CONTAINED_REFS.set([])
+    try:
+        inband = pickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+        contained = _CONTAINED_REFS.get()
+    finally:
+        _CONTAINED_REFS.reset(token)
+    return SerializedObject(inband, buffers, contained)
+
+
+# Active collector for ObjectRefs encountered during a serialize() call.
+# ObjectRef.__reduce__ calls note_contained_ref() so the owner can be told about
+# borrows (reference: reference_counter.h borrowing protocol).
+_CONTAINED_REFS: contextvars.ContextVar[list | None] = contextvars.ContextVar(
+    "rtpu_contained_refs", default=None
+)
+
+
+def note_contained_ref(ref) -> None:
+    lst = _CONTAINED_REFS.get()
+    if lst is not None:
+        lst.append(ref)
 
 
 def deserialize(data, copy_buffers: bool = False) -> Any:

@@ -18,7 +18,6 @@ _LOCK = threading.Lock()
 
 _LIBS = {
     "shm_store": ["shm_store.cc"],
-    "sched_core": ["sched_core.cc"],
 }
 
 

@@ -27,7 +27,9 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
+#include <vector>
 
 namespace {
 
@@ -204,29 +206,41 @@ void heap_free(Store* s, uint64_t data_off) {
   }
 }
 
-// Evict LRU sealed objects with refcount==0 until at least `need` bytes free in
-// one pass attempt. Returns freed bytes.
+// Evict LRU sealed objects with refcount==0 until at least `need` bytes are
+// freed. One scan collects all candidates, sorts by LRU tick, then evicts in
+// order — victims are re-located by id because rehash_cluster moves entries
+// (reference design: intrusive LRU list in plasma/eviction_policy.h).
 uint64_t evict_lru(Store* s, uint64_t need) {
-  uint64_t freed = 0;
-  while (freed < need) {
-    ObjectEntry* victim = nullptr;
-    uint64_t victim_idx = 0;
-    for (uint64_t i = 0; i < s->hdr->capacity; i++) {
-      ObjectEntry* e = &s->table[i];
-      if (e->state == kEntrySealed && e->refcount == 0) {
-        if (victim == nullptr || e->lru_tick < victim->lru_tick) {
-          victim = e;
-          victim_idx = i;
-        }
-      }
+  struct Cand {
+    uint64_t tick;
+    uint64_t size;
+    uint8_t id[kIdSize];
+  };
+  std::vector<Cand> cands;
+  for (uint64_t i = 0; i < s->hdr->capacity; i++) {
+    ObjectEntry* e = &s->table[i];
+    if (e->state == kEntrySealed && e->refcount == 0) {
+      Cand c;
+      c.tick = e->lru_tick;
+      c.size = e->data_size;
+      memcpy(c.id, e->id, kIdSize);
+      cands.push_back(c);
     }
-    if (victim == nullptr) break;
-    freed += victim->data_size;
-    s->hdr->bytes_in_use -= victim->data_size;
+  }
+  std::sort(cands.begin(), cands.end(),
+            [](const Cand& a, const Cand& b) { return a.tick < b.tick; });
+  uint64_t freed = 0;
+  for (const Cand& c : cands) {
+    if (freed >= need) break;
+    ObjectEntry* e = find_entry(s, c.id, false);
+    if (e == nullptr || e->state != kEntrySealed || e->refcount != 0) continue;
+    freed += e->data_size;
+    s->hdr->bytes_in_use -= e->data_size;
     s->hdr->num_objects--;
-    heap_free(s, victim->offset);
-    victim->state = kEntryFree;
-    rehash_cluster(s, victim_idx);
+    heap_free(s, e->offset);
+    uint64_t idx = (uint64_t)(e - s->table);
+    e->state = kEntryFree;
+    rehash_cluster(s, idx);
   }
   return freed;
 }

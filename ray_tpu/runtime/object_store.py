@@ -133,7 +133,10 @@ class ShmObjectStore:
             return None
         if rc != RT_OK:
             raise RayTpuSystemError(f"get {object_id} failed rc={rc}")
-        return self._mv[off.value : off.value + size.value], meta.value
+        # Readonly so a reader can't corrupt the sealed object for every
+        # process on the node (sealed objects are immutable, like plasma's).
+        view = self._mv[off.value : off.value + size.value].toreadonly()
+        return view, meta.value
 
     def get_blocking(self, object_id: ObjectID, timeout: float | None = None,
                      poll_s: float = 0.001) -> Optional[Tuple[memoryview, int]]:

@@ -25,6 +25,10 @@ import msgpack
 from ray_tpu._private import chaos
 from ray_tpu._private.errors import RpcError
 
+
+class RpcConnectionLost(RpcError):
+    """Transport-level failure: the peer connection dropped (retryable)."""
+
 logger = logging.getLogger(__name__)
 
 _FRAME = struct.Struct("<I")
@@ -86,11 +90,14 @@ class RpcServer:
         return self.address
 
     async def stop(self):
+        # Close live connections BEFORE wait_closed(): since Python 3.12,
+        # Server.wait_closed() also waits for active connection handlers, so
+        # awaiting it first deadlocks while clients are still connected.
+        for w in list(self._conns.values()):
+            w.close()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
-        for w in list(self._conns.values()):
-            w.close()
 
     def push(self, conn_id: int, channel: str, message: Any) -> bool:
         """Push a message to a connected client (for subscriptions)."""
@@ -106,6 +113,7 @@ class RpcServer:
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         conn_id = next(self._conn_counter)
         self._conns[conn_id] = writer
+        writer._rt_write_lock = asyncio.Lock()  # serialize drain() across dispatch tasks
         try:
             while True:
                 try:
@@ -147,8 +155,9 @@ class RpcServer:
                 logger.exception("%s: handler %s failed", self.name, method)
             resp = [_ERR, req_id, method, f"{type(e).__name__}: {e}"]
         try:
-            writer.write(_pack(resp))
-            await writer.drain()
+            async with writer._rt_write_lock:
+                writer.write(_pack(resp))
+                await writer.drain()
         except (ConnectionError, RuntimeError):
             pass
 
@@ -168,6 +177,7 @@ class RpcClient:
         self._recv_task: Optional[asyncio.Task] = None
         self._subs: Dict[str, Callable[[Any], None]] = {}
         self._lock = asyncio.Lock()
+        self._write_lock = asyncio.Lock()
         self._closed = False
 
     async def connect(self):
@@ -207,9 +217,16 @@ class RpcClient:
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
+            # Mark the transport dead so call() reconnects instead of writing
+            # into a half-open socket after a server-side EOF.
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
             for fut in self._pending.values():
                 if not fut.done():
-                    fut.set_exception(RpcError(f"{self.name}: connection to {self.address} lost"))
+                    fut.set_exception(
+                        RpcConnectionLost(f"{self.name}: connection to {self.address} lost")
+                    )
             self._pending.clear()
 
     def subscribe_channel(self, channel: str, callback: Callable[[Any], None]):
@@ -223,30 +240,38 @@ class RpcClient:
             raise RpcError(f"{self.name}: client closed")
         last_exc: Exception | None = None
         for attempt in range(self.retries + 1):
+            req_id = None
             try:
                 async with self._lock:
                     await self._ensure_connected()
                 req_id = next(self._req_counter)
                 fut = asyncio.get_running_loop().create_future()
                 self._pending[req_id] = fut
-                self._writer.write(_pack([_REQ, req_id, method, payload]))
-                await self._writer.drain()
+                async with self._write_lock:
+                    writer = self._writer
+                    if writer is None:
+                        raise RpcConnectionLost(f"{self.name}: reconnect pending")
+                    writer.write(_pack([_REQ, req_id, method, payload]))
+                    await writer.drain()
                 return await asyncio.wait_for(fut, timeout)
-            except (ConnectionError, asyncio.TimeoutError, asyncio.IncompleteReadError, OSError) as e:
+            except (
+                ConnectionError,
+                asyncio.TimeoutError,
+                asyncio.IncompleteReadError,
+                OSError,
+                RpcConnectionLost,
+            ) as e:
                 last_exc = e
-                self._pending.pop(req_id, None) if "req_id" in dir() else None
+                if req_id is not None:
+                    self._pending.pop(req_id, None)
                 if self._writer is not None:
                     self._writer.close()
                     self._writer = None
                 if attempt < self.retries:
                     await asyncio.sleep(self.retry_delay * (2**attempt))
-            except RpcError as e:
-                if "connection" in str(e) and attempt < self.retries:
-                    last_exc = e
-                    await asyncio.sleep(self.retry_delay * (2**attempt))
-                    continue
-                raise
-        raise RpcError(f"{self.name}: call {method} to {self.address} failed after retries") from last_exc
+        raise RpcError(
+            f"{self.name}: call {method} to {self.address} failed after retries"
+        ) from last_exc
 
     async def close(self):
         self._closed = True
