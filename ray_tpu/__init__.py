@@ -1,0 +1,91 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+The capability surface of Ray (tasks, actors, objects, placement groups,
+libraries) re-designed TPU-first: the data plane is JAX/XLA over ICI meshes,
+the control plane is an asyncio msgpack RPC fabric with a shared-memory object
+store, and gang scheduling is slice-topology native.
+
+Public API parity reference: python/ray/__init__.py of the reference.
+"""
+
+from ray_tpu._private.core_worker import ObjectRef, get_core_worker
+from ray_tpu._private.errors import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    ObjectStoreFullError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu._private.worker import (
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    wait,
+)
+
+
+def remote(*args, **kwargs):
+    """`@ray_tpu.remote` decorator for functions and actor classes.
+
+    Reference: python/ray/remote_function.py:347 and python/ray/actor.py:1545.
+    """
+    import inspect
+
+    from ray_tpu.actor import ActorClass
+    from ray_tpu.remote_function import RemoteFunction
+
+    def decorate(target, options=None):
+        if inspect.isclass(target):
+            return ActorClass(target, options)
+        if not callable(target):
+            raise TypeError("@remote requires a function or class")
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and not kwargs and (inspect.isclass(args[0]) or callable(args[0])):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote() accepts only keyword options")
+
+    def wrapper(target):
+        return decorate(target, kwargs)
+
+    return wrapper
+
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ObjectRef",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_core_worker",
+    "RayTpuError",
+    "TaskError",
+    "ActorDiedError",
+    "ActorUnavailableError",
+    "WorkerCrashedError",
+    "ObjectLostError",
+    "ObjectStoreFullError",
+    "GetTimeoutError",
+    "__version__",
+]

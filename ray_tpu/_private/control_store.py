@@ -1,0 +1,665 @@
+"""Control store — the cluster control plane (GCS equivalent).
+
+Capability parity with the reference's GCS server (reference:
+src/ray/gcs/gcs_server.h:99, wiring gcs_server.cc:260-341): one process per
+cluster holding the authoritative tables for nodes, jobs, actors, placement
+groups, KV, and task events, plus pub/sub fan-out and node health checking
+(reference: src/ray/gcs/gcs_health_check_manager.h). Redesigned on the asyncio
+msgpack RPC transport (runtime/rpc.py) instead of 13 gRPC services; persistence
+is a pluggable store client (in-memory or file-backed snapshot, reference:
+src/ray/gcs/store_client/).
+
+Actor lifecycle mirrors GcsActorManager/GcsActorScheduler
+(src/ray/gcs/actor/gcs_actor_manager.h:94, gcs_actor_scheduler.h:104): actors
+are registered by their owner, scheduled onto a node chosen from the live
+resource view, created by asking that node's daemon to lease a worker, and
+restarted on failure up to max_restarts.
+
+Placement groups use the same 2-phase prepare/commit over node daemons as the
+reference (node_manager.proto:515-525, gcs_placement_group_manager.h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from ray_tpu._private.aio import spawn
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import protocol as pb
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.protocol import NodeInfo, ResourceSet, TaskSpec
+from ray_tpu.runtime.rpc import RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class PubSub:
+    """Channel-based pub/sub over server push frames.
+
+    Replaces the reference's long-poll publisher (src/ray/pubsub/publisher.h:357):
+    the asyncio transport supports unsolicited server->client frames, so
+    subscriptions are plain push registrations, no polling.
+    """
+
+    def __init__(self, server: RpcServer):
+        self._server = server
+        self._subs: Dict[str, Set[int]] = {}
+
+    def subscribe(self, conn_id: int, channel: str) -> None:
+        self._subs.setdefault(channel, set()).add(conn_id)
+
+    def unsubscribe_conn(self, conn_id: int) -> None:
+        for subs in self._subs.values():
+            subs.discard(conn_id)
+
+    def publish(self, channel: str, message: Any) -> None:
+        for conn_id in list(self._subs.get(channel, ())):
+            if not self._server.push(conn_id, channel, message):
+                self._subs[channel].discard(conn_id)
+
+
+class ActorRecord:
+    __slots__ = (
+        "spec", "state", "node_id", "worker_id", "worker_address",
+        "num_restarts", "death_cause", "name", "pending_create",
+    )
+
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.state = pb.ACTOR_PENDING
+        self.node_id: Optional[bytes] = None
+        self.worker_id: Optional[bytes] = None
+        self.worker_address: str = ""
+        self.num_restarts = 0
+        self.death_cause = ""
+        self.name = spec.name
+        self.pending_create: Optional[asyncio.Task] = None
+
+    def to_wire(self) -> dict:
+        return {
+            "actor_id": self.spec.actor_id.binary(),
+            "state": self.state,
+            "node_id": self.node_id or b"",
+            "worker_id": self.worker_id or b"",
+            "worker_address": self.worker_address,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+            "name": self.name,
+        }
+
+
+class PlacementGroupRecord:
+    __slots__ = ("pg_id", "bundles", "strategy", "state", "placements", "name")
+
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[pb.Bundle], strategy: str, name: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.state = pb.PG_PENDING
+        # bundle index -> node_id bytes
+        self.placements: Dict[int, bytes] = {}
+        self.name = name
+
+    def to_wire(self) -> dict:
+        return {
+            "pg_id": self.pg_id.binary(),
+            "state": self.state,
+            "strategy": self.strategy,
+            "bundles": [b.to_wire() for b in self.bundles],
+            "placements": {str(k): v for k, v in self.placements.items()},
+            "name": self.name,
+        }
+
+
+class ControlStore:
+    """The cluster control plane service."""
+
+    def __init__(self):
+        self.server = RpcServer(name="control_store")
+        self.pubsub = PubSub(self.server)
+        # node_id bytes -> NodeInfo
+        self.nodes: Dict[bytes, NodeInfo] = {}
+        # node_id bytes -> (available ResourceSet, last heartbeat time)
+        self.node_available: Dict[bytes, ResourceSet] = {}
+        self.node_last_beat: Dict[bytes, float] = {}
+        self.node_conns: Dict[bytes, int] = {}
+        # daemon RPC clients per node
+        self._daemon_clients: Dict[bytes, RpcClient] = {}
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        self.jobs: Dict[bytes, dict] = {}
+        self._next_job = 1
+        self.actors: Dict[bytes, ActorRecord] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (namespace, name) -> actor_id
+        self.placement_groups: Dict[bytes, PlacementGroupRecord] = {}
+        self._health_task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.server.register_service(self)
+        self.server.on_disconnect(self._on_disconnect)
+        addr = await self.server.start(host, port)
+        self._health_task = spawn(self._health_loop())
+        logger.info("control store listening on %s", addr)
+        return addr
+
+    async def stop(self):
+        self._stopped = True
+        if self._health_task:
+            self._health_task.cancel()
+        for c in self._daemon_clients.values():
+            await c.close()
+        await self.server.stop()
+
+    def _on_disconnect(self, conn_id: int) -> None:
+        self.pubsub.unsubscribe_conn(conn_id)
+
+    async def _daemon(self, node_id: bytes) -> RpcClient:
+        client = self._daemon_clients.get(node_id)
+        if client is None:
+            info = self.nodes[node_id]
+            client = RpcClient(info.address, name=f"cs->daemon-{info.node_id.hex()[:6]}")
+            await client.connect()
+            self._daemon_clients[node_id] = client
+        return client
+
+    # ------------------------------------------------------------------
+    # health checking (reference: gcs_health_check_manager.h)
+    # ------------------------------------------------------------------
+
+    async def _health_loop(self):
+        period = GLOBAL_CONFIG.get("health_check_period_s")
+        timeout = GLOBAL_CONFIG.get("health_check_timeout_s")
+        while not self._stopped:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node_id, last in list(self.node_last_beat.items()):
+                info = self.nodes.get(node_id)
+                if info is None or info.state == pb.NODE_DEAD:
+                    continue
+                if now - last > timeout:
+                    await self._mark_node_dead(node_id, "health check timed out")
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or info.state == pb.NODE_DEAD:
+            return
+        info.state = pb.NODE_DEAD
+        self.node_available.pop(node_id, None)
+        client = self._daemon_clients.pop(node_id, None)
+        if client:
+            await client.close()
+        logger.warning("node %s marked DEAD: %s", info.node_id.hex()[:8], reason)
+        self.pubsub.publish("nodes", info.to_wire())
+        # Fail over actors that lived on the node.
+        for rec in list(self.actors.values()):
+            if rec.node_id == node_id and rec.state in (pb.ACTOR_ALIVE, pb.ACTOR_PENDING):
+                await self._on_actor_worker_death(rec, f"node died: {reason}")
+
+    # ------------------------------------------------------------------
+    # node service (reference: gcs_service.proto NodeInfo :771)
+    # ------------------------------------------------------------------
+
+    async def rpc_register_node(self, conn_id: int, payload: dict) -> dict:
+        info = NodeInfo.from_wire(payload["node"])
+        self.nodes[info.node_id.binary()] = info
+        self.node_available[info.node_id.binary()] = info.resources
+        self.node_last_beat[info.node_id.binary()] = time.monotonic()
+        self.node_conns[info.node_id.binary()] = conn_id
+        logger.info(
+            "node %s registered at %s resources=%s",
+            info.node_id.hex()[:8], info.address, info.resources.to_dict(),
+        )
+        self.pubsub.publish("nodes", info.to_wire())
+        return {"ok": True}
+
+    async def rpc_heartbeat(self, conn_id: int, payload: dict) -> dict:
+        node_id = payload["node_id"]
+        self.node_last_beat[node_id] = time.monotonic()
+        if "available" in payload:
+            self.node_available[node_id] = ResourceSet.from_wire(payload["available"])
+        # Reply carries the cluster resource view — the gossip function of
+        # ray_syncer (src/ray/ray_syncer/ray_syncer.h:91) piggybacked on the
+        # health-check beat.
+        return {
+            "view": {
+                nid.hex() if isinstance(nid, bytes) else nid: avail.to_wire()
+                for nid, avail in (
+                    (self.nodes[n].node_id.binary(), a)
+                    for n, a in self.node_available.items()
+                    if n in self.nodes and self.nodes[n].state == pb.NODE_ALIVE
+                )
+            },
+            "nodes": [
+                self.nodes[n].to_wire()
+                for n in self.node_available
+                if n in self.nodes
+            ],
+        }
+
+    async def rpc_get_resource_view(self, conn_id: int, payload) -> dict:
+        return {
+            "view": {
+                self.nodes[n].node_id.hex(): a.to_wire()
+                for n, a in self.node_available.items()
+                if n in self.nodes and self.nodes[n].state == pb.NODE_ALIVE
+            }
+        }
+
+    async def rpc_get_all_nodes(self, conn_id: int, payload) -> dict:
+        return {"nodes": [n.to_wire() for n in self.nodes.values()]}
+
+    async def rpc_drain_node(self, conn_id: int, payload: dict) -> dict:
+        node_id = payload["node_id"]
+        info = self.nodes.get(node_id)
+        if info is None:
+            return {"ok": False}
+        info.state = pb.NODE_DRAINING
+        self.pubsub.publish("nodes", info.to_wire())
+        return {"ok": True}
+
+    async def rpc_unregister_node(self, conn_id: int, payload: dict) -> dict:
+        await self._mark_node_dead(payload["node_id"], "unregistered")
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # KV service (reference: gcs_service.proto InternalKV :633)
+    # ------------------------------------------------------------------
+
+    async def rpc_kv_put(self, conn_id: int, payload: dict) -> dict:
+        ns = self.kv.setdefault(payload.get("ns", ""), {})
+        existed = payload["key"] in ns
+        if not existed or payload.get("overwrite", True):
+            ns[payload["key"]] = payload["value"]
+        return {"existed": existed}
+
+    async def rpc_kv_get(self, conn_id: int, payload: dict) -> dict:
+        ns = self.kv.get(payload.get("ns", ""), {})
+        return {"value": ns.get(payload["key"])}
+
+    async def rpc_kv_del(self, conn_id: int, payload: dict) -> dict:
+        ns = self.kv.get(payload.get("ns", ""), {})
+        return {"deleted": ns.pop(payload["key"], None) is not None}
+
+    async def rpc_kv_keys(self, conn_id: int, payload: dict) -> dict:
+        ns = self.kv.get(payload.get("ns", ""), {})
+        prefix = payload.get("prefix", b"")
+        return {"keys": [k for k in ns if k.startswith(prefix)]}
+
+    # ------------------------------------------------------------------
+    # pub/sub
+    # ------------------------------------------------------------------
+
+    async def rpc_subscribe(self, conn_id: int, payload: dict) -> dict:
+        self.pubsub.subscribe(conn_id, payload["channel"])
+        return {"ok": True}
+
+    async def rpc_publish(self, conn_id: int, payload: dict) -> dict:
+        self.pubsub.publish(payload["channel"], payload["message"])
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # job service (reference: gcs_service.proto JobInfo :69)
+    # ------------------------------------------------------------------
+
+    async def rpc_add_job(self, conn_id: int, payload: dict) -> dict:
+        job_id = JobID.from_int(self._next_job)
+        self._next_job += 1
+        self.jobs[job_id.binary()] = {
+            "job_id": job_id.binary(),
+            "driver_address": payload.get("driver_address", ""),
+            "start_time": time.time(),
+            "finished": False,
+        }
+        return {"job_id": job_id.binary()}
+
+    async def rpc_finish_job(self, conn_id: int, payload: dict) -> dict:
+        job = self.jobs.get(payload["job_id"])
+        if job:
+            job["finished"] = True
+            job["end_time"] = time.time()
+            self.pubsub.publish("jobs", job)
+            # Kill detached-from-driver resources: actors owned by the job.
+            for rec in list(self.actors.values()):
+                if (
+                    rec.spec.job_id.binary() == payload["job_id"]
+                    and rec.state != pb.ACTOR_DEAD
+                    and not rec.spec.runtime_env.get("detached")
+                ):
+                    await self._kill_actor(rec, "job finished", no_restart=True)
+        return {"ok": True}
+
+    async def rpc_get_all_jobs(self, conn_id: int, payload) -> dict:
+        return {"jobs": list(self.jobs.values())}
+
+    # ------------------------------------------------------------------
+    # actor service (reference: gcs_actor_manager.h:94)
+    # ------------------------------------------------------------------
+
+    async def rpc_register_actor(self, conn_id: int, payload: dict) -> dict:
+        spec = TaskSpec.from_wire(payload["spec"])
+        actor_id = spec.actor_id.binary()
+        if actor_id in self.actors:
+            return {"ok": True, "already": True}
+        rec = ActorRecord(spec)
+        self.actors[actor_id] = rec
+        if rec.name:
+            key = (spec.runtime_env.get("namespace", ""), rec.name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing.state != pb.ACTOR_DEAD:
+                    del self.actors[actor_id]
+                    raise ValueError(f"Actor name {rec.name!r} already taken")
+            self.named_actors[key] = actor_id
+        rec.pending_create = spawn(self._create_actor(rec))
+        return {"ok": True}
+
+    async def _create_actor(self, rec: ActorRecord, exclude: Optional[Set[bytes]] = None):
+        """Schedule + create an actor (reference: gcs_actor_scheduler.cc:50)."""
+        actor_hex = rec.spec.actor_id.hex()[:8]
+        try:
+            node_id = self._pick_node_for(rec.spec, exclude or set())
+            while node_id is None:
+                await asyncio.sleep(0.2)
+                if rec.state == pb.ACTOR_DEAD:
+                    return
+                node_id = self._pick_node_for(rec.spec, exclude or set())
+            daemon = await self._daemon(node_id)
+            reply = await daemon.call(
+                "create_actor",
+                {"spec": rec.spec.to_wire()},
+                timeout=GLOBAL_CONFIG.get("actor_creation_timeout_s"),
+            )
+            if not reply.get("ok"):
+                raise RuntimeError(reply.get("error", "creation failed"))
+            rec.node_id = node_id
+            rec.worker_id = reply["worker_id"]
+            rec.worker_address = reply["worker_address"]
+            rec.state = pb.ACTOR_ALIVE
+            logger.info("actor %s ALIVE on %s", actor_hex, rec.worker_address)
+            self.pubsub.publish("actors", rec.to_wire())
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            logger.warning("actor %s creation failed: %s", actor_hex, e)
+            rec.state = pb.ACTOR_DEAD
+            rec.death_cause = f"creation failed: {e}"
+            self.pubsub.publish("actors", rec.to_wire())
+
+    def _pick_node_for(self, spec: TaskSpec, exclude: Set[bytes]) -> Optional[bytes]:
+        """Pick a feasible node. Hybrid policy: pack onto the most-utilized
+        feasible node first (reference: hybrid_scheduling_policy.h:50)."""
+        strategy = spec.strategy
+        if strategy.kind == pb.STRATEGY_NODE_AFFINITY and strategy.node_id:
+            nid = bytes.fromhex(strategy.node_id)
+            info = self.nodes.get(nid)
+            if info and info.state == pb.NODE_ALIVE and nid not in exclude:
+                avail = self.node_available.get(nid)
+                if avail and spec.resources.is_subset_of(avail):
+                    return nid
+            if not strategy.soft:
+                return None
+        candidates = []
+        for nid, info in self.nodes.items():
+            if info.state != pb.NODE_ALIVE or nid in exclude:
+                continue
+            if strategy.label_selector:
+                if not all(info.labels.get(k) == v for k, v in strategy.label_selector.items()):
+                    continue
+            avail = self.node_available.get(nid)
+            if avail is None or not spec.resources.is_subset_of(avail):
+                continue
+            total = info.resources
+            util = 1.0 - (
+                sum(avail.to_wire().values()) / max(1, sum(total.to_wire().values()))
+            )
+            candidates.append((util, nid))
+        if not candidates:
+            return None
+        if strategy.kind == pb.STRATEGY_SPREAD:
+            candidates.sort(key=lambda c: c[0])  # least utilized first
+        else:
+            candidates.sort(key=lambda c: -c[0])  # pack
+        return candidates[0][1]
+
+    async def rpc_report_actor_death(self, conn_id: int, payload: dict) -> dict:
+        """A daemon reports that a worker hosting an actor died."""
+        rec = self.actors.get(payload["actor_id"])
+        if rec is None:
+            return {"ok": False}
+        await self._on_actor_worker_death(rec, payload.get("reason", "worker died"))
+        return {"ok": True}
+
+    async def _on_actor_worker_death(self, rec: ActorRecord, reason: str):
+        if rec.state == pb.ACTOR_DEAD:
+            return
+        max_restarts = rec.spec.max_restarts
+        if max_restarts == -1 or rec.num_restarts < max_restarts:
+            rec.num_restarts += 1
+            rec.state = pb.ACTOR_RESTARTING
+            dead_node = rec.node_id
+            rec.worker_id = None
+            rec.worker_address = ""
+            self.pubsub.publish("actors", rec.to_wire())
+            exclude = set()
+            if dead_node is not None and self.nodes.get(dead_node, None) is not None:
+                if self.nodes[dead_node].state != pb.NODE_ALIVE:
+                    exclude.add(dead_node)
+            rec.pending_create = spawn(self._create_actor(rec, exclude=exclude))
+        else:
+            rec.state = pb.ACTOR_DEAD
+            rec.death_cause = reason
+            self.pubsub.publish("actors", rec.to_wire())
+
+    async def rpc_get_actor_info(self, conn_id: int, payload: dict) -> dict:
+        rec = self.actors.get(payload["actor_id"])
+        return {"actor": rec.to_wire() if rec else None}
+
+    async def rpc_get_named_actor(self, conn_id: int, payload: dict) -> dict:
+        key = (payload.get("namespace", ""), payload["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return {"actor": None}
+        rec = self.actors.get(actor_id)
+        return {"actor": rec.to_wire() if rec else None}
+
+    async def rpc_list_actors(self, conn_id: int, payload) -> dict:
+        return {"actors": [r.to_wire() for r in self.actors.values()]}
+
+    async def rpc_kill_actor(self, conn_id: int, payload: dict) -> dict:
+        rec = self.actors.get(payload["actor_id"])
+        if rec is None:
+            return {"ok": False}
+        await self._kill_actor(
+            rec, payload.get("reason", "ray_tpu.kill"),
+            no_restart=payload.get("no_restart", True),
+        )
+        return {"ok": True}
+
+    async def _kill_actor(self, rec: ActorRecord, reason: str, no_restart: bool):
+        if rec.pending_create and not rec.pending_create.done():
+            rec.pending_create.cancel()
+        if no_restart:
+            rec.state = pb.ACTOR_DEAD
+            rec.death_cause = reason
+        if rec.node_id is not None and rec.worker_id:
+            try:
+                daemon = await self._daemon(rec.node_id)
+                await daemon.call(
+                    "kill_worker",
+                    {"worker_id": rec.worker_id, "reason": reason},
+                    timeout=5,
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        if not no_restart:
+            await self._on_actor_worker_death(rec, reason)
+        else:
+            self.pubsub.publish("actors", rec.to_wire())
+
+    # ------------------------------------------------------------------
+    # placement groups (reference: gcs_placement_group_manager.h, 2PC
+    # prepare/commit node_manager.proto:515-525)
+    # ------------------------------------------------------------------
+
+    async def rpc_create_placement_group(self, conn_id: int, payload: dict) -> dict:
+        pg_id = PlacementGroupID(payload["pg_id"])
+        bundles = [pb.Bundle.from_wire(b) for b in payload["bundles"]]
+        strategy = payload.get("strategy", pb.PG_PACK)
+        rec = PlacementGroupRecord(pg_id, bundles, strategy, payload.get("name", ""))
+        self.placement_groups[pg_id.binary()] = rec
+        spawn(self._schedule_pg(rec))
+        return {"ok": True}
+
+    def _place_bundles(self, rec: PlacementGroupRecord) -> Optional[Dict[int, bytes]]:
+        """Bin-pack bundles onto live nodes per strategy (reference:
+        bundle_scheduling_policy.h:74-101)."""
+        avail = {
+            nid: ResourceSet.from_wire(a.to_wire())
+            for nid, a in self.node_available.items()
+            if nid in self.nodes and self.nodes[nid].state == pb.NODE_ALIVE
+        }
+        placements: Dict[int, bytes] = {}
+        if rec.strategy in (pb.PG_STRICT_PACK,):
+            for nid, a in avail.items():
+                need = ResourceSet()
+                for b in rec.bundles:
+                    need = need + b.resources
+                if need.is_subset_of(a):
+                    return {b.index: nid for b in rec.bundles}
+            return None
+        used_nodes: Set[bytes] = set()
+        for b in sorted(rec.bundles, key=lambda b: -sum(b.resources.to_wire().values())):
+            candidates = [
+                (nid, a) for nid, a in avail.items() if b.resources.is_subset_of(a)
+            ]
+            if rec.strategy == pb.PG_STRICT_SPREAD:
+                candidates = [(n, a) for n, a in candidates if n not in used_nodes]
+            if not candidates:
+                return None
+            if rec.strategy in (pb.PG_SPREAD, pb.PG_STRICT_SPREAD):
+                candidates.sort(key=lambda c: (c[0] in used_nodes, -sum(c[1].to_wire().values())))
+            else:  # PACK: prefer already-used nodes
+                candidates.sort(key=lambda c: (c[0] not in used_nodes, -sum(c[1].to_wire().values())))
+            nid = candidates[0][0]
+            placements[b.index] = nid
+            used_nodes.add(nid)
+            avail[nid] = avail[nid] - b.resources
+        return placements
+
+    async def _schedule_pg(self, rec: PlacementGroupRecord):
+        deadline = time.monotonic() + GLOBAL_CONFIG.get("placement_group_timeout_s")
+        while rec.state == pb.PG_PENDING:
+            placements = self._place_bundles(rec)
+            if placements is None:
+                if time.monotonic() > deadline:
+                    rec.state = pb.PG_REMOVED
+                    self.pubsub.publish("placement_groups", rec.to_wire())
+                    return
+                await asyncio.sleep(0.2)
+                continue
+            # 2PC prepare
+            by_node: Dict[bytes, List[pb.Bundle]] = {}
+            for b in rec.bundles:
+                by_node.setdefault(placements[b.index], []).append(b)
+            prepared: List[bytes] = []
+            ok = True
+            for nid, bundles in by_node.items():
+                try:
+                    daemon = await self._daemon(nid)
+                    r = await daemon.call("prepare_bundles", {
+                        "pg_id": rec.pg_id.binary(),
+                        "bundles": [b.to_wire() for b in bundles],
+                    }, timeout=10)
+                    if not r.get("ok"):
+                        ok = False
+                        break
+                    prepared.append(nid)
+                except Exception:  # noqa: BLE001
+                    ok = False
+                    break
+            if ok:
+                # commit phase: a daemon dying here must roll everything back,
+                # or the surviving nodes leak their prepared reservations
+                try:
+                    for nid in by_node:
+                        daemon = await self._daemon(nid)
+                        await daemon.call(
+                            "commit_bundles", {"pg_id": rec.pg_id.binary()}, timeout=10
+                        )
+                except Exception:  # noqa: BLE001 — node died mid-2PC
+                    ok = False
+            if not ok:
+                for nid in prepared:
+                    try:
+                        daemon = await self._daemon(nid)
+                        await daemon.call("cancel_bundles", {"pg_id": rec.pg_id.binary()}, timeout=5)
+                    except Exception:  # noqa: BLE001
+                        pass
+                await asyncio.sleep(0.2)
+                continue
+            rec.placements = placements
+            rec.state = pb.PG_CREATED
+            self.pubsub.publish("placement_groups", rec.to_wire())
+            return
+
+    async def rpc_get_placement_group(self, conn_id: int, payload: dict) -> dict:
+        rec = self.placement_groups.get(payload["pg_id"])
+        return {"pg": rec.to_wire() if rec else None}
+
+    async def rpc_remove_placement_group(self, conn_id: int, payload: dict) -> dict:
+        rec = self.placement_groups.get(payload["pg_id"])
+        if rec is None:
+            return {"ok": False}
+        rec.state = pb.PG_REMOVED
+        for nid in set(rec.placements.values()):
+            try:
+                daemon = await self._daemon(nid)
+                await daemon.call("return_bundles", {"pg_id": rec.pg_id.binary()}, timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+        self.pubsub.publish("placement_groups", rec.to_wire())
+        return {"ok": True}
+
+
+async def run_control_store(host: str, port: int, ready_file: Optional[str] = None):
+    store = ControlStore()
+    addr = await store.start(host, port)
+    if ready_file:
+        with open(ready_file, "w") as f:
+            json.dump({"address": addr}, f)
+    await asyncio.Event().wait()  # run forever
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--ready-file", default=None)
+    parser.add_argument("--config-json", default="")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=os.environ.get("RT_LOG_LEVEL", args.log_level),
+        format="%(asctime)s %(levelname)s control_store %(message)s",
+    )
+    if args.config_json:
+        GLOBAL_CONFIG.load_overrides(args.config_json)
+    try:
+        asyncio.run(run_control_store(args.host, args.port, args.ready_file))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1060 @@
+"""Core worker — the per-process task/actor/object runtime.
+
+Capability parity with the reference's core worker (reference:
+src/ray/core_worker/core_worker.h:182 — SubmitTask core_worker.cc:1995,
+Get :1326, HandlePushTask :3672; task_submission/normal_task_submitter.h:87;
+task_submission/actor_task_submitter.h:69; store_provider/memory_store/
+memory_store.h:48; reference_counter.h:44). Linked into every driver and
+worker process; drivers run it on a background asyncio thread, workers run it
+on the process main loop.
+
+Data plane design: small objects ride RPC replies into the owner's in-process
+memory store; large objects are sealed into the executing node's shared-memory
+store and the owner records the location (ownership-based object directory,
+reference: ownership_object_directory.h). `get` of a remote object asks the
+local daemon to pull it chunk-wise into the local store, then maps it
+zero-copy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from ray_tpu._private.aio import spawn
+import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import protocol as pb
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.errors import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    RpcError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.protocol import ResourceSet, SchedulingStrategy, TaskSpec
+from ray_tpu.runtime.object_store import META_ERROR, META_NORMAL, ShmObjectStore
+from ray_tpu.runtime.rpc import RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+MODE_DRIVER = "driver"
+MODE_WORKER = "worker"
+
+_current_core_worker: Optional["CoreWorker"] = None
+
+
+def get_core_worker() -> "CoreWorker":
+    if _current_core_worker is None:
+        raise RayTpuError("ray_tpu.init() has not been called in this process")
+    return _current_core_worker
+
+
+def set_core_worker(cw: Optional["CoreWorker"]) -> None:
+    global _current_core_worker
+    _current_core_worker = cw
+
+
+class ObjectRef:
+    """A reference to a (possibly not-yet-computed) remote object.
+
+    Reference: the ObjectRef/ObjectID surface of python/ray/_raylet.pyx and
+    the distributed ref counting of src/ray/core_worker/reference_counter.h:44.
+    Pickling an ObjectRef registers a borrow with the owner; dropping the last
+    reference in a process releases it.
+    """
+
+    __slots__ = ("_id", "_owner_address", "_owner_worker_id", "_released", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: str, owner_worker_id: bytes,
+                 *, _register: bool = True):
+        self._id = object_id
+        self._owner_address = owner_address
+        self._owner_worker_id = owner_worker_id
+        self._released = False
+        if _register and _current_core_worker is not None:
+            _current_core_worker.ref_counter.add_local(self)
+
+    def object_id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    @property
+    def owner_address(self) -> str:
+        return self._owner_address
+
+    def __reduce__(self):
+        ser.note_contained_ref(self)
+        return (
+            _deserialize_object_ref,
+            (self._id.binary(), self._owner_address, self._owner_worker_id),
+        )
+
+    def __del__(self):
+        if not self._released and _current_core_worker is not None:
+            try:
+                _current_core_worker.ref_counter.remove_local(self)
+            except Exception:  # noqa: BLE001 — interpreter shutdown
+                pass
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    # Allow `await ref` inside async actors.
+    def __await__(self):
+        cw = get_core_worker()
+        return cw.get_async(self).__await__()
+
+
+def _deserialize_object_ref(id_bytes: bytes, owner_address: str, owner_worker_id: bytes):
+    ref = ObjectRef(ObjectID(id_bytes), owner_address, owner_worker_id, _register=False)
+    if _current_core_worker is not None:
+        _current_core_worker.ref_counter.on_ref_deserialized(ref)
+    return ref
+
+
+class ReferenceCounter:
+    """Tracks local reference counts and cross-process borrows.
+
+    Reference: src/ray/core_worker/reference_counter.h:44. Owned objects are
+    freed when (local refs == 0) and (known borrowers == 0); borrower
+    processes notify the owner on first deserialization and on release.
+    """
+
+    def __init__(self, cw: "CoreWorker"):
+        self.cw = cw
+        self.local_counts: Dict[bytes, int] = {}
+        self.borrower_counts: Dict[bytes, int] = {}  # for owned objects
+        self.borrowed_owners: Dict[bytes, str] = {}  # oid -> owner address
+        self._lock = threading.Lock()
+
+    def add_local(self, ref: ObjectRef):
+        with self._lock:
+            self.local_counts[ref.binary()] = self.local_counts.get(ref.binary(), 0) + 1
+
+    def remove_local(self, ref: ObjectRef):
+        ref._released = True
+        with self._lock:
+            key = ref.binary()
+            n = self.local_counts.get(key, 0) - 1
+            if n > 0:
+                self.local_counts[key] = n
+                return
+            self.local_counts.pop(key, None)
+        self.cw.schedule(self._on_zero_local(ref))
+
+    async def _on_zero_local(self, ref: ObjectRef):
+        key = ref.binary()
+        with self._lock:
+            if self.local_counts.get(key, 0) > 0:
+                return
+        if self.cw.owns(ref):
+            with self._lock:
+                if self.borrower_counts.get(key, 0) > 0:
+                    return
+            await self.cw.free_owned_object(ref.object_id())
+        else:
+            owner = self.borrowed_owners.pop(key, None)
+            if owner:
+                await self.cw.notify_owner(owner, "remove_borrow", key)
+
+    def on_ref_deserialized(self, ref: ObjectRef):
+        """First sight of a borrowed ref in this process."""
+        with self._lock:
+            first = ref.binary() not in self.local_counts
+            self.local_counts[ref.binary()] = self.local_counts.get(ref.binary(), 0) + 1
+        if not self.cw.owns(ref) and first:
+            self.borrowed_owners[ref.binary()] = ref.owner_address
+            self.cw.schedule(
+                self.cw.notify_owner(ref.owner_address, "add_borrow", ref.binary())
+            )
+
+    # owner side
+    def add_borrower(self, oid: bytes):
+        with self._lock:
+            self.borrower_counts[oid] = self.borrower_counts.get(oid, 0) + 1
+
+    def remove_borrower(self, oid: bytes):
+        drop = False
+        with self._lock:
+            n = self.borrower_counts.get(oid, 0) - 1
+            if n <= 0:
+                self.borrower_counts.pop(oid, None)
+                drop = self.local_counts.get(oid, 0) == 0
+            else:
+                self.borrower_counts[oid] = n
+        if drop:
+            self.cw.schedule(self.cw.free_owned_object(ObjectID(oid)))
+
+
+class MemoryStore:
+    """In-process store for small owned objects and pending futures.
+
+    Reference: src/ray/core_worker/store_provider/memory_store/memory_store.h:48.
+    Values are kept serialized (bytes, metadata); futures resolve when a task
+    reply or put lands.
+    """
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop
+        self.objects: Dict[bytes, Tuple[bytes, int]] = {}
+        self.locations: Dict[bytes, dict] = {}  # oid -> {"daemon": addr, "node_id": hex}
+        self.futures: Dict[bytes, List[asyncio.Future]] = {}
+
+    def put(self, oid: bytes, data: bytes, meta: int):
+        self.objects[oid] = (data, meta)
+        for fut in self.futures.pop(oid, []):
+            if not fut.done():
+                fut.set_result(True)
+
+    def set_location(self, oid: bytes, location: dict):
+        self.locations[oid] = location
+        for fut in self.futures.pop(oid, []):
+            if not fut.done():
+                fut.set_result(True)
+
+    def fail(self, oid: bytes, exc: Exception):
+        data = ser.serialize(exc).to_bytes()
+        self.put(oid, data, META_ERROR)
+
+    def contains(self, oid: bytes) -> bool:
+        return oid in self.objects or oid in self.locations
+
+    def wait_future(self, oid: bytes) -> asyncio.Future:
+        fut = self.loop.create_future()
+        if self.contains(oid):
+            fut.set_result(True)
+        else:
+            self.futures.setdefault(oid, []).append(fut)
+        return fut
+
+    def delete(self, oid: bytes):
+        self.objects.pop(oid, None)
+        self.locations.pop(oid, None)
+
+
+class ActorHandleState:
+    """Caller-side per-actor submission state (reference:
+    actor_task_submitter.h:69 — ordered sequence numbers, address cache)."""
+
+    __slots__ = ("actor_id", "seq", "address", "client", "state", "death_cause", "event")
+
+    def __init__(self, actor_id: bytes):
+        self.actor_id = actor_id
+        self.seq = 0
+        self.address = ""
+        self.client: Optional[RpcClient] = None
+        self.state = pb.ACTOR_PENDING
+        self.death_cause = ""
+        self.event: Optional[asyncio.Event] = None
+
+
+class CoreWorker:
+    """The runtime: owns RPC endpoints, stores, submitters, and executors."""
+
+    def __init__(
+        self,
+        mode: str,
+        control_address: str,
+        daemon_address: str,
+        store_name: str,
+        node_id_hex: str,
+        job_id: JobID,
+        loop: asyncio.AbstractEventLoop,
+        worker_id: Optional[WorkerID] = None,
+    ):
+        self.mode = mode
+        self.loop = loop
+        self.job_id = job_id
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id_hex = node_id_hex
+        self.control_address = control_address
+        self.daemon_address = daemon_address
+        self.store = ShmObjectStore(store_name)
+        self.store_name = store_name
+        self.control = RpcClient(control_address, name=f"{mode}->cs")
+        self.daemon = RpcClient(daemon_address, name=f"{mode}->daemon")
+        self.server = RpcServer(name=f"{mode}-{self.worker_id.hex()[:6]}")
+        self.address: str = ""
+        self.memory_store = MemoryStore(loop)
+        self.ref_counter = ReferenceCounter(self)
+        self.current_task_id = TaskID.for_driver(job_id)
+        self._task_index = 0
+        self._put_index = 0
+        self._actor_index = 0
+        self._lock = threading.Lock()
+        # submitter state
+        self._actor_states: Dict[bytes, ActorHandleState] = {}
+        self._owned_actor_handles: Dict[bytes, int] = {}
+        self._bg_futures: set = set()
+        self._worker_clients: Dict[str, RpcClient] = {}
+        self._owner_clients: Dict[str, RpcClient] = {}
+        # executor state (workers only)
+        self.executor: Optional["TaskExecutor"] = None
+        self._function_cache: Dict[str, Any] = {}
+        self._exported: set = set()
+        self._inline_max = GLOBAL_CONFIG.get("inline_object_max_bytes")
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self):
+        self.server.register_service(self)
+        self.address = await self.server.start()
+        await self.control.connect()
+        await self.daemon.connect()
+        self.control.subscribe_channel("actors", self._on_actor_update)
+        await self.control.call("subscribe", {"channel": "actors"})
+
+    async def close(self):
+        self._closed = True
+        await self.server.stop()
+        await self.control.close()
+        await self.daemon.close()
+        for c in list(self._worker_clients.values()) + list(self._owner_clients.values()):
+            await c.close()
+        for st in self._actor_states.values():
+            if st.client:
+                await st.client.close()
+        self.store.close()
+
+    def schedule(self, coro) -> None:
+        """Schedule a coroutine from any thread; pins the task (the loop keeps
+        only weak task refs — see aio.spawn)."""
+        if self._closed:
+            coro.close()
+            return
+        if self._loop_running_here():
+            spawn(coro)
+        else:
+            fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+            self._bg_futures.add(fut)
+            fut.add_done_callback(self._bg_futures.discard)
+
+    def _loop_running_here(self) -> bool:
+        try:
+            return asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            return False
+
+    def run_sync(self, coro, timeout: Optional[float] = None):
+        """Bridge a coroutine to sync callers (driver public API)."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def owns(self, ref: ObjectRef) -> bool:
+        return ref._owner_worker_id == self.worker_id.binary()
+
+    def next_task_id(self) -> TaskID:
+        with self._lock:
+            self._task_index += 1
+            return TaskID.for_task(self.job_id, self.current_task_id, self._task_index)
+
+    # ------------------------------------------------------------------
+    # function export/fetch (reference: python/ray/_private/function_manager.py)
+    # ------------------------------------------------------------------
+
+    async def export_function(self, key: str, obj: Any):
+        if key in self._exported:
+            return
+        blob = cloudpickle.dumps(obj)
+        await self.control.call(
+            "kv_put",
+            {"ns": "fn", "key": key.encode(), "value": blob, "overwrite": False},
+        )
+        self._exported.add(key)
+
+    async def fetch_function(self, key: str) -> Any:
+        if key in self._function_cache:
+            return self._function_cache[key]
+        deadline = time.monotonic() + 30
+        while True:
+            reply = await self.control.call("kv_get", {"ns": "fn", "key": key.encode()})
+            if reply["value"] is not None:
+                fn = cloudpickle.loads(reply["value"])
+                self._function_cache[key] = fn
+                return fn
+            if time.monotonic() > deadline:
+                raise RayTpuError(f"function {key} never appeared in the control store")
+            await asyncio.sleep(0.05)
+
+    # ------------------------------------------------------------------
+    # put / get / wait
+    # ------------------------------------------------------------------
+
+    async def put_object(self, value: Any) -> ObjectRef:
+        with self._lock:
+            self._put_index += 1
+            oid = ObjectID.for_put(self.current_task_id, self._put_index)
+        sobj = ser.serialize(value)
+        ref = ObjectRef(oid, self.address, self.worker_id.binary())
+        if sobj.total_bytes <= self._inline_max:
+            self.memory_store.put(oid.binary(), sobj.to_bytes(), META_NORMAL)
+        else:
+            view = self.store.create(oid, sobj.total_bytes)
+            sobj.write_into(view)
+            view.release()
+            self.store.seal(oid)
+            self.memory_store.set_location(
+                oid.binary(),
+                {"daemon": self.daemon_address, "node_id": self.node_id_hex, "local": True},
+            )
+        return ref
+
+    async def get_objects(self, refs: Sequence[ObjectRef],
+                          timeout: Optional[float] = None) -> List[Any]:
+        return list(
+            await asyncio.gather(*[self._get_one(r, timeout) for r in refs])
+        )
+
+    async def get_async(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
+        return await self._get_one(ref, timeout)
+
+    async def _get_one(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
+        oid = ref.binary()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if self.owns(ref):
+            fut = self.memory_store.wait_future(oid)
+            await self._await_deadline(fut, deadline, ref)
+            if oid in self.memory_store.objects:
+                data, meta = self.memory_store.objects[oid]
+                return self._materialize(data, meta, copy_buffers=False)
+            location = self.memory_store.locations[oid]
+            return await self._read_store_object(ref, location, deadline)
+        # borrowed: ask the owner (bounded by the caller's deadline)
+        owner_call = self._call_owner(ref, "get_object", {"object_id": oid})
+        if deadline is None:
+            reply = await owner_call
+        else:
+            try:
+                reply = await asyncio.wait_for(
+                    owner_call, max(0.0, deadline - time.monotonic())
+                )
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(
+                    f"get() timed out waiting for {ref.hex()} at its owner"
+                ) from None
+        if reply.get("error"):
+            raise ObjectLostError(ref.hex(), reply["error"])
+        if "data" in reply and reply["data"] is not None:
+            return self._materialize(reply["data"], reply["meta"], copy_buffers=False)
+        return await self._read_store_object(ref, reply["location"], deadline)
+
+    async def _await_deadline(self, fut, deadline, ref):
+        if deadline is None:
+            await fut
+            return
+        remaining = deadline - time.monotonic()
+        try:
+            await asyncio.wait_for(fut, max(0.0, remaining))
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(f"get() timed out waiting for {ref.hex()}") from None
+
+    async def _read_store_object(self, ref: ObjectRef, location: dict, deadline) -> Any:
+        oid = ref.object_id()
+        if not self.store.contains(oid):
+            # remote: ask local daemon to pull into our node's store
+            remote_daemon = location["daemon"]
+            if location.get("node_id") != self.node_id_hex:
+                reply = await self.daemon.call(
+                    "pull_object",
+                    {"object_id": oid.binary(), "from_address": remote_daemon},
+                    timeout=None if deadline is None else max(0.1, deadline - time.monotonic()),
+                )
+                if not reply.get("ok"):
+                    raise ObjectLostError(ref.hex(), reply.get("error", "pull failed"))
+        res = self.store.get_blocking(
+            oid, timeout=None if deadline is None else max(0.0, deadline - time.monotonic())
+        )
+        if res is None:
+            raise GetTimeoutError(f"get() timed out materializing {ref.hex()}")
+        view, meta = res
+        try:
+            if meta == META_ERROR:
+                raise self._deserialize_error(bytes(view))
+            # Zero-copy: buffers alias shm. The view is pinned for the life
+            # of the returned value via the keepalive in deserialize.
+            value = ser.deserialize(view, copy_buffers=False)
+            return value
+        finally:
+            # note: pin stays (store.get incremented); release when GC'd is
+            # future work — the store evicts only unpinned objects.
+            pass
+
+    def _materialize(self, data: bytes, meta: int, copy_buffers: bool) -> Any:
+        if meta == META_ERROR:
+            raise self._deserialize_error(data)
+        return ser.deserialize(data, copy_buffers=copy_buffers)
+
+    def _deserialize_error(self, data) -> Exception:
+        try:
+            exc = ser.deserialize(data, copy_buffers=True)
+            if isinstance(exc, BaseException):
+                return exc
+            return RayTpuError(str(exc))
+        except Exception:  # noqa: BLE001
+            return RayTpuError("task failed and its error could not be deserialized")
+
+    async def wait_objects(self, refs: Sequence[ObjectRef], num_returns: int,
+                           timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        pending = {r: None for r in refs}
+        ready: List[ObjectRef] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def ready_one(r: ObjectRef):
+            if self.owns(r):
+                await self.memory_store.wait_future(r.binary())
+            else:
+                await self._call_owner(r, "wait_object", {"object_id": r.binary()})
+            return r
+
+        tasks = {spawn(ready_one(r)): r for r in pending}
+        try:
+            while tasks and len(ready) < num_returns:
+                budget = None if deadline is None else max(0.0, deadline - time.monotonic())
+                done, _ = await asyncio.wait(
+                    tasks, timeout=budget, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    break
+                for d in done:
+                    r = tasks.pop(d)
+                    if not d.cancelled() and d.exception() is None:
+                        ready.append(r)
+        finally:
+            for t in tasks:
+                t.cancel()
+        not_ready = [r for r in refs if r not in ready]
+        return ready, not_ready
+
+    # ------------------------------------------------------------------
+    # owner-side object service (serving borrowers and executors)
+    # ------------------------------------------------------------------
+
+    async def rpc_get_object(self, conn_id: int, payload: dict) -> dict:
+        oid = payload["object_id"]
+        await self.memory_store.wait_future(oid)
+        if oid in self.memory_store.objects:
+            data, meta = self.memory_store.objects[oid]
+            return {"data": data, "meta": meta}
+        loc = self.memory_store.locations.get(oid)
+        if loc is None:
+            return {"error": "object not found at owner"}
+        return {"data": None, "location": loc}
+
+    async def rpc_wait_object(self, conn_id: int, payload: dict) -> dict:
+        await self.memory_store.wait_future(payload["object_id"])
+        return {"ok": True}
+
+    async def rpc_add_borrow(self, conn_id: int, payload: dict) -> dict:
+        self.ref_counter.add_borrower(payload["object_id"])
+        return {"ok": True}
+
+    async def rpc_remove_borrow(self, conn_id: int, payload: dict) -> dict:
+        self.ref_counter.remove_borrower(payload["object_id"])
+        return {"ok": True}
+
+    async def notify_owner(self, owner_address: str, method: str, oid: bytes):
+        if owner_address == self.address:
+            return
+        try:
+            client = await self._owner_client(owner_address)
+            await client.call(method, {"object_id": oid}, timeout=10)
+        except Exception:  # noqa: BLE001 — owner may be gone; borrow bookkeeping is moot
+            pass
+
+    async def _owner_client(self, address: str) -> RpcClient:
+        client = self._owner_clients.get(address)
+        if client is None:
+            client = RpcClient(address, name="owner-client")
+            await client.connect()
+            self._owner_clients[address] = client
+        return client
+
+    async def _call_owner(self, ref: ObjectRef, method: str, payload: dict) -> dict:
+        try:
+            client = await self._owner_client(ref.owner_address)
+            return await client.call(method, payload, timeout=None)
+        except RpcError as e:
+            raise ObjectLostError(
+                ref.hex(), f"owner at {ref.owner_address} unreachable: {e}"
+            ) from e
+
+    async def free_owned_object(self, oid: ObjectID):
+        key = oid.binary()
+        loc = self.memory_store.locations.get(key)
+        self.memory_store.delete(key)
+        if loc is not None:
+            try:
+                if loc.get("node_id") == self.node_id_hex:
+                    self.store.delete(oid)
+                else:
+                    client = await self._owner_client(loc["daemon"])
+                    await client.call("free_objects", {"object_ids": [key]}, timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+    # task submission (reference: normal_task_submitter.h:87)
+    # ------------------------------------------------------------------
+
+    async def serialize_args(self, args: tuple, kwargs: dict) -> List[dict]:
+        """Serialize positional + keyword args. Each wire entry is either a
+        pass-by-reference {"ref", "owner", ...} or an {"inline"} value, with an
+        optional "kw" name; refs (positional OR keyword) are resolved to their
+        values on the executor, like the reference's plasma-arg resolution."""
+        out = []
+        for kw_name, value in [
+            *((None, v) for v in args),
+            *kwargs.items(),
+        ]:
+            if isinstance(value, ObjectRef):
+                entry = {
+                    "ref": value.binary(),
+                    "owner": value.owner_address,
+                    "owner_worker_id": value._owner_worker_id,
+                }
+            else:
+                sobj = ser.serialize(value)
+                if sobj.total_bytes > self._inline_max or sobj.contained_refs:
+                    ref = await self.put_object(value)
+                    entry = {
+                        "ref": ref.binary(),
+                        "owner": ref.owner_address,
+                        "owner_worker_id": ref._owner_worker_id,
+                        # keep the put alive until the task completes
+                        "_pyref": ref,  # stripped before wire
+                    }
+                else:
+                    entry = {"inline": sobj.to_bytes()}
+            if kw_name is not None:
+                entry["kw"] = kw_name
+            out.append(entry)
+        return out
+
+    async def submit_task(
+        self,
+        function_key: str,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        strategy: Optional[SchedulingStrategy] = None,
+        max_retries: Optional[int] = None,
+        name: str = "",
+    ) -> List[ObjectRef]:
+        task_id = self.next_task_id()
+        wire_args = await self.serialize_args(args, kwargs)
+        pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            kind=pb.TASK_KIND_NORMAL,
+            function_key=function_key,
+            args=wire_args,
+            num_returns=num_returns,
+            resources=ResourceSet(resources or {"CPU": 1.0}),
+            strategy=strategy or SchedulingStrategy(),
+            max_retries=(
+                max_retries if max_retries is not None
+                else GLOBAL_CONFIG.get("max_task_retries_default")
+            ),
+            owner_worker_id=self.worker_id.binary(),
+            owner_address=self.address,
+            name=name,
+        )
+        refs = [
+            ObjectRef(oid, self.address, self.worker_id.binary())
+            for oid in spec.return_ids()
+        ]
+        spawn(self._submit_with_retries(spec, pyrefs))
+        return refs
+
+    async def _submit_with_retries(self, spec: TaskSpec, keepalive):
+        retries = spec.max_retries
+        attempt = 0
+        while True:
+            try:
+                await self._submit_once(spec)
+                return
+            except (WorkerCrashedError, RpcError, ConnectionError, asyncio.TimeoutError) as e:
+                attempt += 1
+                if attempt > retries:
+                    for oid in spec.return_ids():
+                        self.memory_store.fail(
+                            oid.binary(),
+                            WorkerCrashedError(
+                                f"task {spec.name or spec.function_key} failed after "
+                                f"{retries} retries: {e}"
+                            ),
+                        )
+                    return
+                logger.info("retrying task %s (attempt %d): %s", spec.name, attempt, e)
+                await asyncio.sleep(min(0.2 * (2 ** attempt), 5.0))
+            except Exception as e:  # noqa: BLE001 — scheduling-level failure
+                for oid in spec.return_ids():
+                    self.memory_store.fail(oid.binary(), RayTpuError(f"submit failed: {e}"))
+                return
+        # `keepalive` pins arg refs for the life of this coroutine.
+
+    async def _submit_once(self, spec: TaskSpec):
+        lease = await self._acquire_lease(spec)
+        worker_addr = lease["worker_address"]
+        lease_id = lease["lease_id"]
+        daemon_addr = lease["daemon_address"]
+        try:
+            client = await self._worker_client(worker_addr)
+            reply = await client.call("push_task", {"spec": spec.to_wire()}, timeout=None)
+        except (RpcError, ConnectionError) as e:
+            raise WorkerCrashedError(f"worker at {worker_addr} died mid-task: {e}") from e
+        finally:
+            try:
+                dclient = await self._owner_client(daemon_addr)
+                await dclient.call("return_lease", {"lease_id": lease_id}, timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+        self._record_task_reply(spec, reply)
+
+    def _record_task_reply(self, spec: TaskSpec, reply: dict):
+        if reply.get("error"):
+            err = reply["error"]
+            exc = TaskError(
+                spec.name or spec.function_key, err.get("traceback", ""),
+            )
+            if err.get("pickled"):
+                try:
+                    exc = self._deserialize_error(err["pickled"])
+                except Exception:  # noqa: BLE001
+                    pass
+            for oid in spec.return_ids():
+                self.memory_store.fail(oid.binary(), exc)
+            return
+        for ret in reply["returns"]:
+            oid = ret["object_id"]
+            if ret.get("inline") is not None:
+                self.memory_store.put(oid, ret["inline"], ret.get("meta", META_NORMAL))
+            else:
+                self.memory_store.set_location(oid, ret["location"])
+
+    async def _acquire_lease(self, spec: TaskSpec) -> dict:
+        address = self.daemon_address
+        hops = 0
+        last_warn = 0.0
+        while True:
+            client = await self._owner_client(address)
+            reply = await client.call("request_lease", {
+                "resources": spec.resources.to_wire(),
+                "strategy": spec.strategy.to_wire(),
+                "job_id": self.job_id.binary(),
+                "hops": hops,
+            }, timeout=None)
+            if reply.get("granted"):
+                reply["daemon_address"] = address
+                return reply
+            if reply.get("spillback"):
+                address = reply["spillback"]
+                hops += 1
+                continue
+            if reply.get("infeasible"):
+                # The reference keeps infeasible work queued — a node with the
+                # right resources may join (autoscaling, gossip lag). Warn
+                # periodically and retry.
+                now = time.monotonic()
+                if now - last_warn > 30:
+                    last_warn = now
+                    logger.warning(
+                        "task %s requires resources %s which no live node "
+                        "currently provides; waiting",
+                        spec.name or spec.function_key, spec.resources.to_dict(),
+                    )
+                await asyncio.sleep(0.5)
+                address = self.daemon_address
+                hops = 0
+                continue
+            if reply.get("retry"):
+                await asyncio.sleep(0.2)
+                address = self.daemon_address
+                continue
+            raise RayTpuError(f"lease request failed: {reply}")
+
+    async def _worker_client(self, address: str) -> RpcClient:
+        client = self._worker_clients.get(address)
+        if client is None:
+            client = RpcClient(address, name="to-worker", retries=0)
+            await client.connect()
+            self._worker_clients[address] = client
+        return client
+
+    # ------------------------------------------------------------------
+    # actors (reference: actor_task_submitter.h:69, gcs_actor_manager.h:94)
+    # ------------------------------------------------------------------
+
+    def _on_actor_update(self, message: dict):
+        st = self._actor_states.get(message["actor_id"])
+        if st is None:
+            return
+        st.state = message["state"]
+        st.death_cause = message.get("death_cause", "")
+        if st.state == pb.ACTOR_ALIVE:
+            if st.address != message["worker_address"]:
+                if st.client is not None:
+                    old = st.client
+                    st.client = None
+                    self.schedule(old.close())
+                st.address = message["worker_address"]
+        elif st.state in (pb.ACTOR_RESTARTING, pb.ACTOR_DEAD):
+            st.address = ""
+            if st.client is not None:
+                old = st.client
+                st.client = None
+                self.schedule(old.close())
+        if st.event is not None:
+            st.event.set()
+
+    def _actor_state(self, actor_id: bytes) -> ActorHandleState:
+        st = self._actor_states.get(actor_id)
+        if st is None:
+            st = ActorHandleState(actor_id)
+            st.event = asyncio.Event()
+            self._actor_states[actor_id] = st
+        return st
+
+    async def create_actor(
+        self,
+        class_key: str,
+        args: tuple,
+        kwargs: dict,
+        resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: int = 1,
+        is_async: bool = False,
+        strategy: Optional[SchedulingStrategy] = None,
+        name: str = "",
+        namespace: str = "",
+        detached: bool = False,
+    ) -> ActorID:
+        with self._lock:
+            self._actor_index += 1
+            actor_id = ActorID.of(self.job_id, self.current_task_id, self._actor_index)
+        wire_args = await self.serialize_args(args, kwargs)
+        for a in wire_args:
+            a.pop("_pyref", None)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id),
+            job_id=self.job_id,
+            kind=pb.TASK_KIND_ACTOR_CREATION,
+            function_key=class_key,
+            args=wire_args,
+            resources=ResourceSet(resources if resources is not None else {"CPU": 1.0}),
+            strategy=strategy or SchedulingStrategy(),
+            owner_worker_id=self.worker_id.binary(),
+            owner_address=self.address,
+            actor_id=actor_id,
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            is_async_actor=is_async,
+            runtime_env={"namespace": namespace, "detached": detached},
+            name=name,
+        )
+        self._actor_state(actor_id.binary())
+        await self.control.call("register_actor", {"spec": spec.to_wire()})
+        return actor_id
+
+    async def wait_actor_alive(self, actor_id: bytes, timeout: float = 60.0):
+        st = self._actor_state(actor_id)
+        deadline = time.monotonic() + timeout
+        while st.state != pb.ACTOR_ALIVE:
+            if st.state == pb.ACTOR_DEAD:
+                raise ActorDiedError(f"actor failed to start: {st.death_cause}")
+            # poll as fallback for missed pubsub
+            reply = await self.control.call("get_actor_info", {"actor_id": actor_id})
+            if reply["actor"]:
+                self._on_actor_update(reply["actor"])
+            if st.state == pb.ACTOR_ALIVE:
+                break
+            if time.monotonic() > deadline:
+                raise ActorUnavailableError("timed out waiting for actor to start")
+            await asyncio.sleep(0.1)
+
+    async def submit_actor_task(
+        self,
+        actor_id: bytes,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+    ) -> List[ObjectRef]:
+        st = self._actor_state(actor_id)
+        task_id = TaskID.for_actor_task(
+            self.job_id, ActorID(actor_id), self.current_task_id, self._next_seq(st)
+        )
+        wire_args = await self.serialize_args(args, kwargs)
+        pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            kind=pb.TASK_KIND_ACTOR_TASK,
+            method_name=method_name,
+            args=wire_args,
+            num_returns=num_returns,
+            owner_worker_id=self.worker_id.binary(),
+            owner_address=self.address,
+            actor_id=ActorID(actor_id),
+            seq_no=st.seq,
+            name=method_name,
+        )
+        refs = [
+            ObjectRef(oid, self.address, self.worker_id.binary())
+            for oid in spec.return_ids()
+        ]
+        spawn(self._submit_actor_with_retries(st, spec, max_task_retries, pyrefs))
+        return refs
+
+    def _next_seq(self, st: ActorHandleState) -> int:
+        st.seq += 1
+        return st.seq
+
+    async def _submit_actor_with_retries(self, st: ActorHandleState, spec: TaskSpec,
+                                         max_task_retries: int, keepalive):
+        attempt = 0
+        while True:
+            try:
+                await self.wait_actor_alive(st.actor_id)
+                if st.client is None:
+                    st.client = RpcClient(st.address, name="to-actor", retries=0)
+                    await st.client.connect()
+                client = st.client
+                reply = await client.call("push_task", {"spec": spec.to_wire()}, timeout=None)
+                self._record_task_reply(spec, reply)
+                return
+            except (ActorDiedError, ActorUnavailableError) as e:
+                for oid in spec.return_ids():
+                    self.memory_store.fail(oid.binary(), e)
+                return
+            except (RpcError, ConnectionError, asyncio.TimeoutError) as e:
+                attempt += 1
+                if st.state == pb.ACTOR_ALIVE:
+                    # connection died but no death report yet: nudge state
+                    reply = await self.control.call(
+                        "get_actor_info", {"actor_id": st.actor_id}
+                    )
+                    if reply["actor"]:
+                        self._on_actor_update(reply["actor"])
+                if attempt > max_task_retries:
+                    for oid in spec.return_ids():
+                        self.memory_store.fail(
+                            oid.binary(),
+                            ActorUnavailableError(
+                                f"actor task {spec.method_name} failed: {e}"
+                            ) if st.state != pb.ACTOR_DEAD else ActorDiedError(
+                                f"actor died: {st.death_cause or e}"
+                            ),
+                        )
+                    return
+                await asyncio.sleep(min(0.2 * (2 ** attempt), 5.0))
+
+    async def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        await self.control.call(
+            "kill_actor", {"actor_id": actor_id, "no_restart": no_restart}
+        )
+
+    # Actor-handle GC (reference: actor handles participate in reference
+    # counting, python/ray/actor.py — an unnamed, non-detached actor dies when
+    # the creator's last handle goes out of scope).
+    def add_actor_handle_ref(self, actor_id: bytes):
+        with self._lock:
+            self._owned_actor_handles[actor_id] = (
+                self._owned_actor_handles.get(actor_id, 0) + 1
+            )
+
+    def remove_actor_handle_ref(self, actor_id: bytes):
+        with self._lock:
+            n = self._owned_actor_handles.get(actor_id, 0) - 1
+            if n > 0:
+                self._owned_actor_handles[actor_id] = n
+                return
+            self._owned_actor_handles.pop(actor_id, None)
+        self.schedule(self._kill_on_gc(actor_id))
+
+    async def _kill_on_gc(self, actor_id: bytes):
+        try:
+            await self.kill_actor(actor_id, no_restart=True)
+        except Exception:  # noqa: BLE001 — shutdown race
+            pass
+
+    # ------------------------------------------------------------------
+    # executor side (workers; reference: core_worker.cc:3672 HandlePushTask)
+    # ------------------------------------------------------------------
+
+    async def rpc_push_task(self, conn_id: int, payload: dict) -> dict:
+        assert self.executor is not None, "push_task on a non-worker process"
+        spec = TaskSpec.from_wire(payload["spec"])
+        return await self.executor.execute(spec)
+
+    async def resolve_arg(self, arg: dict) -> Any:
+        if "inline" in arg:
+            return ser.deserialize(arg["inline"], copy_buffers=True)
+        ref = ObjectRef(
+            ObjectID(arg["ref"]), arg["owner"], arg["owner_worker_id"], _register=False
+        )
+        if self.owns(ref):
+            return await self._get_one(ref)
+        # check local shm first (zero-copy fast path)
+        if self.store.contains(ref.object_id()):
+            res = self.store.get(ref.object_id())
+            if res is not None:
+                view, meta = res
+                if meta == META_ERROR:
+                    raise self._deserialize_error(bytes(view))
+                return ser.deserialize(view, copy_buffers=False)
+        reply = await self._call_owner(ref, "get_object", {"object_id": ref.binary()})
+        if reply.get("error"):
+            raise ObjectLostError(ref.hex(), reply["error"])
+        if reply.get("data") is not None:
+            return self._materialize(reply["data"], reply["meta"], copy_buffers=True)
+        return await self._read_store_object(ref, reply["location"], None)
+
+    def store_return(self, oid: ObjectID, sobj: ser.SerializedObject,
+                     meta: int = META_NORMAL) -> dict:
+        """Store one return value; small→inline reply, large→local shm."""
+        if sobj.total_bytes <= self._inline_max:
+            return {"object_id": oid.binary(), "inline": sobj.to_bytes(), "meta": meta}
+        try:
+            view = self.store.create(oid, sobj.total_bytes, metadata=meta)
+            sobj.write_into(view)
+            view.release()
+            self.store.seal(oid)
+        except FileExistsError:
+            pass
+        return {
+            "object_id": oid.binary(),
+            "inline": None,
+            "location": {"daemon": self.daemon_address, "node_id": self.node_id_hex},
+        }

@@ -1,0 +1,76 @@
+"""Worker process entry point.
+
+Capability parity with the reference's worker main (reference:
+python/ray/_private/workers/default_worker.py:323 →
+CoreWorkerProcess::RunTaskExecutionLoop core_worker_process.cc:124):
+connects to the node daemon and control store using env vars injected by the
+daemon's worker pool, then serves push_task RPCs until killed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import sys
+
+
+def amain():
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.core_worker import CoreWorker, MODE_WORKER, set_core_worker
+    from ray_tpu._private.ids import JobID, WorkerID
+    from ray_tpu._private.task_executor import TaskExecutor
+    from ray_tpu.runtime.rpc import RpcClient
+
+    async def run():
+        config_json = os.environ.get("RT_CONFIG_JSON", "")
+        if config_json and config_json != "{}":
+            GLOBAL_CONFIG.load_overrides(config_json)
+        job_hex = os.environ["RT_JOB_ID"]
+        cw = CoreWorker(
+            mode=MODE_WORKER,
+            control_address=os.environ["RT_CONTROL_ADDR"],
+            daemon_address=os.environ["RT_DAEMON_ADDR"],
+            store_name=os.environ["RT_STORE_NAME"],
+            node_id_hex=os.environ["RT_NODE_ID"],
+            job_id=JobID(bytes.fromhex(job_hex)) if job_hex else JobID.nil(),
+            loop=asyncio.get_running_loop(),
+            worker_id=WorkerID.from_hex(os.environ["RT_WORKER_ID"]),
+        )
+        cw.executor = TaskExecutor(cw)
+        set_core_worker(cw)
+        await cw.start()
+        # register with the daemon's worker pool
+        reg = RpcClient(os.environ["RT_DAEMON_ADDR"], name="worker->daemon")
+        await reg.connect()
+        reply = await reg.call(
+            "worker_ready",
+            {"worker_id": cw.worker_id.binary(), "address": cw.address},
+        )
+        await reg.close()
+        if not reply.get("ok"):
+            logging.error("daemon rejected worker registration: %s", reply)
+            sys.exit(1)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        loop.add_signal_handler(signal.SIGTERM, stop.set)
+        await stop.wait()
+        await cw.close()
+
+    asyncio.run(run())
+
+
+def main():
+    logging.basicConfig(
+        level=os.environ.get("RT_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s worker %(message)s",
+    )
+    try:
+        amain()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,19 @@ class TaskError(RayTpuError):
         tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
         return cls(function_name, tb, cause=exc)
 
+    def __reduce__(self):
+        # Exceptions pickle by re-calling __init__ with self.args, which does
+        # not match this signature; rebuild explicitly. The cause is carried
+        # when picklable (its traceback is already flattened into the string).
+        cause = self.__cause__
+        try:
+            import pickle
+
+            pickle.dumps(cause)
+        except Exception:  # noqa: BLE001
+            cause = None
+        return (type(self), (self.function_name, self.traceback_str, cause))
+
 
 class WorkerCrashedError(RayTpuError):
     """The worker process executing a task died unexpectedly."""

@@ -1,0 +1,115 @@
+"""Process orchestration: spawn the control store and node daemons.
+
+Capability parity with the reference's node/services layer (reference:
+python/ray/_private/node.py:1629 start_head_processes,
+services.py:1523 start_gcs_server, :1610 start_raylet): head startup spawns the
+control store and a node daemon as subprocesses with ready-file handshakes;
+worker-node startup spawns a daemon pointed at an existing control store.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+
+def _wait_ready(path: str, proc: subprocess.Popen, timeout: float = 30.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"process {proc.args} exited with {proc.returncode} during startup"
+            )
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (json.JSONDecodeError, OSError):
+                pass
+        time.sleep(0.02)
+    raise TimeoutError(f"timed out waiting for ready file {path}")
+
+
+def new_session_dir() -> str:
+    # NOT "<tmp>/ray_tpu": a directory named like the package next to a user's
+    # script would shadow the real package as a namespace package.
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu_sessions")
+    session = os.path.join(
+        base, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{uuid.uuid4().hex[:6]}"
+    )
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    return session
+
+
+def start_control_store(session_dir: str, port: int = 0) -> tuple:
+    ready = os.path.join(session_dir, f"cs_ready_{uuid.uuid4().hex[:6]}.json")
+    log = open(os.path.join(session_dir, "logs", "control_store.log"), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu._private.control_store",
+            "--port", str(port), "--ready-file", ready,
+            "--config-json", GLOBAL_CONFIG.serialize_overrides(),
+        ],
+        stdout=log, stderr=subprocess.STDOUT, start_new_session=True,
+    )
+    log.close()
+    info = _wait_ready(ready, proc)
+    return proc, info["address"]
+
+
+def start_node_daemon(
+    control_address: str,
+    session_dir: str,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    port: int = 0,
+) -> tuple:
+    ready = os.path.join(session_dir, f"nd_ready_{uuid.uuid4().hex[:6]}.json")
+    log = open(
+        os.path.join(session_dir, "logs", f"daemon_{uuid.uuid4().hex[:6]}.log"), "ab"
+    )
+    cmd = [
+        sys.executable, "-m", "ray_tpu._private.node_daemon",
+        "--control-address", control_address,
+        "--session-dir", session_dir,
+        "--port", str(port),
+        "--ready-file", ready,
+        "--config-json", GLOBAL_CONFIG.serialize_overrides(),
+    ]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    if labels:
+        cmd += ["--labels", json.dumps(labels)]
+    proc = subprocess.Popen(
+        cmd, stdout=log, stderr=subprocess.STDOUT, start_new_session=True
+    )
+    log.close()
+    info = _wait_ready(ready, proc)
+    return proc, info
+
+
+def kill_process(proc: subprocess.Popen, force: bool = False, timeout: float = 5.0):
+    if proc.poll() is not None:
+        return
+    try:
+        if force:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        else:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            try:
+                proc.wait(timeout)
+                return
+            except subprocess.TimeoutExpired:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.wait(timeout)
+    except (ProcessLookupError, PermissionError):
+        pass

@@ -1,0 +1,778 @@
+"""Node daemon — the per-node agent (raylet equivalent).
+
+Capability parity with the reference's raylet (reference: src/ray/raylet/
+node_manager.h:144, worker_pool.h:284, scheduling/cluster_lease_manager.h:41,
+scheduling/local_lease_manager.h:62, object_manager/object_manager.h:137):
+
+- owns the node's shared-memory object store (native, ray_tpu/native/shm_store.cc);
+- spawns and pools worker processes (keyed by job, cached idle, monitored for
+  death — reference: worker_pool.h:284);
+- serves worker leases with a two-level scheduler: a cluster policy choosing a
+  node from the gossiped resource view (hybrid pack/spread, reference:
+  hybrid_scheduling_policy.h:50) with spillback replies, and a local grant path
+  that queues until resources free up (reference: cluster_lease_manager.cc:195);
+- reserves/commits placement-group bundles 2-phase (reference:
+  node_manager.proto:515-525, placement_group_resource_manager.h);
+- transfers objects node-to-node in chunks pulled into the local store
+  (reference: object_manager/pull_manager.h:52, push_manager.h:28).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from ray_tpu._private.aio import spawn
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import protocol as pb
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ray_tpu._private.protocol import NodeInfo, ResourceSet, TaskSpec
+from ray_tpu.runtime.object_store import ShmObjectStore
+from ray_tpu.runtime.rpc import RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+W_STARTING = "STARTING"
+W_IDLE = "IDLE"
+W_LEASED = "LEASED"
+W_ACTOR = "ACTOR"
+W_DEAD = "DEAD"
+
+
+class WorkerHandle:
+    __slots__ = (
+        "worker_id", "proc", "state", "address", "pid", "job_id",
+        "client", "lease_id", "actor_id", "ready_event", "idle_since",
+        "actor_resources",
+    )
+
+    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen, job_id: bytes):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.state = W_STARTING
+        self.address = ""
+        self.pid = proc.pid
+        self.job_id = job_id
+        self.client: Optional[RpcClient] = None
+        self.lease_id: Optional[bytes] = None
+        self.actor_id: Optional[bytes] = None
+        self.ready_event = asyncio.Event()
+        self.idle_since = time.monotonic()
+        self.actor_resources: Optional[ResourceSet] = None
+
+
+class PendingLease:
+    __slots__ = ("spec_resources", "strategy", "job_id", "future", "hops")
+
+    def __init__(self, spec_resources: ResourceSet, strategy: pb.SchedulingStrategy,
+                 job_id: bytes, hops: int):
+        self.spec_resources = spec_resources
+        self.strategy = strategy
+        self.job_id = job_id
+        self.future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.hops = hops
+
+
+class NodeDaemon:
+    def __init__(
+        self,
+        control_address: str,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        session_dir: str = "/tmp/ray_tpu_sessions",
+        host: str = "127.0.0.1",
+        store_name: Optional[str] = None,
+    ):
+        self.node_id = NodeID.from_random()
+        self.control_address = control_address
+        self.host = host
+        self.session_dir = session_dir
+        os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        res = dict(resources or {})
+        if "CPU" not in res:
+            res["CPU"] = float(os.cpu_count() or 1)
+        self.total_resources = ResourceSet(res)
+        self.available = ResourceSet(res)
+        self.labels = dict(labels or {})
+        self.store_name = store_name or f"rt_{self.node_id.hex()[:12]}"
+        self.store: Optional[ShmObjectStore] = None
+        self.server = RpcServer(name=f"daemon-{self.node_id.hex()[:6]}")
+        self.control: Optional[RpcClient] = None
+        # worker pool
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.idle_by_job: Dict[bytes, List[bytes]] = {}
+        # leases
+        self.leases: Dict[bytes, Tuple[bytes, ResourceSet, Optional[bytes]]] = {}
+        #   lease_id -> (worker_id, resources, pg_id, bundle_index)
+        self.pending: List[PendingLease] = []
+        # cluster view: node_id hex -> available ResourceSet
+        self.cluster_view: Dict[str, ResourceSet] = {}
+        self.peer_nodes: Dict[str, NodeInfo] = {}
+        self._peer_clients: Dict[str, RpcClient] = {}
+        # placement groups: pg_id -> {"bundles": {idx: ResourceSet}, "state", "free": {idx: ResourceSet}}
+        self.pg_prepared: Dict[bytes, dict] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+        self._draining = False
+        self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self, port: int = 0) -> str:
+        self.store = ShmObjectStore(
+            self.store_name,
+            create=True,
+            size=GLOBAL_CONFIG.get("object_store_memory_bytes"),
+        )
+        self.server.register_service(self)
+        addr = await self.server.start(self.host, port)
+        self.address = addr
+        self.control = RpcClient(self.control_address, name="daemon->cs")
+        await self.control.connect()
+        info = NodeInfo(
+            node_id=self.node_id,
+            address=addr,
+            object_store_name=self.store_name,
+            resources=self.total_resources,
+            labels=self.labels,
+        )
+        await self.control.call("register_node", {"node": info.to_wire()})
+        self._tasks.append(spawn(self._heartbeat_loop()))
+        self._tasks.append(spawn(self._reap_loop()))
+        for _ in range(GLOBAL_CONFIG.get("worker_pool_prestart")):
+            spawn(self._spawn_worker(job_id=b""))
+        logger.info(
+            "daemon %s up at %s store=%s resources=%s",
+            self.node_id.hex()[:8], addr, self.store_name, self.total_resources.to_dict(),
+        )
+        return addr
+
+    async def stop(self):
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self.workers.values()):
+            self._kill_worker_proc(w, "daemon shutdown")
+        if self.control:
+            await self.control.close()
+        for c in self._peer_clients.values():
+            await c.close()
+        await self.server.stop()
+        if self.store:
+            self.store.destroy()
+
+    async def _heartbeat_loop(self):
+        period = GLOBAL_CONFIG.get("health_check_period_s")
+        while not self._stopped:
+            try:
+                reply = await self.control.call(
+                    "heartbeat",
+                    {
+                        "node_id": self.node_id.binary(),
+                        "available": self.available.to_wire(),
+                    },
+                    timeout=period * 5,
+                )
+                self.cluster_view = {
+                    nid: ResourceSet.from_wire(w)
+                    for nid, w in reply.get("view", {}).items()
+                }
+                for nw in reply.get("nodes", []):
+                    info = NodeInfo.from_wire(nw)
+                    self.peer_nodes[info.node_id.hex()] = info
+                self._try_schedule()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("heartbeat failed: %s", e)
+            await asyncio.sleep(period)
+
+    async def _reap_loop(self):
+        """Poll worker processes for death; reap idle surplus."""
+        while not self._stopped:
+            await asyncio.sleep(0.1)
+            for w in list(self.workers.values()):
+                if w.state != W_DEAD and w.proc.poll() is not None:
+                    await self._on_worker_death(w)
+            # reap surplus idle workers (only genuinely idle ones — the list
+            # may hold stale ids for workers that have since been leased)
+            max_idle = GLOBAL_CONFIG.get("worker_pool_max_idle")
+            for job_id, idle in self.idle_by_job.items():
+                idle[:] = [
+                    wid for wid in idle
+                    if self.workers.get(wid) is not None
+                    and self.workers[wid].state == W_IDLE
+                ]
+                while len(idle) > max_idle:
+                    wid = idle.pop(0)
+                    w = self.workers.get(wid)
+                    if w is not None and w.state == W_IDLE:
+                        self._kill_worker_proc(w, "idle reaping")
+
+    # ------------------------------------------------------------------
+    # worker pool (reference: worker_pool.h:284)
+    # ------------------------------------------------------------------
+
+    async def _spawn_worker(self, job_id: bytes) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        log_base = os.path.join(
+            self.session_dir, "logs", f"worker-{worker_id.hex()[:12]}"
+        )
+        env = dict(os.environ)
+        env.update(
+            RT_CONTROL_ADDR=self.control_address,
+            RT_DAEMON_ADDR=self.address,
+            RT_NODE_ID=self.node_id.hex(),
+            RT_WORKER_ID=worker_id.hex(),
+            RT_STORE_NAME=self.store_name,
+            RT_JOB_ID=job_id.hex(),
+            RT_SESSION_DIR=self.session_dir,
+            RT_CONFIG_JSON=GLOBAL_CONFIG.serialize_overrides(),
+        )
+        out = open(log_base + ".out", "ab")
+        err = open(log_base + ".err", "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.default_worker"],
+            env=env, stdout=out, stderr=err, start_new_session=True,
+        )
+        out.close()
+        err.close()
+        handle = WorkerHandle(worker_id, proc, job_id)
+        self.workers[worker_id.binary()] = handle
+        try:
+            await asyncio.wait_for(
+                handle.ready_event.wait(),
+                GLOBAL_CONFIG.get("worker_register_timeout_s"),
+            )
+        except asyncio.TimeoutError:
+            self._kill_worker_proc(handle, "register timeout")
+            raise RuntimeError(
+                f"worker {worker_id.hex()[:8]} failed to register "
+                f"(see {log_base}.err)"
+            )
+        return handle
+
+    async def rpc_worker_ready(self, conn_id: int, payload: dict) -> dict:
+        w = self.workers.get(payload["worker_id"])
+        if w is None:
+            return {"ok": False, "error": "unknown worker"}
+        w.address = payload["address"]
+        w.state = W_IDLE
+        self.idle_by_job.setdefault(w.job_id, []).append(w.worker_id.binary())
+        w.ready_event.set()
+        return {"ok": True}
+
+    def _kill_worker_proc(self, w: WorkerHandle, reason: str):
+        if w.state == W_DEAD:
+            return
+        w.state = W_DEAD
+        try:
+            os.killpg(os.getpgid(w.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        self._forget_worker(w)
+        logger.info("killed worker %s: %s", w.worker_id.hex()[:8], reason)
+
+    def _forget_worker(self, w: WorkerHandle):
+        self.workers.pop(w.worker_id.binary(), None)
+        idle = self.idle_by_job.get(w.job_id, [])
+        if w.worker_id.binary() in idle:
+            idle.remove(w.worker_id.binary())
+
+    async def _on_worker_death(self, w: WorkerHandle):
+        prev_state = w.state
+        w.state = W_DEAD
+        self._forget_worker(w)
+        logger.warning(
+            "worker %s died (state=%s, code=%s)",
+            w.worker_id.hex()[:8], prev_state, w.proc.poll(),
+        )
+        if w.lease_id is not None:
+            self._release_lease(w.lease_id)
+        self._release_actor_resources(w)
+        if w.actor_id is not None:
+            try:
+                await self.control.call(
+                    "report_actor_death",
+                    {"actor_id": w.actor_id, "reason": f"worker process exited ({w.proc.poll()})"},
+                    timeout=10,
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("failed to report actor death")
+
+    async def _get_idle_worker(self, job_id: bytes) -> WorkerHandle:
+        idle = self.idle_by_job.setdefault(job_id, [])
+        while idle:
+            wid = idle.pop()
+            w = self.workers.get(wid)
+            if w is not None and w.state == W_IDLE and w.proc.poll() is None:
+                return w
+        # adopt a prestarted generic worker (spawned before any job existed)
+        generic = self.idle_by_job.get(b"", [])
+        while job_id != b"" and generic:
+            wid = generic.pop()
+            w = self.workers.get(wid)
+            if w is not None and w.state == W_IDLE and w.proc.poll() is None:
+                w.job_id = job_id
+                return w
+        w = await self._spawn_worker(job_id)
+        # worker_ready put it in the idle list; it is being handed out now
+        self._drop_from_idle(w)
+        return w
+
+    def _drop_from_idle(self, w: WorkerHandle):
+        idle = self.idle_by_job.get(w.job_id, [])
+        if w.worker_id.binary() in idle:
+            idle.remove(w.worker_id.binary())
+
+    # ------------------------------------------------------------------
+    # lease scheduling (reference: cluster_lease_manager.cc:195)
+    # ------------------------------------------------------------------
+
+    async def rpc_request_lease(self, conn_id: int, payload: dict) -> dict:
+        spec_res = ResourceSet.from_wire(payload["resources"])
+        strategy = pb.SchedulingStrategy.from_wire(payload.get("strategy"))
+        job_id = payload["job_id"]
+        hops = payload.get("hops", 0)
+        logger.debug("request_lease res=%s hops=%s", spec_res.to_dict(), hops)
+
+        if strategy.kind == pb.STRATEGY_PLACEMENT_GROUP:
+            return await self._grant_pg_lease(spec_res, strategy, job_id)
+
+        # Cluster policy: pick the best node; spill if it isn't us.
+        if not self._draining:
+            choice = self._choose_node(spec_res, strategy)
+        else:
+            choice = self._choose_node(spec_res, strategy, exclude_self=True)
+        my_hex = self.node_id.hex()
+        if choice is not None and choice != my_hex:
+            if hops < GLOBAL_CONFIG.get("lease_spillback_max_hops"):
+                peer = self.peer_nodes.get(choice)
+                if peer is not None:
+                    return {"spillback": peer.address, "node_id": choice}
+        if choice is None and not self._feasible_anywhere(spec_res):
+            return {"infeasible": True}
+        # Local grant path: queue until available.
+        pending = PendingLease(spec_res, strategy, job_id, hops)
+        self.pending.append(pending)
+        self._try_schedule()
+        return await pending.future
+
+    def _choose_node(self, res: ResourceSet, strategy: pb.SchedulingStrategy,
+                     exclude_self: bool = False) -> Optional[str]:
+        """Hybrid pack/spread over the gossiped view (hybrid_scheduling_policy.h:50)."""
+        my_hex = self.node_id.hex()
+        if strategy.kind == pb.STRATEGY_NODE_AFFINITY and strategy.node_id:
+            return strategy.node_id
+        candidates: List[Tuple[float, str]] = []
+        view = dict(self.cluster_view)
+        view[my_hex] = self.available
+        for nid, avail in view.items():
+            if exclude_self and nid == my_hex:
+                continue
+            info = self.peer_nodes.get(nid)
+            if strategy.label_selector and info is not None:
+                if not all(info.labels.get(k) == v
+                           for k, v in strategy.label_selector.items()):
+                    continue
+            if res.is_subset_of(avail):
+                total = info.resources if info else self.total_resources
+                denom = max(1, sum(total.to_wire().values()))
+                util = 1.0 - sum(avail.to_wire().values()) / denom
+                candidates.append((util, nid))
+        if not candidates:
+            return None
+        threshold = GLOBAL_CONFIG.get("scheduler_spread_threshold")
+        if strategy.kind == pb.STRATEGY_SPREAD:
+            candidates.sort(key=lambda c: c[0])
+        else:
+            below = [c for c in candidates if c[0] < threshold]
+            if below:
+                # pack: most utilized under threshold; prefer self on ties
+                below.sort(key=lambda c: (-c[0], c[1] != my_hex))
+                return below[0][1]
+            candidates.sort(key=lambda c: c[0])
+        # prefer self on equal footing to avoid pointless spills
+        best_util = candidates[0][0]
+        for util, nid in candidates:
+            if nid == my_hex and util <= best_util + 1e-9:
+                return my_hex
+        return candidates[0][1]
+
+    def _feasible_anywhere(self, res: ResourceSet) -> bool:
+        if res.is_subset_of(self.total_resources):
+            return True
+        for nid, info in self.peer_nodes.items():
+            if info.state == pb.NODE_ALIVE and res.is_subset_of(info.resources):
+                return True
+        return False
+
+    def _try_schedule(self):
+        if not self.pending:
+            return
+        still: List[PendingLease] = []
+        for p in self.pending:
+            if p.future.done():
+                continue
+            if p.spec_resources.is_subset_of(self.available):
+                self.available = self.available - p.spec_resources
+                spawn(self._grant(p, pg_id=None, bundle_index=-1))
+            else:
+                still.append(p)
+        self.pending = still
+
+    async def _grant(self, p: PendingLease, pg_id: Optional[bytes],
+                     bundle_index: int = -1):
+        try:
+            w = await self._get_idle_worker(p.job_id)
+        except Exception as e:  # noqa: BLE001
+            self.available = self.available + p.spec_resources
+            if not p.future.done():
+                p.future.set_result({"error": f"worker spawn failed: {e}"})
+            return
+        lease_id = os.urandom(16)
+        w.state = W_LEASED
+        w.lease_id = lease_id
+        self.leases[lease_id] = (
+            w.worker_id.binary(), p.spec_resources, pg_id, bundle_index
+        )
+        if not p.future.done():
+            p.future.set_result({
+                "granted": True,
+                "lease_id": lease_id,
+                "worker_id": w.worker_id.binary(),
+                "worker_address": w.address,
+                "node_id": self.node_id.hex(),
+            })
+        else:  # caller gave up (timeout) — reclaim
+            self._release_lease(lease_id)
+
+    async def _grant_pg_lease(self, res: ResourceSet, strategy: pb.SchedulingStrategy,
+                              job_id: bytes) -> dict:
+        pg_id = bytes.fromhex(strategy.placement_group_id)
+        pg = self.pg_prepared.get(pg_id)
+        if pg is None or pg["state"] != "committed":
+            return {"error": "placement group not committed on this node", "retry": True}
+        free: Dict[int, ResourceSet] = pg["free"]
+        idx = strategy.bundle_index
+        indices = [idx] if idx >= 0 else sorted(free.keys())
+        for i in indices:
+            if i in free and res.is_subset_of(free[i]):
+                free[i] = free[i] - res
+                p = PendingLease(res, strategy, job_id, 0)
+                await self._grant(p, pg_id=pg_id, bundle_index=i)
+                reply = await p.future
+                if reply.get("granted"):
+                    reply["bundle_index"] = i
+                else:
+                    free[i] = free[i] + res
+                return reply
+        return {"error": "insufficient placement group resources", "retry": True}
+
+    def _release_lease(self, lease_id: bytes):
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        worker_id, res, pg_id, bundle_index = lease
+        if pg_id is not None:
+            pg = self.pg_prepared.get(pg_id)
+            if pg is not None and bundle_index in pg["free"]:
+                pg["free"][bundle_index] = pg["free"][bundle_index] + res
+        else:
+            self.available = self.available + res
+        w = self.workers.get(worker_id)
+        if w is not None and w.state == W_LEASED:
+            w.state = W_IDLE
+            w.lease_id = None
+            w.idle_since = time.monotonic()
+            self.idle_by_job.setdefault(w.job_id, []).append(worker_id)
+        self._try_schedule()
+
+    async def rpc_return_lease(self, conn_id: int, payload: dict) -> dict:
+        self._release_lease(payload["lease_id"])
+        return {"ok": True}
+
+    async def rpc_kill_worker(self, conn_id: int, payload: dict) -> dict:
+        w = self.workers.get(payload["worker_id"])
+        if w is None:
+            return {"ok": False}
+        actor_id = w.actor_id
+        w.actor_id = None  # killed on purpose: no death report
+        self._kill_worker_proc(w, payload.get("reason", "kill_worker"))
+        if w.lease_id is not None:
+            self._release_lease(w.lease_id)
+        self._release_actor_resources(w)
+        return {"ok": True, "actor_id": actor_id}
+
+    def _release_actor_resources(self, w: WorkerHandle):
+        if w.actor_resources is not None:
+            self.available = self.available + w.actor_resources
+            w.actor_resources = None
+            self._try_schedule()
+
+    # ------------------------------------------------------------------
+    # actor creation (reference: gcs_actor_scheduler.cc:235-387 — here the
+    # control store delegates the lease+push to the owning daemon)
+    # ------------------------------------------------------------------
+
+    async def rpc_create_actor(self, conn_id: int, payload: dict) -> dict:
+        spec = TaskSpec.from_wire(payload["spec"])
+        if not spec.resources.is_subset_of(self.available):
+            return {"ok": False, "error": "insufficient resources"}
+        self.available = self.available - spec.resources
+        try:
+            w = await self._spawn_worker(spec.job_id.binary())
+        except Exception as e:  # noqa: BLE001
+            self.available = self.available + spec.resources
+            return {"ok": False, "error": f"worker spawn failed: {e}"}
+        # dedicate this worker to the actor
+        idle = self.idle_by_job.get(w.job_id, [])
+        if w.worker_id.binary() in idle:
+            idle.remove(w.worker_id.binary())
+        w.state = W_ACTOR
+        w.actor_id = spec.actor_id.binary()
+        client = RpcClient(w.address, name="daemon->worker")
+        try:
+            await client.connect()
+            reply = await client.call(
+                "push_task", {"spec": spec.to_wire()},
+                timeout=GLOBAL_CONFIG.get("actor_creation_timeout_s"),
+            )
+        except Exception as e:  # noqa: BLE001
+            self._kill_worker_proc(w, "actor init push failed")
+            self.available = self.available + spec.resources
+            return {"ok": False, "error": f"actor init failed: {e}"}
+        finally:
+            await client.close()
+        if reply.get("error"):
+            self._kill_worker_proc(w, "actor __init__ raised")
+            self.available = self.available + spec.resources
+            return {"ok": False, "error": reply["error"].get("traceback", "init failed")}
+        w.actor_resources = spec.resources
+        return {
+            "ok": True,
+            "worker_id": w.worker_id.binary(),
+            "worker_address": w.address,
+        }
+
+    # ------------------------------------------------------------------
+    # placement group bundles (reference: node_manager.proto:515-525)
+    # ------------------------------------------------------------------
+
+    async def rpc_prepare_bundles(self, conn_id: int, payload: dict) -> dict:
+        pg_id = payload["pg_id"]
+        bundles = [pb.Bundle.from_wire(b) for b in payload["bundles"]]
+        need = ResourceSet()
+        for b in bundles:
+            need = need + b.resources
+        if not need.is_subset_of(self.available):
+            return {"ok": False}
+        self.available = self.available - need
+        self.pg_prepared[pg_id] = {
+            "state": "prepared",
+            "bundles": {b.index: b.resources for b in bundles},
+            "free": {b.index: b.resources for b in bundles},
+        }
+        return {"ok": True}
+
+    async def rpc_commit_bundles(self, conn_id: int, payload: dict) -> dict:
+        pg = self.pg_prepared.get(payload["pg_id"])
+        if pg is None:
+            return {"ok": False}
+        pg["state"] = "committed"
+        return {"ok": True}
+
+    async def rpc_cancel_bundles(self, conn_id: int, payload: dict) -> dict:
+        return await self.rpc_return_bundles(conn_id, payload)
+
+    async def rpc_return_bundles(self, conn_id: int, payload: dict) -> dict:
+        pg = self.pg_prepared.pop(payload["pg_id"], None)
+        if pg is not None:
+            freed = ResourceSet()
+            for res in pg["bundles"].values():
+                freed = freed + res
+            self.available = self.available + freed
+            self._try_schedule()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # object transfer (reference: object_manager.h:137, pull_manager.h:52)
+    # ------------------------------------------------------------------
+
+    async def rpc_fetch_object_info(self, conn_id: int, payload: dict) -> dict:
+        oid = ObjectID(payload["object_id"])
+        res = self.store.get(oid)
+        if res is None:
+            return {"found": False}
+        view, meta = res
+        size = len(view)
+        view.release()
+        self.store.release(oid)
+        return {"found": True, "size": size, "metadata": meta}
+
+    async def rpc_fetch_chunk(self, conn_id: int, payload: dict) -> dict:
+        oid = ObjectID(payload["object_id"])
+        res = self.store.get(oid)
+        if res is None:
+            return {"found": False}
+        view, meta = res
+        try:
+            off, ln = payload["offset"], payload["length"]
+            return {"found": True, "data": bytes(view[off : off + ln])}
+        finally:
+            view.release()
+            self.store.release(oid)
+
+    async def rpc_pull_object(self, conn_id: int, payload: dict) -> dict:
+        """Pull an object from a remote node into the local store."""
+        oid = ObjectID(payload["object_id"])
+        if self.store.contains(oid):
+            return {"ok": True}
+        key = oid.binary()
+        fut = self._pulls_inflight.get(key)
+        if fut is None:
+            fut = spawn(self._do_pull(oid, payload["from_address"]))
+            self._pulls_inflight[key] = fut
+        try:
+            await fut
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "error": str(e)}
+        finally:
+            self._pulls_inflight.pop(key, None)
+
+    async def _do_pull(self, oid: ObjectID, from_address: str):
+        client = self._peer_clients.get(from_address)
+        if client is None:
+            client = RpcClient(from_address, name="daemon->peer")
+            await client.connect()
+            self._peer_clients[from_address] = client
+        delay = GLOBAL_CONFIG.get("pull_retry_initial_delay_s")
+        max_delay = GLOBAL_CONFIG.get("pull_retry_max_delay_s")
+        deadline = time.monotonic() + 60
+        while True:
+            info = await client.call("fetch_object_info", {"object_id": oid.binary()})
+            if info.get("found"):
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"object {oid} never appeared on {from_address}")
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, max_delay)
+        size, meta = info["size"], info["metadata"]
+        chunk = GLOBAL_CONFIG.get("object_chunk_bytes")
+        try:
+            view = self.store.create(oid, size, metadata=meta)
+        except FileExistsError:
+            return
+        # Parallel chunk fetch (reference: push_manager chunking).
+        offsets = list(range(0, size, chunk))
+        sem = asyncio.Semaphore(8)
+
+        async def fetch(off: int):
+            async with sem:
+                r = await client.call("fetch_chunk", {
+                    "object_id": oid.binary(), "offset": off,
+                    "length": min(chunk, size - off),
+                })
+                if not r.get("found"):
+                    raise RuntimeError("object vanished mid-pull")
+                view[off : off + len(r["data"])] = r["data"]
+
+        try:
+            await asyncio.gather(*[fetch(o) for o in offsets])
+        except Exception:
+            view.release()
+            self.store.delete(oid)
+            raise
+        view.release()
+        self.store.seal(oid)
+
+    async def rpc_free_objects(self, conn_id: int, payload: dict) -> dict:
+        for ob in payload["object_ids"]:
+            self.store.delete(ObjectID(ob))
+        return {"ok": True}
+
+    async def rpc_store_stats(self, conn_id: int, payload) -> dict:
+        return self.store.stats()
+
+    async def rpc_node_info(self, conn_id: int, payload) -> dict:
+        return {
+            "node_id": self.node_id.hex(),
+            "address": self.address,
+            "store_name": self.store_name,
+            "available": self.available.to_wire(),
+            "total": self.total_resources.to_wire(),
+            "num_workers": len(self.workers),
+            "num_pending_leases": len(self.pending),
+        }
+
+    async def rpc_drain(self, conn_id: int, payload) -> dict:
+        """Graceful drain (reference: DrainRaylet node_manager.proto:510)."""
+        self._draining = True
+        return {"ok": True}
+
+
+async def run_daemon(args):
+    daemon = NodeDaemon(
+        control_address=args.control_address,
+        resources=json.loads(args.resources) if args.resources else None,
+        labels=json.loads(args.labels) if args.labels else None,
+        session_dir=args.session_dir,
+        store_name=args.store_name or None,
+    )
+    addr = await daemon.start(args.port)
+    if args.ready_file:
+        with open(args.ready_file, "w") as f:
+            json.dump(
+                {
+                    "address": addr,
+                    "node_id": daemon.node_id.hex(),
+                    "store_name": daemon.store_name,
+                },
+                f,
+            )
+    stop = asyncio.Event()
+
+    def _term(*_):
+        stop.set()
+
+    asyncio.get_running_loop().add_signal_handler(signal.SIGTERM, _term)
+    await stop.wait()
+    await daemon.stop()
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--control-address", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources", default="")
+    parser.add_argument("--labels", default="")
+    parser.add_argument("--session-dir", default="/tmp/ray_tpu_sessions")
+    parser.add_argument("--store-name", default="")
+    parser.add_argument("--ready-file", default=None)
+    parser.add_argument("--config-json", default="")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=os.environ.get("RT_LOG_LEVEL", args.log_level),
+        format="%(asctime)s %(levelname)s daemon %(message)s",
+    )
+    if args.config_json:
+        GLOBAL_CONFIG.load_overrides(args.config_json)
+    try:
+        asyncio.run(run_daemon(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""Callee-side task execution.
+
+Capability parity with the reference's execution pipeline (reference:
+src/ray/core_worker/task_execution/task_receiver.h, concurrency_group_manager.h,
+and the Python seam _raylet.pyx:2540 task_execution_handler /
+:2326 execute_task_with_cancellation_handler):
+
+- normal tasks run serially on a dedicated executor thread;
+- actor creation instantiates the user class and pins it in-process;
+- sync actor tasks are executed in per-caller sequence order (reorder buffer
+  keyed by (caller, seq_no), matching SequentialActorSubmitQueue semantics);
+- async actors run methods as coroutines bounded by max_concurrency;
+- threaded actors use a pool of max_concurrency threads;
+- duplicate deliveries (client retries after reconnect) are answered from a
+  bounded reply cache keyed by task id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import threading
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private import protocol as pb
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.errors import TaskError
+from ray_tpu.runtime.object_store import META_NORMAL
+
+logger = logging.getLogger(__name__)
+
+
+class TaskExecutor:
+    def __init__(self, core_worker):
+        self.cw = core_worker
+        self.thread_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        self.actor_instance: Any = None
+        self.actor_spec = None
+        self._actor_sem: Optional[asyncio.Semaphore] = None
+        # per-caller ordering for sync actors
+        self._expected_seq: Dict[bytes, int] = {}
+        self._buffered: Dict[bytes, Dict[int, asyncio.Event]] = {}
+        self._reply_cache: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._exec_lock = asyncio.Lock()
+
+    # ------------------------------------------------------------------
+
+    async def execute(self, spec: pb.TaskSpec) -> dict:
+        cached = self._reply_cache.get(spec.task_id.binary())
+        if cached is not None:
+            return cached
+        if spec.kind == pb.TASK_KIND_NORMAL:
+            reply = await self._execute_normal(spec)
+        elif spec.kind == pb.TASK_KIND_ACTOR_CREATION:
+            reply = await self._execute_actor_creation(spec)
+        else:
+            reply = await self._execute_actor_task(spec)
+        if spec.kind == pb.TASK_KIND_ACTOR_TASK:
+            self._reply_cache[spec.task_id.binary()] = reply
+            while len(self._reply_cache) > 1024:
+                self._reply_cache.popitem(last=False)
+        return reply
+
+    # ------------------------------------------------------------------
+
+    async def _resolve_args(self, wire_args) -> Tuple[tuple, dict]:
+        resolved = await asyncio.gather(*[self.cw.resolve_arg(a) for a in wire_args])
+        args, kwargs = [], {}
+        for wire, value in zip(wire_args, resolved):
+            if wire.get("kw") is not None:
+                kwargs[wire["kw"]] = value
+            else:
+                args.append(value)
+        return tuple(args), kwargs
+
+    def _error_reply(self, spec: pb.TaskSpec, exc: BaseException) -> dict:
+        terr = TaskError.from_exception(spec.name or spec.method_name or spec.function_key, exc)
+        try:
+            pickled = ser.serialize(terr).to_bytes()
+        except Exception:  # noqa: BLE001 — unpicklable cause
+            pickled = ser.serialize(
+                TaskError(terr.function_name, terr.traceback_str)
+            ).to_bytes()
+        return {"error": {"traceback": terr.traceback_str, "pickled": pickled}}
+
+    def _returns_reply(self, spec: pb.TaskSpec, result: Any) -> dict:
+        oids = spec.return_ids()
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task declared num_returns={spec.num_returns} but returned "
+                    f"{len(values)} values"
+                )
+        returns = []
+        for oid, value in zip(oids, values):
+            sobj = ser.serialize(value)
+            returns.append(self.cw.store_return(oid, sobj, META_NORMAL))
+        return {"returns": returns}
+
+    async def _execute_normal(self, spec: pb.TaskSpec) -> dict:
+        try:
+            fn = await self.cw.fetch_function(spec.function_key)
+            args, kwargs = await self._resolve_args(spec.args)
+            self.cw.current_task_id = spec.task_id
+            if inspect.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                result = await asyncio.get_running_loop().run_in_executor(
+                    self.thread_pool, lambda: fn(*args, **kwargs)
+                )
+            return self._returns_reply(spec, result)
+        except BaseException as e:  # noqa: BLE001 — all errors cross the wire
+            return self._error_reply(spec, e)
+
+    async def _execute_actor_creation(self, spec: pb.TaskSpec) -> dict:
+        try:
+            cls = await self.cw.fetch_function(spec.function_key)
+            args, kwargs = await self._resolve_args(spec.args)
+            self.actor_spec = spec
+            self.cw.current_task_id = spec.task_id
+            if spec.max_concurrency > 1 and not spec.is_async_actor:
+                self.thread_pool = ThreadPoolExecutor(
+                    max_workers=spec.max_concurrency, thread_name_prefix="actor-exec"
+                )
+            if spec.is_async_actor:
+                self._actor_sem = asyncio.Semaphore(max(1, spec.max_concurrency))
+            self.actor_instance = await asyncio.get_running_loop().run_in_executor(
+                self.thread_pool, lambda: cls(*args, **kwargs)
+            )
+            return {"returns": []}
+        except BaseException as e:  # noqa: BLE001
+            return self._error_reply(spec, e)
+
+    async def _execute_actor_task(self, spec: pb.TaskSpec) -> dict:
+        caller = spec.owner_worker_id
+        is_async = self.actor_spec is not None and self.actor_spec.is_async_actor
+        threaded = (
+            self.actor_spec is not None and self.actor_spec.max_concurrency > 1
+        )
+        if not is_async and not threaded:
+            await self._wait_turn(caller, spec.seq_no)
+        try:
+            return await self._run_method(spec, is_async)
+        finally:
+            if not is_async and not threaded:
+                self._advance(caller, spec.seq_no)
+
+    async def _wait_turn(self, caller: bytes, seq: int):
+        """Per-caller in-order execution (reference: sequential actor queues)."""
+        if seq < 0:
+            return
+        expected = self._expected_seq.setdefault(caller, 1)
+        if seq <= expected:
+            return
+        event = asyncio.Event()
+        self._buffered.setdefault(caller, {})[seq] = event
+        try:
+            await asyncio.wait_for(event.wait(), timeout=60.0)
+        except asyncio.TimeoutError:
+            logger.warning("gave up waiting for seq %d from caller; executing", seq)
+        finally:
+            self._buffered.get(caller, {}).pop(seq, None)
+
+    def _advance(self, caller: bytes, seq: int):
+        if seq < 0:
+            return
+        nxt = max(self._expected_seq.get(caller, 1), seq + 1)
+        self._expected_seq[caller] = nxt
+        buf = self._buffered.get(caller, {})
+        if nxt in buf:
+            buf[nxt].set()
+
+    async def _run_method(self, spec: pb.TaskSpec, is_async: bool) -> dict:
+        try:
+            if self.actor_instance is None:
+                raise RuntimeError("actor instance not initialized")
+            method = getattr(self.actor_instance, spec.method_name)
+            args, kwargs = await self._resolve_args(spec.args)
+            self.cw.current_task_id = spec.task_id
+            if is_async:
+                async with self._actor_sem:
+                    if inspect.iscoroutinefunction(method):
+                        result = await method(*args, **kwargs)
+                    else:
+                        result = method(*args, **kwargs)
+            elif inspect.iscoroutinefunction(method):
+                result = await method(*args, **kwargs)
+            else:
+                result = await asyncio.get_running_loop().run_in_executor(
+                    self.thread_pool, lambda: method(*args, **kwargs)
+                )
+            return self._returns_reply(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            return self._error_reply(spec, e)

@@ -1,0 +1,290 @@
+"""Driver/worker global state and the sync↔async bridge.
+
+Capability parity with the reference's worker module (reference:
+python/ray/_private/worker.py:442 Worker, :1438 ray.init, :2855 ray.get,
+:3080 ray.wait, :2069 ray.shutdown): holds the process-wide connection state
+and bridges the synchronous public API onto the core worker's asyncio loop,
+which runs on a dedicated background thread in driver processes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import node as node_mod
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.core_worker import (
+    MODE_DRIVER,
+    CoreWorker,
+    ObjectRef,
+    get_core_worker,
+    set_core_worker,
+)
+from ray_tpu._private.errors import RayTpuError
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.protocol import NodeInfo
+
+
+class DriverContext:
+    """Everything ray_tpu.init() sets up in a driver process."""
+
+    def __init__(self):
+        self.core_worker: Optional[CoreWorker] = None
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.loop_thread: Optional[threading.Thread] = None
+        self.owned_processes: list = []
+        self.session_dir: str = ""
+        self.control_address: str = ""
+        self.initialized = False
+
+    def start_loop(self):
+        ready = threading.Event()
+
+        def run():
+            self.loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self.loop)
+            self.loop._thread_ident = threading.get_ident()
+            ready.set()
+            self.loop.run_forever()
+
+        self.loop_thread = threading.Thread(target=run, name="ray-tpu-driver-loop", daemon=True)
+        self.loop_thread.start()
+        ready.wait()
+
+    def stop_loop(self):
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.loop_thread.join(timeout=5)
+            self.loop = None
+
+
+_context = DriverContext()
+
+
+def global_context() -> DriverContext:
+    return _context
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    system_config: Optional[Dict[str, Any]] = None,
+    ignore_reinit_error: bool = False,
+) -> Dict[str, Any]:
+    """Start a new local cluster (head) or connect to an existing one.
+
+    Reference: ray.init python/ray/_private/worker.py:1438.
+    """
+    if _context.initialized:
+        if ignore_reinit_error:
+            return {"address": _context.control_address}
+        raise RayTpuError("ray_tpu.init() already called (pass ignore_reinit_error=True)")
+    if system_config:
+        GLOBAL_CONFIG.apply_system_config(system_config)
+
+    if address is None:
+        # head mode: spawn control store + a node daemon
+        session_dir = node_mod.new_session_dir()
+        cs_proc, control_address = node_mod.start_control_store(session_dir)
+        _context.owned_processes.append(cs_proc)
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        nd_proc, nd_info = node_mod.start_node_daemon(
+            control_address, session_dir, resources=res or None, labels=labels
+        )
+        _context.owned_processes.append(nd_proc)
+        daemon_address = nd_info["address"]
+        node_id_hex = nd_info["node_id"]
+        store_name = nd_info["store_name"]
+        _context.session_dir = session_dir
+    else:
+        control_address = address
+        _context.session_dir = node_mod.new_session_dir()
+        daemon_address = node_id_hex = store_name = None  # resolved below
+
+    _context.control_address = control_address
+    _context.start_loop()
+    loop = _context.loop
+
+    async def boot():
+        from ray_tpu.runtime.rpc import RpcClient
+
+        cs = RpcClient(control_address, name="driver-boot")
+        await cs.connect()
+        nonlocal_info = {}
+        if daemon_address is None:
+            # connect mode: adopt the first live node on this host as local
+            deadline = time.monotonic() + 10
+            while True:
+                nodes = (await cs.call("get_all_nodes", {}))["nodes"]
+                live = [NodeInfo.from_wire(n) for n in nodes]
+                live = [n for n in live if n.state == "ALIVE"]
+                if live:
+                    break
+                if time.monotonic() > deadline:
+                    raise RayTpuError("no live nodes in cluster to attach to")
+                await asyncio.sleep(0.1)
+            info = live[0]
+            nonlocal_info = {
+                "daemon": info.address,
+                "node_id": info.node_id.hex(),
+                "store": info.object_store_name,
+            }
+        job_reply = await cs.call("add_job", {"driver_address": ""})
+        await cs.close()
+        return nonlocal_info, job_reply["job_id"]
+
+    info, job_id_bytes = asyncio.run_coroutine_threadsafe(boot(), loop).result(30)
+    if daemon_address is None:
+        daemon_address = info["daemon"]
+        node_id_hex = info["node_id"]
+        store_name = info["store"]
+
+    cw = CoreWorker(
+        mode=MODE_DRIVER,
+        control_address=control_address,
+        daemon_address=daemon_address,
+        store_name=store_name,
+        node_id_hex=node_id_hex,
+        job_id=JobID(job_id_bytes),
+        loop=loop,
+    )
+    asyncio.run_coroutine_threadsafe(cw.start(), loop).result(30)
+    set_core_worker(cw)
+    _context.core_worker = cw
+    _context.initialized = True
+    atexit.register(shutdown)
+    return {
+        "address": control_address,
+        "session_dir": _context.session_dir,
+        "job_id": JobID(job_id_bytes).hex(),
+        "node_id": node_id_hex,
+    }
+
+
+def shutdown():
+    if not _context.initialized:
+        return
+    cw = _context.core_worker
+    try:
+        asyncio.run_coroutine_threadsafe(
+            cw.control.call("finish_job", {"job_id": cw.job_id.binary()}, timeout=5),
+            _context.loop,
+        ).result(10)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        asyncio.run_coroutine_threadsafe(cw.close(), _context.loop).result(10)
+    except Exception:  # noqa: BLE001
+        pass
+    set_core_worker(None)
+    _context.core_worker = None
+    _context.stop_loop()
+    for proc in reversed(_context.owned_processes):
+        node_mod.kill_process(proc)
+    _context.owned_processes.clear()
+    _context.initialized = False
+    atexit.unregister(shutdown)
+
+
+def is_initialized() -> bool:
+    return _context.initialized
+
+
+def get(refs, timeout: Optional[float] = None):
+    cw = get_core_worker()
+    single = isinstance(refs, ObjectRef)
+    if single:
+        refs = [refs]
+    if not all(isinstance(r, ObjectRef) for r in refs):
+        raise TypeError("ray_tpu.get() accepts an ObjectRef or a list of ObjectRefs")
+    bridge_timeout = None if timeout is None else timeout + 30
+    values = cw.run_sync(cw.get_objects(refs, timeout), bridge_timeout)
+    return values[0] if single else values
+
+
+def put(value) -> ObjectRef:
+    cw = get_core_worker()
+    return cw.run_sync(cw.put_object(value))
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    cw = get_core_worker()
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    bridge_timeout = None if timeout is None else timeout + 30
+    return cw.run_sync(cw.wait_objects(refs, num_returns, timeout), bridge_timeout)
+
+
+def nodes() -> List[dict]:
+    cw = get_core_worker()
+    reply = cw.run_sync(cw.control.call("get_all_nodes", {}))
+    out = []
+    for n in reply["nodes"]:
+        info = NodeInfo.from_wire(n)
+        out.append({
+            "node_id": info.node_id.hex(),
+            "address": info.address,
+            "state": info.state,
+            "resources": info.resources.to_dict(),
+            "labels": info.labels,
+        })
+    return out
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _sum_resources(
+        n["resources"] for n in nodes() if n["state"] == "ALIVE"
+    )
+
+
+def available_resources() -> Dict[str, float]:
+    from ray_tpu._private.protocol import ResourceSet
+
+    cw = get_core_worker()
+    view = cw.run_sync(cw.control.call("get_resource_view", {})).get("view", {})
+    return _sum_resources(ResourceSet.from_wire(w).to_dict() for w in view.values())
+
+
+def _sum_resources(dicts) -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for d in dicts:
+        for k, v in d.items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def kill(actor, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("ray_tpu.kill() expects an ActorHandle")
+    cw = get_core_worker()
+    cw.run_sync(cw.kill_actor(actor._actor_id.binary(), no_restart), 30)
+
+
+def get_actor(name: str, namespace: str = "") -> "Any":
+    from ray_tpu.actor import ActorHandle
+
+    cw = get_core_worker()
+    reply = cw.run_sync(
+        cw.control.call("get_named_actor", {"name": name, "namespace": namespace})
+    )
+    if reply["actor"] is None or reply["actor"]["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r}")
+    from ray_tpu._private.ids import ActorID
+
+    return ActorHandle(ActorID(reply["actor"]["actor_id"]), class_key="", method_meta=None)

@@ -1,0 +1,172 @@
+"""@ray_tpu.remote actor classes.
+
+Capability parity with the reference's actor surface (reference:
+python/ray/actor.py:1545 ActorClass, :1875 ActorClass._remote, :2266
+ActorHandle, :848 ActorMethod): `.remote()` registers the actor with the
+control store which schedules and instantiates it on a node; handles submit
+ordered method tasks directly to the actor's worker; handles pickle by actor
+id and rebind through the control store on the receiving side.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.core_worker import get_core_worker
+from ray_tpu._private.ids import ActorID
+from ray_tpu.remote_function import build_resources, build_strategy
+
+_VALID_ACTOR_OPTIONS = {
+    "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
+    "max_concurrency", "name", "namespace", "lifetime", "scheduling_strategy",
+    "label_selector", "placement_group", "placement_group_bundle_index",
+}
+
+
+class ActorMethod:
+    __slots__ = ("_handle", "_method_name", "_num_returns")
+
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit(
+            self._method_name, args, kwargs, num_returns=self._num_returns
+        )
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name} cannot be called directly; use .remote()"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_key: str, method_meta: Optional[dict],
+                 max_task_retries: int = 0, _owned: bool = False):
+        self._actor_id = actor_id
+        self._class_key = class_key
+        self._method_meta = method_meta or {}
+        self._max_task_retries = max_task_retries
+        self._owned = _owned
+        if _owned:
+            get_core_worker().add_actor_handle_ref(actor_id.binary())
+
+    def __del__(self):
+        if getattr(self, "_owned", False):
+            try:
+                get_core_worker().remove_actor_handle_ref(self._actor_id.binary())
+            except Exception:  # noqa: BLE001 — interpreter shutdown
+                pass
+
+    def _submit(self, method_name: str, args, kwargs, num_returns: int = 1):
+        cw = get_core_worker()
+        refs = cw.run_sync(
+            cw.submit_actor_task(
+                self._actor_id.binary(), method_name, args, kwargs,
+                num_returns=num_returns, max_task_retries=self._max_task_retries,
+            )
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name, self._method_meta.get(name, 1))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:16]})"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._class_key, self._method_meta, self._max_task_retries),
+        )
+
+    def _actor_info(self) -> dict:
+        cw = get_core_worker()
+        return cw.run_sync(
+            cw.control.call("get_actor_info", {"actor_id": self._actor_id.binary()})
+        )["actor"]
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        for k in self._options:
+            if k not in _VALID_ACTOR_OPTIONS:
+                raise ValueError(f"invalid actor @remote option {k!r}")
+        h = hashlib.blake2b(digest_size=8)
+        h.update(cls.__module__.encode() if cls.__module__ else b"")
+        h.update(cls.__qualname__.encode())
+        for attr in sorted(vars(cls)):
+            fn = vars(cls)[attr]
+            if callable(fn) and hasattr(fn, "__code__"):
+                h.update(fn.__code__.co_code)
+        self._class_key = f"actor:{cls.__qualname__}:{h.hexdigest()}"
+
+    def options(self, **overrides) -> "ActorClass":
+        for k in overrides:
+            if k not in _VALID_ACTOR_OPTIONS:
+                raise ValueError(f"invalid options() key {k!r}")
+        clone = ActorClass.__new__(ActorClass)
+        clone._cls = self._cls
+        clone._options = {**self._options, **overrides}
+        clone._class_key = self._class_key
+        return clone
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        cw = get_core_worker()
+        opts = self._options
+        is_async = _is_async_actor(self._cls)
+
+        async def create():
+            await cw.export_function(self._class_key, self._cls)
+            return await cw.create_actor(
+                self._class_key,
+                args,
+                kwargs,
+                resources=build_resources(opts),
+                max_restarts=opts.get("max_restarts", 0),
+                max_task_retries=opts.get("max_task_retries", 0),
+                max_concurrency=opts.get(
+                    "max_concurrency", 1000 if is_async else 1
+                ),
+                is_async=is_async,
+                strategy=build_strategy(opts),
+                name=opts.get("name", ""),
+                namespace=opts.get("namespace", ""),
+                detached=opts.get("lifetime") == "detached",
+            )
+
+        actor_id = cw.run_sync(create())
+        # Unnamed, non-detached actors are GC'd with the creator's last handle.
+        owned = not opts.get("name") and opts.get("lifetime") != "detached"
+        return ActorHandle(
+            actor_id, self._class_key, {},
+            max_task_retries=opts.get("max_task_retries", 0),
+            _owned=owned,
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated directly; "
+            f"use .remote()"
+        )
+
+
+def _is_async_actor(cls: type) -> bool:
+    import inspect
+
+    for attr in dir(cls):
+        if attr.startswith("__"):
+            continue
+        if inspect.iscoroutinefunction(getattr(cls, attr, None)):
+            return True
+    return False

@@ -1,0 +1,124 @@
+"""@ray_tpu.remote functions.
+
+Capability parity with the reference's remote function surface (reference:
+python/ray/remote_function.py:347 RemoteFunction._remote and the options
+system of python/ray/_private/ray_option_utils.py): `.remote()` exports the
+function once through the control-store KV and submits tasks; `.options()`
+returns a shallow override copy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private.core_worker import get_core_worker
+from ray_tpu._private.protocol import (
+    STRATEGY_NODE_AFFINITY,
+    STRATEGY_PLACEMENT_GROUP,
+    STRATEGY_SPREAD,
+    SchedulingStrategy,
+)
+
+_VALID_OPTIONS = {
+    "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
+    "retry_exceptions", "scheduling_strategy", "name", "label_selector",
+    "placement_group", "placement_group_bundle_index",
+}
+
+
+def build_strategy(opts: Dict[str, Any]) -> SchedulingStrategy:
+    strategy = opts.get("scheduling_strategy")
+    if isinstance(strategy, SchedulingStrategy):
+        s = strategy
+    elif strategy == "SPREAD":
+        s = SchedulingStrategy(kind=STRATEGY_SPREAD)
+    elif isinstance(strategy, str) and strategy.startswith("node:"):
+        s = SchedulingStrategy(kind=STRATEGY_NODE_AFFINITY, node_id=strategy[5:])
+    else:
+        s = SchedulingStrategy()
+    pg = opts.get("placement_group")
+    if pg is not None:
+        pg_id = pg.id.hex() if hasattr(pg, "id") else str(pg)
+        s = SchedulingStrategy(
+            kind=STRATEGY_PLACEMENT_GROUP,
+            placement_group_id=pg_id,
+            bundle_index=opts.get("placement_group_bundle_index", -1),
+        )
+    if opts.get("label_selector"):
+        s.label_selector = dict(opts["label_selector"])
+    return s
+
+
+def build_resources(opts: Dict[str, Any], default_cpu: float = 1.0) -> Dict[str, float]:
+    res = dict(opts.get("resources") or {})
+    if "num_cpus" in opts and opts["num_cpus"] is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    elif "CPU" not in res:
+        res["CPU"] = default_cpu
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    return res
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = dict(options or {})
+        for k in self._options:
+            if k not in _VALID_OPTIONS:
+                raise ValueError(f"invalid @remote option {k!r}")
+        code = getattr(fn, "__code__", None)
+        h = hashlib.blake2b(digest_size=8)
+        h.update(fn.__module__.encode() if fn.__module__ else b"")
+        h.update(fn.__qualname__.encode())
+        if code is not None:
+            h.update(code.co_code)
+        self._function_key = f"{fn.__qualname__}:{h.hexdigest()}"
+        self._exported = False
+
+    @property
+    def _function_name(self) -> str:
+        return self._fn.__qualname__
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = {**self._options, **overrides}
+        clone = RemoteFunction.__new__(RemoteFunction)
+        clone._fn = self._fn
+        clone._options = merged
+        for k in overrides:
+            if k not in _VALID_OPTIONS:
+                raise ValueError(f"invalid options() key {k!r}")
+        clone._function_key = self._function_key
+        clone._exported = self._exported
+        return clone
+
+    def remote(self, *args, **kwargs):
+        cw = get_core_worker()
+        opts = self._options
+
+        async def submit():
+            await cw.export_function(self._function_key, self._fn)
+            return await cw.submit_task(
+                self._function_key,
+                args,
+                kwargs,
+                num_returns=opts.get("num_returns", 1),
+                resources=build_resources(opts),
+                strategy=build_strategy(opts),
+                max_retries=opts.get("max_retries"),
+                name=self._function_name,
+            )
+
+        refs = cw.run_sync(submit())
+        if opts.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._function_name} cannot be called directly; "
+            f"use .remote()"
+        )

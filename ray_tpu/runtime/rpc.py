@@ -15,6 +15,7 @@ src/ray/rpc/rpc_chaos.h and src/ray/asio/asio_chaos.h.
 from __future__ import annotations
 
 import asyncio
+from ray_tpu._private.aio import spawn
 import itertools
 import logging
 import struct
@@ -123,9 +124,7 @@ class RpcServer:
                 kind, req_id, method, payload = frame
                 if kind != _REQ:
                     continue
-                asyncio.ensure_future(
-                    self._dispatch(conn_id, writer, req_id, method, payload)
-                )
+                spawn(self._dispatch(conn_id, writer, req_id, method, payload))
         finally:
             self._conns.pop(conn_id, None)
             for cb in self._on_disconnect:
@@ -158,8 +157,10 @@ class RpcServer:
             async with writer._rt_write_lock:
                 writer.write(_pack(resp))
                 await writer.drain()
-        except (ConnectionError, RuntimeError):
-            pass
+        except (ConnectionError, RuntimeError) as e:
+            logger.warning(
+                "%s: reply to %s (req %s) lost: %s", self.name, method, req_id, e
+            )
 
 
 class RpcClient:
@@ -192,7 +193,7 @@ class RpcClient:
         else:
             host, port = self.address.rsplit(":", 1)
             self._reader, self._writer = await asyncio.open_connection(host, int(port))
-        self._recv_task = asyncio.ensure_future(self._recv_loop())
+        self._recv_task = spawn(self._recv_loop())
 
     async def _recv_loop(self):
         try:
@@ -214,8 +215,8 @@ class RpcClient:
                     fut.set_exception(RpcError(payload))
                 else:
                     fut.set_result(payload)
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError) as e:
+            logger.debug("%s: recv loop ended: %r", self.name, e)
         finally:
             # Mark the transport dead so call() reconnects instead of writing
             # into a half-open socket after a server-side EOF.
@@ -262,6 +263,9 @@ class RpcClient:
                 RpcConnectionLost,
             ) as e:
                 last_exc = e
+                logger.debug(
+                    "%s: call %s attempt %d failed: %r", self.name, method, attempt, e
+                )
                 if req_id is not None:
                     self._pending.pop(req_id, None)
                 if self._writer is not None:
