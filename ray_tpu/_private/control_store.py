@@ -94,9 +94,14 @@ class ActorRecord:
 
 
 class PlacementGroupRecord:
-    __slots__ = ("pg_id", "bundles", "strategy", "state", "placements", "name")
+    __slots__ = (
+        "pg_id", "bundles", "strategy", "state", "placements", "name",
+        "label_selector",
+    )
 
-    def __init__(self, pg_id: PlacementGroupID, bundles: List[pb.Bundle], strategy: str, name: str):
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[pb.Bundle],
+                 strategy: str, name: str,
+                 label_selector: Optional[Dict[str, str]] = None):
         self.pg_id = pg_id
         self.bundles = bundles
         self.strategy = strategy
@@ -104,6 +109,7 @@ class PlacementGroupRecord:
         # bundle index -> node_id bytes
         self.placements: Dict[int, bytes] = {}
         self.name = name
+        self.label_selector = label_selector or {}
 
     def to_wire(self) -> dict:
         return {
@@ -514,7 +520,10 @@ class ControlStore:
         pg_id = PlacementGroupID(payload["pg_id"])
         bundles = [pb.Bundle.from_wire(b) for b in payload["bundles"]]
         strategy = payload.get("strategy", pb.PG_PACK)
-        rec = PlacementGroupRecord(pg_id, bundles, strategy, payload.get("name", ""))
+        rec = PlacementGroupRecord(
+            pg_id, bundles, strategy, payload.get("name", ""),
+            label_selector=payload.get("labels") or {},
+        )
         self.placement_groups[pg_id.binary()] = rec
         spawn(self._schedule_pg(rec))
         return {"ok": True}
@@ -526,6 +535,10 @@ class ControlStore:
             nid: ResourceSet.from_wire(a.to_wire())
             for nid, a in self.node_available.items()
             if nid in self.nodes and self.nodes[nid].state == pb.NODE_ALIVE
+            and all(
+                self.nodes[nid].labels.get(k) == v
+                for k, v in rec.label_selector.items()
+            )
         }
         placements: Dict[int, bytes] = {}
         if rec.strategy in (pb.PG_STRICT_PACK,):

@@ -666,6 +666,7 @@ class CoreWorker:
         strategy: Optional[SchedulingStrategy] = None,
         max_retries: Optional[int] = None,
         name: str = "",
+        runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
         task_id = self.next_task_id()
         wire_args = await self.serialize_args(args, kwargs)
@@ -686,6 +687,7 @@ class CoreWorker:
             owner_worker_id=self.worker_id.binary(),
             owner_address=self.address,
             name=name,
+            runtime_env=runtime_env or {},
         )
         refs = [
             ObjectRef(oid, self.address, self.worker_id.binary())

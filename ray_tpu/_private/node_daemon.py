@@ -98,9 +98,22 @@ class NodeDaemon:
         res = dict(resources or {})
         if "CPU" not in res:
             res["CPU"] = float(os.cpu_count() or 1)
+        self.labels = dict(labels or {})
+        if "TPU" not in res and os.environ.get("RT_TPU_AUTODETECT"):
+            # env-only detection: the daemon must not touch libtpu (that
+            # would claim the chips workers need). Opt-in: on shared-sandbox
+            # hosts several fake daemons coexist with one real chip.
+            from ray_tpu.tpu.accelerator import TpuAcceleratorManager
+
+            info = TpuAcceleratorManager.detect(allow_jax_probe=False)
+            if info is not None:
+                tpu_res, tpu_labels = (
+                    TpuAcceleratorManager.node_resources_and_labels(info)
+                )
+                res.update(tpu_res)
+                self.labels.update(tpu_labels)
         self.total_resources = ResourceSet(res)
         self.available = ResourceSet(res)
-        self.labels = dict(labels or {})
         self.store_name = store_name or f"rt_{self.node_id.hex()[:12]}"
         self.store: Optional[ShmObjectStore] = None
         self.server = RpcServer(name=f"daemon-{self.node_id.hex()[:6]}")

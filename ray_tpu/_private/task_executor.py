@@ -108,6 +108,15 @@ class TaskExecutor:
             fn = await self.cw.fetch_function(spec.function_key)
             args, kwargs = await self._resolve_args(spec.args)
             self.cw.current_task_id = spec.task_id
+            # runtime env vars (e.g. MEGASCALE_* for gang workers) apply to
+            # the worker process before user code runs (reference: runtime_env
+            # env_vars; the reference applies them at worker start, here at
+            # task start since workers are pooled per job)
+            env_vars = (spec.runtime_env or {}).get("env_vars") or {}
+            if env_vars:
+                import os as _os
+
+                _os.environ.update(env_vars)
             if inspect.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
             else:

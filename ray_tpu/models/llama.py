@@ -1,0 +1,381 @@
+"""Llama-family decoder — the flagship model, TPU-first.
+
+Pure-functional JAX (params are a pytree; layers are STACKED and executed with
+`lax.scan` so XLA compiles one layer once regardless of depth — compile time
+stays flat as models grow). bfloat16 activations/matmuls feed the MXU; RoPE,
+GQA, RMSNorm, SwiGLU match Llama-2/3 semantics.
+
+Parallelism is declared, not hand-written: every parameter carries a
+PartitionSpec (megatron tp on the contracting 'parallel' dim, fsdp on the
+other — ZeRO-3 semantics emerge from GSPMD all-gather/reduce-scatter), and
+activations are constrained to ((«dp","fsdp»), "sp", None). Sequence
+parallelism can route attention through ring attention
+(ray_tpu.parallel.ring_attention) instead of GSPMD's KV all-gather.
+
+Capability reference: the models Ray serves/trains via vLLM & TorchTrainer
+(e.g. python/ray/llm/ engines; BASELINE.json configs 3/5 — Llama-2-7B LoRA,
+Llama-3-8B serving); the framework itself has no native model zoo — this one
+does, by design.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import BATCH_AXES, MeshSpec, constrain
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    dtype: Any = jnp.bfloat16  # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    # attention implementation: "xla" (GSPMD), "ring" (ppermute SP),
+    # "flash" (pallas kernel on TPU)
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        return cls(dim=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+                   ffn_dim=11008, **kw)
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, ffn_dim=14336, rope_theta=500000.0, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test/CI-size config."""
+        return cls(vocab_size=512, dim=128, n_layers=2, n_heads=4,
+                   n_kv_heads=2, ffn_dim=256, max_seq_len=256, **kw)
+
+    def num_params(self) -> int:
+        hd = self.head_dim
+        per_layer = (
+            self.dim * self.n_heads * hd          # wq
+            + 2 * self.dim * self.n_kv_heads * hd  # wk, wv
+            + self.n_heads * hd * self.dim         # wo
+            + 3 * self.dim * self.ffn_dim          # w1, w2, w3 (w2 transposed)
+            + 2 * self.dim                         # ln1, ln2
+        )
+        return (
+            self.vocab_size * self.dim             # tok_emb
+            + self.n_layers * per_layer
+            + self.dim                             # final norm
+            + self.dim * self.vocab_size           # lm_head
+        )
+
+
+# ---------------------------------------------------------------------------
+# parameter init + sharding specs
+# ---------------------------------------------------------------------------
+
+
+def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpec pytree matching init_params' structure.
+
+    Leading axis of layer params is the scan (layer) axis — never sharded.
+    tp shards the 'parallel' dim (megatron column/row), fsdp the other.
+    """
+    return {
+        "tok_emb": P("tp", "fsdp"),
+        "layers": {
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "w1": P(None, "fsdp", "tp"),
+            "w3": P(None, "fsdp", "tp"),
+            "w2": P(None, "tp", "fsdp"),
+        },
+        "norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    hd = cfg.head_dim
+    k = iter(jax.random.split(key, 16))
+    pd = cfg.param_dtype
+
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(pd)
+
+    L = cfg.n_layers
+    return {
+        "tok_emb": dense(next(k), cfg.dim, (cfg.vocab_size, cfg.dim)),
+        "layers": {
+            "ln1": jnp.ones((L, cfg.dim), pd),
+            "ln2": jnp.ones((L, cfg.dim), pd),
+            "wq": dense(next(k), cfg.dim, (L, cfg.dim, cfg.n_heads * hd)),
+            "wk": dense(next(k), cfg.dim, (L, cfg.dim, cfg.n_kv_heads * hd)),
+            "wv": dense(next(k), cfg.dim, (L, cfg.dim, cfg.n_kv_heads * hd)),
+            "wo": dense(next(k), cfg.n_heads * hd, (L, cfg.n_heads * hd, cfg.dim)),
+            "w1": dense(next(k), cfg.dim, (L, cfg.dim, cfg.ffn_dim)),
+            "w3": dense(next(k), cfg.dim, (L, cfg.dim, cfg.ffn_dim)),
+            "w2": dense(next(k), cfg.ffn_dim, (L, cfg.ffn_dim, cfg.dim)),
+        },
+        "norm": jnp.ones((cfg.dim,), pd),
+        "lm_head": dense(next(k), cfg.dim, (cfg.dim, cfg.vocab_size)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# model pieces
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    # fp32 statistics even under bf16 activations (numerical parity with
+    # the usual implementations)
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def rope_tables(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """positions: (..., seq) int32 → cos/sin (..., seq, head_dim/2), fp32."""
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (
+        -jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (b, s, h, hd); cos/sin: (b, s, hd/2) or (s, hd/2)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    if cos.ndim == 2:
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    else:
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(jnp.bfloat16 if x.dtype == jnp.bfloat16 else x.dtype)
+
+
+def _attention_xla(q, k, v, causal: bool = True):
+    """Plain XLA attention; fp32 softmax. q: (b, s, h, hd), k/v (b, s, kv, hd)."""
+    b, sq, h, hd = q.shape
+    kv = k.shape[2]
+    if kv != h:  # GQA: repeat kv heads
+        rep = h // kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention(cfg: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
+    if cfg.attention_impl == "ring" and mesh is not None and mesh.shape["sp"] > 1:
+        from ray_tpu.parallel.ring_attention import ring_attention_sharded
+
+        return ring_attention_sharded(q, k, v, mesh, causal=True)
+    if cfg.attention_impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    return _attention_xla(q, k, v, causal=True)
+
+
+def _layer(cfg: LlamaConfig, mesh: Optional[Mesh], h, layer_params, cos, sin):
+    p = layer_params
+    hd = cfg.head_dim
+    b, s, _ = h.shape
+    dt = cfg.dtype
+
+    x = rms_norm(h, p["ln1"], cfg.norm_eps)
+    q = (x @ p["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (x @ p["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (x @ p["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attention(cfg, q, k, v, mesh)
+    attn = attn.reshape(b, s, cfg.n_heads * hd) @ p["wo"].astype(dt)
+    if mesh is not None:
+        attn = constrain(attn, mesh, P(BATCH_AXES, "sp", None))
+    h = h + attn
+
+    x = rms_norm(h, p["ln2"], cfg.norm_eps)
+    gate = jax.nn.silu(x @ p["w1"].astype(dt))
+    up = x @ p["w3"].astype(dt)
+    out = (gate * up) @ p["w2"].astype(dt)
+    if mesh is not None:
+        out = constrain(out, mesh, P(BATCH_AXES, "sp", None))
+    return h + out
+
+
+def forward(
+    cfg: LlamaConfig,
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    mesh: Optional[Mesh] = None,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """tokens (b, s) int32 → logits (b, s, vocab) in fp32."""
+    dt = cfg.dtype
+    h = params["tok_emb"].astype(dt)[tokens]
+    if mesh is not None:
+        h = constrain(h, mesh, P(BATCH_AXES, "sp", None))
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+    cos, sin = rope_tables(cfg, positions)
+
+    def body(carry, layer_params):
+        return _layer(cfg, mesh, carry, layer_params, cos, sin), None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    h = rms_norm(h, params["norm"], cfg.norm_eps)
+    logits = h @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(cfg, params, tokens, mesh=None):
+    """Next-token cross entropy; tokens (b, s)."""
+    logits = forward(cfg, params, tokens[:, :-1], mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+# ---------------------------------------------------------------------------
+# training step factory
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(cfg: LlamaConfig, mesh: Mesh, learning_rate: float = 3e-4,
+                    remat: bool = False):
+    """Build (init_state, jitted train_step) sharded over `mesh`.
+
+    State = (params, opt_state). Donated on update. AdamW via optax.
+    `remat=True` rematerializes each layer (HBM↔FLOPs trade, the standard
+    long-context lever — jax.checkpoint around the scanned layer body).
+    """
+    import optax
+
+    from ray_tpu.parallel.mesh import data_spec, logical_to_sharding
+
+    tx = optax.adamw(learning_rate)
+    specs = param_specs(cfg)
+    param_shardings = logical_to_sharding(specs, mesh)
+
+    lcfg = cfg
+    layer = partial(_layer, lcfg, mesh)
+    if remat:
+        # rematerialize each scanned layer: activations are recomputed in the
+        # backward pass instead of stored — the standard HBM↔FLOPs trade
+        layer = jax.checkpoint(layer)
+
+    def fwd(params, tokens):
+        dt = lcfg.dtype
+        h = params["tok_emb"].astype(dt)[tokens]
+        h = constrain(h, mesh, P(BATCH_AXES, "sp", None))
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+        cos, sin = rope_tables(lcfg, positions)
+
+        def body(carry, lp):
+            return layer(carry, lp, cos, sin), None
+
+        h, _ = jax.lax.scan(body, h, params["layers"])
+        h = rms_norm(h, params["norm"], lcfg.norm_eps)
+        return (h @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+    def compute_loss(params, tokens):
+        # forward on the FULL sequence (keeps the input length divisible by
+        # the sp axis for sharding); the shift happens on logits
+        logits = fwd(params, tokens)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        targets = tokens[:, 1:]
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    def init_state(key):
+        params = init_params(cfg, key)
+        opt_state = tx.init(params)
+        return params, opt_state
+
+    def train_step(state, tokens):
+        params, opt_state = state
+        loss, grads = jax.value_and_grad(compute_loss)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    data_sharding = jax.sharding.NamedSharding(mesh, data_spec())
+    replicated = jax.sharding.NamedSharding(mesh, P())
+
+    def shard_state(state):
+        """Place a (params, opt_state) pytree onto the mesh.
+
+        Optimizer moments mirror the param tree inside optax's state, so each
+        moment leaf's key path ENDS with its param's key path — match on that
+        suffix (shape alone is ambiguous: wq/wk/wv/wo coincide whenever
+        n_heads*head_dim == dim, and a transposed spec would silently force a
+        per-step reshard of donated optimizer state).
+        """
+        from jax.tree_util import keystr, tree_flatten_with_path
+
+        params, opt_state = state
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), params, param_shardings
+        )
+        param_paths = [
+            (keystr(path), leaf.shape, sharding)
+            for (path, leaf), sharding in zip(
+                tree_flatten_with_path(params)[0],
+                jax.tree.leaves(
+                    param_shardings,
+                    is_leaf=lambda s: isinstance(s, jax.sharding.NamedSharding),
+                ),
+            )
+        ]
+
+        def sharding_for(opt_path, x):
+            if not hasattr(x, "ndim") or x.ndim == 0:
+                return replicated
+            ks = keystr(opt_path)
+            for pk, shape, sharding in param_paths:
+                if ks.endswith(pk) and x.shape == shape:
+                    return sharding
+            return replicated
+
+        flat, treedef = tree_flatten_with_path(opt_state)
+        placed = [
+            jax.device_put(x, sharding_for(path, x)) for path, x in flat
+        ]
+        return params, jax.tree.unflatten(treedef, placed)
+
+    jitted = jax.jit(train_step, donate_argnums=(0,))
+    return init_state, shard_state, jitted, data_sharding

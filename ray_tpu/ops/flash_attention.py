@@ -1,0 +1,168 @@
+"""Flash attention forward kernel in Pallas (TPU).
+
+Blockwise online-softmax attention that never materializes the (s, s) score
+matrix: for each query block the kernel streams key/value blocks through VMEM,
+keeping fp32 running max/denominator/accumulator in registers. Causal blocks
+after the diagonal are skipped entirely (work ∝ s²/2). On non-TPU backends
+(CPU tests) it transparently falls back to a fused XLA implementation.
+
+Backward currently recomputes attention under `jax.custom_vjp` with the XLA
+path — functional everywhere, with the memory win applying to inference and
+the forward pass. (A full Pallas backward kernel is the known next step.)
+
+Reference gap: the reference has no attention kernels at all (delegated to
+vLLM/torch — SURVEY §2b); pallas_guide.md is the kernel playbook used here.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_INTERPRET = False  # set True to debug kernels on CPU interpreter
+
+
+def _xla_attention(q, k, v, causal: bool):
+    b, sq, h, hd = q.shape
+    kvh = k.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(q.dtype)
+
+
+def _flash_fwd_tpu(q, k, v, causal: bool, block_q: int, block_k: int):
+    """q: (b, s, h, hd) bf16/f32; returns same. Requires s % block_q == 0."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, hd = q.shape
+    kvh = k.shape[2]
+    rep = h // kvh
+    scale = 1.0 / math.sqrt(hd)
+    num_q_blocks = s // block_q
+
+    # layout: (b*h, s, hd) programs over (bh, q_block)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, hd)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * kvh, s, hd)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * kvh, s, hd)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        qb = q_ref[0].astype(jnp.float32) * scale          # (block_q, hd)
+        # dynamic bound: causal → only K blocks up to (and including) the
+        # diagonal; ceiling division so a partial diagonal block is processed
+        # when block_q < block_k (masking handles the overhang)
+        num_kb = (
+            pl.cdiv(qi * block_q + block_q, block_k) if causal
+            else s // block_k
+        )
+        n_steps = jnp.asarray(num_kb, jnp.int32)
+
+        def body(j, carry):
+            o, m, l = carry
+            kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            logits = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                               # (block_q, block_k)
+            if causal:
+                q_pos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                logits = jnp.where(q_pos >= k_pos, logits, -1e30)
+            block_max = jnp.max(logits, axis=-1, keepdims=True)  # (bq, 1)
+            new_m = jnp.maximum(m, block_max)
+            corr = jnp.exp(m - new_m)
+            p = jnp.exp(logits - new_m)
+            new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            new_o = o * corr + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return new_o, new_m, new_l
+
+        o0 = jnp.zeros((block_q, hd), jnp.float32)
+        m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        o, m, l = lax.fori_loop(0, n_steps, body, (o0, m0, l0))
+        o_ref[0] = (o / l).astype(o_ref.dtype)
+
+    grid = (b * h, num_q_blocks)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, hd), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda bh, qi: (bh, qi, 0)),
+            # GQA: several q heads share one kv head — index map folds bh
+            pl.BlockSpec((1, s, hd), lambda bh, qi: (bh // rep, 0, 0)),
+            pl.BlockSpec((1, s, hd), lambda bh, qi: (bh // rep, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), lambda bh, qi: (bh, qi, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(2 * 2 * b * h * s * s * hd * (0.5 if causal else 1.0)),
+            bytes_accessed=(qt.size + kt.size + vt.size) * qt.dtype.itemsize,
+            transcendentals=b * h * s * s,
+        ),
+        interpret=_INTERPRET,
+    )(qt, kt, vt)
+    return out.reshape(b, h, s, hd).transpose(0, 2, 1, 3)
+
+
+def _supported_on_tpu(q, k, block_q, block_k):
+    b, s, h, hd = q.shape
+    return (
+        jax.default_backend() == "tpu"
+        and s % block_q == 0
+        and s % block_k == 0
+        and hd % 128 == 0
+        and h % k.shape[2] == 0
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    if _supported_on_tpu(q, k, block_q, block_k):
+        return _flash_fwd_tpu(q, k, v, causal, block_q, block_k)
+    return _xla_attention(q, k, v, causal)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 256, block_k: int = 256):
+    """Public entry. q/k/v: (batch, seq, heads, head_dim); GQA supported."""
+    s = q.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    return _flash(q, k, v, causal, block_q, block_k)

@@ -25,7 +25,7 @@ from ray_tpu._private.protocol import (
 _VALID_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
     "retry_exceptions", "scheduling_strategy", "name", "label_selector",
-    "placement_group", "placement_group_bundle_index",
+    "placement_group", "placement_group_bundle_index", "runtime_env",
 }
 
 
@@ -110,6 +110,7 @@ class RemoteFunction:
                 strategy=build_strategy(opts),
                 max_retries=opts.get("max_retries"),
                 name=self._function_name,
+                runtime_env=opts.get("runtime_env"),
             )
 
         refs = cw.run_sync(submit())

@@ -1,0 +1,165 @@
+"""TPU accelerator manager: detection, visibility, resource naming.
+
+Capability parity with the reference's TPU accelerator plugin (reference:
+python/ray/_private/accelerators/tpu.py — GCE metadata + GKE env detection
+:24-40, TPU_VISIBLE_CHIPS :42, chips/host logic :155-258, topology validation
+:96, pod-head resource `TPU-{type}-head` :345) and the AcceleratorManager ABC
+(accelerator.py). Rebuilt for a zero-egress environment: detection prefers
+explicit env/config over GCE metadata (which is gated), then live JAX devices.
+
+Key semantics ported:
+- one worker process owns a host's chip set; `TPU_VISIBLE_CHIPS` restricts it;
+- TPU resources are named by accelerator version ("TPU-v5e" etc.);
+- the FIRST host of a slice additionally exposes `TPU-{pod_type}-head: 1`, the
+  hook the slice scheduler gangs on.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+logger = logging.getLogger(__name__)
+
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+TPU_CHIPS_PER_HOST_BOUNDS_ENV = "TPU_CHIPS_PER_HOST_BOUNDS"
+TPU_ACCELERATOR_TYPE_ENV = "TPU_ACCELERATOR_TYPE"  # e.g. "v5litepod-16"
+TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+TPU_NAME_ENV = "TPU_NAME"
+
+# accelerator generation -> chips per host (reference: tpu.py host-shape logic)
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5p": 4, "v5e": 8, "v5litepod": 8,
+                   "v6e": 8}
+
+
+@dataclass
+class TpuInfo:
+    generation: str            # "v5e", "v4", ...
+    pod_type: str              # "v5e-16" style (accelerator_type normalized)
+    topology: str              # "4x4" style when known
+    chips_on_host: int
+    hosts_in_slice: int
+    worker_id: int             # this host's index within the slice
+    slice_name: str
+
+    @property
+    def resource_name(self) -> str:
+        return f"TPU-{self.generation}"
+
+    @property
+    def head_resource_name(self) -> str:
+        return f"TPU-{self.pod_type}-head"
+
+
+def _normalize_generation(accel_type: str) -> str:
+    gen = accel_type.split("-")[0].lower()
+    return {"v5litepod": "v5e", "v5lite": "v5e"}.get(gen, gen)
+
+
+class TpuAcceleratorManager:
+    """Detection + env handling for the node daemon and worker pool."""
+
+    @staticmethod
+    def detect(allow_jax_probe: bool = True) -> Optional[TpuInfo]:
+        """Detect TPU presence. `allow_jax_probe=False` for the node daemon:
+        importing jax initializes libtpu and would CLAIM the host's chips —
+        only worker processes may do that (reference: one process per chip
+        set, tpu.py:42-55 / SURVEY §7 hard part 2)."""
+        accel_type = (
+            os.environ.get(TPU_ACCELERATOR_TYPE_ENV)
+            or os.environ.get("PALLAS_AXON_TPU_GEN")  # this image's env
+        )
+        chips = GLOBAL_CONFIG.get("tpu_chips_per_host")
+        topology = GLOBAL_CONFIG.get("tpu_topology") or os.environ.get(
+            "TPU_TOPOLOGY", ""
+        )
+        if accel_type is None and not chips and not allow_jax_probe:
+            return None
+        if accel_type is None and not chips:
+            # live-JAX fallback: count local TPU devices if a backend is up
+            try:
+                import jax
+
+                devs = [d for d in jax.local_devices() if d.platform == "tpu"]
+                if not devs:
+                    return None
+                kind = devs[0].device_kind.lower()  # e.g. "tpu v5 lite"
+                gen = "v5e" if "v5 lite" in kind else (
+                    "v6e" if "v6 lite" in kind else
+                    re.sub(r"[^v0-9p]", "", kind.replace("tpu", "")) or "v4"
+                )
+                accel_type = f"{gen}-{len(devs)}"
+                chips = chips or len(devs)
+            except Exception:  # noqa: BLE001
+                return None
+        if accel_type is None:
+            return None
+        gen = _normalize_generation(accel_type)
+        num_chips_total = 0
+        m = re.match(r".*-(\d+)$", accel_type)
+        if m:
+            num_chips_total = int(m.group(1))
+            # for v2/v3/v4/v5p the accelerator-type suffix counts TensorCores
+            # (2 per chip), not chips (reference: tpu.py get_tpu_cores_per_chip
+            # semantics, :155-188); v5e/v6e suffixes already count chips
+            if gen in ("v2", "v3", "v4", "v5p"):
+                num_chips_total = max(1, num_chips_total // 2)
+        chips_on_host = chips or min(
+            _CHIPS_PER_HOST.get(gen, 4), num_chips_total or 4
+        )
+        hosts = max(1, (num_chips_total or chips_on_host) // chips_on_host)
+        pod_type = f"{gen}-{num_chips_total or chips_on_host}"
+        return TpuInfo(
+            generation=gen,
+            pod_type=pod_type,
+            topology=topology,
+            chips_on_host=chips_on_host,
+            hosts_in_slice=hosts,
+            worker_id=int(os.environ.get(TPU_WORKER_ID_ENV, "0")),
+            slice_name=os.environ.get(TPU_NAME_ENV, pod_type),
+        )
+
+    @staticmethod
+    def node_resources_and_labels(info: Optional[TpuInfo] = None):
+        """Resources + labels the node daemon should advertise."""
+        info = info or TpuAcceleratorManager.detect()
+        if info is None:
+            return {}, {}
+        resources: Dict[str, float] = {
+            "TPU": float(info.chips_on_host),
+            info.resource_name: float(info.chips_on_host),
+        }
+        if info.worker_id == 0:
+            resources[info.head_resource_name] = 1.0
+        labels = {
+            "tpu-generation": info.generation,
+            "tpu-pod-type": info.pod_type,
+            "tpu-slice-name": info.slice_name,
+            "tpu-worker-id": str(info.worker_id),
+        }
+        if info.topology:
+            labels["tpu-topology"] = info.topology
+        return resources, labels
+
+    @staticmethod
+    def set_visible_chips_env(env: Dict[str, str], chip_ids: List[int],
+                              chips_per_host: int) -> None:
+        """Restrict a worker process to specific chips (reference: tpu.py:42-55).
+
+        With all chips granted, the env vars are left unset so libtpu owns the
+        full host (the fast path — one long-lived gang worker per host).
+        """
+        if len(chip_ids) >= chips_per_host:
+            return
+        env[TPU_VISIBLE_CHIPS_ENV] = ",".join(str(c) for c in chip_ids)
+        if len(chip_ids) == 1:
+            env[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = "1,1,1"
+        elif len(chip_ids) == 2:
+            env[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = "1,2,1"
+        elif len(chip_ids) == 4:
+            env[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = "2,2,1"

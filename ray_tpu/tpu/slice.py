@@ -1,0 +1,163 @@
+"""TPU slice orchestration: whole-slice reservation + per-host dispatch.
+
+Capability parity with the reference's `ray.util.tpu` (reference:
+python/ray/util/tpu.py — SlicePlacementGroup :421 reserves whole slices via
+the `TPU-{pod_type}-head` resource + label selector, slice_placement_group
+:803, dispatch :849 runs a fn on every host of the slices,
+get_tpu_coordinator_env_vars :213 builds the MEGASCALE cross-slice env).
+
+A slice reservation works in two stages, like the reference:
+1. grab one `TPU-{pod_type}-head: 1` per slice — the head resource exists on
+   exactly one host per slice, so owning it owns the slice;
+2. resolve each claimed head's `tpu-slice-name` node label and gang that
+   slice's per-host TPU bundles with a `bundle_label_selector` pinning them to
+   the slice's own hosts (STRICT_SPREAD within the slice). On clusters whose
+   nodes don't carry slice labels (single-host dev boxes), stage 2 falls back
+   to an unpinned gang.
+
+`dispatch` injects the MEGASCALE_* env into every host's task for multi-slice
+reservations (coordinator = slice 0's head host).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.util.placement_group import PlacementGroup, placement_group
+
+logger = logging.getLogger(__name__)
+
+# MEGASCALE env keys for cross-slice DCN coordination (reference:
+# python/ray/train/v2/jax/config.py:29-35, util/tpu.py:213)
+MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+MEGASCALE_PORT = "MEGASCALE_PORT"
+
+
+def get_tpu_coordinator_env_vars(
+    coordinator_address: str, num_slices: int, slice_id: int,
+    port: int = 8081,
+) -> Dict[str, str]:
+    """Env to inject into every worker of a multi-slice job."""
+    if num_slices <= 1:
+        return {}
+    return {
+        MEGASCALE_COORDINATOR: coordinator_address,
+        MEGASCALE_NUM_SLICES: str(num_slices),
+        MEGASCALE_SLICE_ID: str(slice_id),
+        MEGASCALE_PORT: str(port),
+    }
+
+
+@dataclass
+class SlicePlacementGroup:
+    """Reservation of one or more whole TPU slices."""
+
+    pod_type: str                   # e.g. "v5e-16"
+    num_slices: int = 1
+    chips_per_host: int = 8
+    hosts_per_slice: int = 1
+    megascale_port: int = 8081
+    _head_pg: Optional[PlacementGroup] = None
+    _slice_pgs: List[PlacementGroup] = field(default_factory=list)
+    _slice_names: List[Optional[str]] = field(default_factory=list)
+    _coordinator: str = ""
+
+    def reserve(self) -> "SlicePlacementGroup":
+        head_resource = f"TPU-{self.pod_type}-head"
+        self._head_pg = placement_group(
+            [{head_resource: 1.0} for _ in range(self.num_slices)],
+            strategy="STRICT_SPREAD" if self.num_slices > 1 else "PACK",
+            name=f"slice-head:{self.pod_type}",
+        )
+        return self
+
+    def ready(self, timeout: float = 120.0) -> bool:
+        if self._head_pg is None or not self._head_pg.ready(timeout):
+            return False
+        if not self._slice_pgs:
+            self._create_slice_pgs()
+        return all(pg.ready(timeout) for pg in self._slice_pgs)
+
+    def _create_slice_pgs(self):
+        """Stage 2: pin per-host gangs to the claimed slices via node labels."""
+        import ray_tpu
+
+        placements = self._head_pg.bundle_placements()
+        node_info = {n["node_id"]: n for n in ray_tpu.nodes()}
+        bundles = [
+            {"TPU": float(self.chips_per_host)}
+            for _ in range(self.hosts_per_slice)
+        ]
+        for slice_idx in range(self.num_slices):
+            head_node = node_info.get(placements.get(slice_idx, ""), {})
+            labels = head_node.get("labels", {})
+            slice_name = labels.get("tpu-slice-name")
+            self._slice_names.append(slice_name)
+            if slice_idx == 0 and head_node:
+                host = head_node.get("address", "").rsplit(":", 1)[0]
+                self._coordinator = f"{host}:{self.megascale_port}"
+            selector = {"tpu-slice-name": slice_name} if slice_name else None
+            self._slice_pgs.append(placement_group(
+                bundles,
+                strategy="STRICT_SPREAD" if self.hosts_per_slice > 1 else "PACK",
+                name=f"slice:{self.pod_type}:{slice_idx}",
+                bundle_label_selector=selector,
+            ))
+
+    @property
+    def placement_group(self) -> PlacementGroup:
+        """The slice-0 gang PG (after ready())."""
+        return self._slice_pgs[0] if self._slice_pgs else self._head_pg
+
+    def remove(self):
+        from ray_tpu.util.placement_group import remove_placement_group
+
+        for pg in [*self._slice_pgs, self._head_pg]:
+            if pg is not None:
+                remove_placement_group(pg)
+
+    def dispatch(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Run `fn` once per host of every slice (reference: tpu.py:849).
+
+        Returns one ObjectRef per host, slice-major. For multi-slice
+        reservations the MEGASCALE_* cross-slice env rides each task's
+        runtime_env (coordinator = slice 0's head host).
+        """
+        import ray_tpu
+
+        if not self.ready():
+            raise RuntimeError("slice placement group is not ready")
+        remote_fn = ray_tpu.remote(fn) if not hasattr(fn, "remote") else fn
+        refs = []
+        for slice_idx, pg in enumerate(self._slice_pgs):
+            env = get_tpu_coordinator_env_vars(
+                self._coordinator, self.num_slices, slice_idx,
+                self.megascale_port,
+            )
+            for host_index in range(self.hosts_per_slice):
+                refs.append(
+                    remote_fn.options(
+                        num_cpus=0,  # the bundle reserves TPU, not CPU
+                        resources={"TPU": float(self.chips_per_host)},
+                        placement_group=pg,
+                        placement_group_bundle_index=host_index,
+                        runtime_env={"env_vars": env} if env else None,
+                    ).remote(*args, **kwargs)
+                )
+        return refs
+
+
+def slice_placement_group(pod_type: str, num_slices: int = 1,
+                          chips_per_host: int = 8,
+                          hosts_per_slice: int = 1) -> SlicePlacementGroup:
+    """Reserve `num_slices` whole slices of `pod_type` (reference: tpu.py:803)."""
+    return SlicePlacementGroup(
+        pod_type=pod_type,
+        num_slices=num_slices,
+        chips_per_host=chips_per_host,
+        hosts_per_slice=hosts_per_slice,
+    ).reserve()

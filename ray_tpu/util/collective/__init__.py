@@ -1,0 +1,29 @@
+"""ray_tpu.util.collective — out-of-band collectives with an XLA/ICI backend.
+
+Reference: python/ray/util/collective/ (NCCL/GLOO backends); SURVEY §7.5
+names this registry's XLA backend the north-star deliverable.
+"""
+
+from ray_tpu.util.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+__all__ = [
+    "init_collective_group", "destroy_collective_group", "is_group_initialized",
+    "get_rank", "get_collective_group_size",
+    "allreduce", "reduce", "broadcast", "allgather", "reducescatter",
+    "send", "recv", "barrier", "Backend", "ReduceOp",
+]

@@ -1,0 +1,119 @@
+"""Collective communication API.
+
+Capability parity with the reference's surface (reference:
+python/ray/util/collective/collective.py — init_collective_group :149,
+allreduce :312, barrier :352, reduce :362, broadcast :421, allgather :468,
+reducescatter :511, send :567, recv :624, GroupManager :65), with the XLA
+backend in place of NCCL/GLOO.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ray_tpu.util.collective.types import Backend, GroupInfo, ReduceOp
+from ray_tpu.util.collective.xla_group import XlaCollectiveGroup
+
+
+class GroupManager:
+    """Process-local registry of collective groups (reference: :65)."""
+
+    def __init__(self):
+        self._groups: Dict[str, XlaCollectiveGroup] = {}
+        self._lock = threading.Lock()
+
+    def create(self, world_size: int, rank: int, backend: str,
+               group_name: str) -> XlaCollectiveGroup:
+        Backend.validate(backend)
+        with self._lock:
+            if group_name in self._groups:
+                raise ValueError(f"collective group {group_name!r} already exists")
+        group = XlaCollectiveGroup(world_size, rank, group_name)
+        with self._lock:
+            self._groups[group_name] = group
+        return group
+
+    def get(self, group_name: str) -> XlaCollectiveGroup:
+        with self._lock:
+            group = self._groups.get(group_name)
+        if group is None:
+            raise ValueError(
+                f"collective group {group_name!r} is not initialized in this "
+                f"process; call init_collective_group first"
+            )
+        return group
+
+    def destroy(self, group_name: str):
+        with self._lock:
+            group = self._groups.pop(group_name, None)
+        if group is not None:
+            group.destroy()
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = Backend.XLA,
+                          group_name: str = "default") -> None:
+    """Initialize this process's membership in a collective group.
+
+    Must be called by every member (typically inside each actor). Rank 0
+    publishes the jax.distributed coordinator through the control store.
+    """
+    _manager.create(world_size, rank, backend, group_name)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _manager.destroy(group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    try:
+        _manager.get(group_name)
+        return True
+    except ValueError:
+        return False
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get(group_name).world_size
+
+
+def allreduce(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
+    return _manager.get(group_name).allreduce(tensor, op)
+
+
+def reduce(tensor, dst_rank: int = 0, op: str = ReduceOp.SUM,
+           group_name: str = "default"):
+    return _manager.get(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _manager.get(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _manager.get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
+    return _manager.get(group_name).reducescatter(tensor, op)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    _manager.get(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
+    return _manager.get(group_name).recv(src_rank, timeout)
+
+
+def barrier(group_name: str = "default"):
+    _manager.get(group_name).barrier()

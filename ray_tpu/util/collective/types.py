@@ -1,0 +1,38 @@
+"""Collective types (reference: python/ray/util/collective/types.py:34 —
+Backend enum NCCL/GLOO; here the native backend is XLA over ICI/gloo)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class Backend:
+    XLA = "xla"        # jax.distributed + XLA collectives (ICI on TPU, gloo on CPU)
+    GLOO = "gloo"      # alias: the XLA backend over CPU devices uses gloo
+    NCCL = "nccl"      # not available in a TPU-native build
+
+    @staticmethod
+    def validate(name: str) -> str:
+        name = name.lower()
+        if name in (Backend.XLA, Backend.GLOO):
+            return Backend.XLA
+        if name == Backend.NCCL:
+            raise ValueError(
+                "NCCL is not available in the TPU-native build; use backend='xla'"
+            )
+        raise ValueError(f"unknown collective backend {name!r}")
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MAX = "max"
+    MIN = "min"
+
+
+@dataclass
+class GroupInfo:
+    group_name: str
+    world_size: int
+    rank: int
+    backend: str

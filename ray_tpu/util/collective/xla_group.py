@@ -1,0 +1,281 @@
+"""XLA collective group — the TPU-native replacement for the NCCL backend.
+
+Capability parity with the reference's NCCL collective group
+(reference: python/ray/util/collective/collective_group/nccl_collective_group.py,
+850 LoC over cupy.nccl with unique-id exchange through a named actor), rebuilt
+the XLA way (SURVEY §5 "Distributed communication backend"):
+
+- Bootstrap: `jax.distributed.initialize` against a coordinator address
+  exchanged through the control store KV (replacing the NCCLUniqueID actor).
+- Data plane: ops run as jitted global-SPMD computations over a 1-axis device
+  mesh — on TPU the allreduce/allgather/reducescatter ride ICI; on CPU
+  multi-process, jax's gloo cpu collectives carry them (test parity with the
+  reference's GLOO backend).
+- P2P send/recv ride the framework's RPC host plane out-of-band (matching the
+  reference's semantics where only the two endpoints participate).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ray_tpu.util.collective.types import GroupInfo, ReduceOp
+
+logger = logging.getLogger(__name__)
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda a: a.sum(axis=0),
+    ReduceOp.PRODUCT: lambda a: a.prod(axis=0),
+    ReduceOp.MAX: lambda a: a.max(axis=0),
+    ReduceOp.MIN: lambda a: a.min(axis=0),
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class XlaCollectiveGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        import jax
+
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._p2p_queues: dict = {}
+        self._jit_cache: dict = {}
+
+        # NOTE: anything that touches devices (jax.process_count, jax.devices)
+        # initializes the XLA backend and makes distributed-init impossible —
+        # so query the distributed client state directly.
+        from jax._src import distributed as _jdist
+
+        already = getattr(_jdist.global_state, "client", None) is not None
+        self._owns_distributed = world_size > 1 and not already
+        if self._owns_distributed:
+            coordinator = self._rendezvous()
+            try:
+                # gloo carries CPU collectives; harmless ahead of TPU init
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # noqa: BLE001 — renamed/absent config
+                pass
+            jax.distributed.initialize(
+                coordinator, num_processes=world_size, process_id=rank
+            )
+        self.mesh = self._build_mesh()
+        self._register_p2p()
+
+    # ------------------------------------------------------------------
+    # bootstrap
+    # ------------------------------------------------------------------
+
+    def _kv(self):
+        """KV access through the process's core worker (None outside a cluster)."""
+        try:
+            from ray_tpu._private.core_worker import get_core_worker
+
+            return get_core_worker()
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _kv_put(self, key: str, value: bytes):
+        cw = self._kv()
+        if cw is None:
+            raise RuntimeError(
+                "collective rendezvous needs a ray_tpu cluster (or set "
+                "RT_COLLECTIVE_COORD)"
+            )
+        cw.run_sync(cw.control.call(
+            "kv_put", {"ns": "collective", "key": key.encode(), "value": value}
+        ))
+
+    def _kv_get(self, key: str, timeout: float = 60.0) -> bytes:
+        cw = self._kv()
+        if cw is None:
+            raise RuntimeError(
+                "collective rendezvous needs a ray_tpu cluster (or set "
+                "RT_COLLECTIVE_COORD)"
+            )
+        deadline = time.monotonic() + timeout
+        while True:
+            reply = cw.run_sync(cw.control.call(
+                "kv_get", {"ns": "collective", "key": key.encode()}
+            ))
+            if reply["value"] is not None:
+                return reply["value"]
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"rendezvous key {key} never appeared")
+            time.sleep(0.05)
+
+    def _rendezvous(self) -> str:
+        import os
+
+        env = os.environ.get("RT_COLLECTIVE_COORD")
+        if env:
+            return env
+        key = f"{self.group_name}:coordinator"
+        if self.rank == 0:
+            host = socket.gethostbyname(socket.gethostname())
+            coord = f"{host}:{_free_port()}"
+            self._kv_put(key, coord.encode())
+            return coord
+        return self._kv_get(key).decode()
+
+    def _build_mesh(self):
+        """One mesh coordinate per PROCESS (rank), regardless of how many
+        local devices each process exposes."""
+        import jax
+        from jax.sharding import Mesh
+
+        per_process = {}
+        for d in jax.devices():
+            cur = per_process.get(d.process_index)
+            if cur is None or d.id < cur.id:
+                per_process[d.process_index] = d
+        devices = np.array([per_process[p] for p in sorted(per_process)])
+        self._local_device = per_process[jax.process_index()]
+        return Mesh(devices, ("ranks",))
+
+    def _register_p2p(self):
+        """Register this member's RPC address for out-of-band send/recv."""
+        cw = self._kv()
+        if cw is None:
+            return
+        self._kv_put(f"{self.group_name}:member:{self.rank}", cw.address.encode())
+        cw.server.register(
+            f"collective_p2p:{self.group_name}", self._handle_p2p
+        )
+
+    async def _handle_p2p(self, conn_id, payload):
+        q = self._p2p_queues.setdefault(payload["src"], asyncio.Queue())
+        await q.put((payload["data"], payload["shape"], payload["dtype"]))
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # collectives (jitted SPMD over the ranks axis)
+    # ------------------------------------------------------------------
+
+    def _global_stack(self, x):
+        """Local array → global (world, ...) array sharded over ranks."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = np.asarray(x)
+        local = jax.device_put(x[None], self._local_device)
+        return jax.make_array_from_single_device_arrays(
+            (self.world_size, *x.shape),
+            NamedSharding(self.mesh, P("ranks")),
+            [local],
+        )
+
+    def _run_replicated(self, key, fn, garr):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        jitted = self._jit_cache.get(key)
+        if jitted is None:
+            jitted = jax.jit(
+                fn, out_shardings=NamedSharding(self.mesh, P())
+            )
+            self._jit_cache[key] = jitted
+        out = jitted(garr)
+        return np.asarray(out)
+
+    def allreduce(self, x, op: str = ReduceOp.SUM):
+        if self.world_size == 1:
+            return np.asarray(x)
+        reducer = _REDUCERS[op]
+        garr = self._global_stack(x)
+        return self._run_replicated(
+            ("allreduce", op, garr.shape, str(garr.dtype)), reducer, garr
+        )
+
+    def reduce(self, x, dst_rank: int = 0, op: str = ReduceOp.SUM):
+        out = self.allreduce(x, op)
+        return out if self.rank == dst_rank else np.asarray(x)
+
+    def broadcast(self, x, src_rank: int = 0):
+        if self.world_size == 1:
+            return np.asarray(x)
+        garr = self._global_stack(x)
+        return self._run_replicated(
+            ("broadcast", src_rank, garr.shape, str(garr.dtype)),
+            lambda a: a[src_rank], garr,
+        )
+
+    def allgather(self, x):
+        if self.world_size == 1:
+            return np.asarray(x)[None]
+        garr = self._global_stack(x)
+        return self._run_replicated(
+            ("allgather", garr.shape, str(garr.dtype)), lambda a: a, garr
+        )
+
+    def reducescatter(self, x, op: str = ReduceOp.SUM):
+        """x: local (world, chunk...) contribution → this rank's reduced chunk."""
+        x = np.asarray(x)
+        if x.shape[0] != self.world_size:
+            raise ValueError(
+                f"reducescatter input leading dim must be world_size "
+                f"{self.world_size}, got {x.shape}"
+            )
+        if self.world_size == 1:
+            return x[0]
+        reduced = self.allreduce(x, op)
+        return reduced[self.rank]
+
+    def barrier(self):
+        self.allreduce(np.ones((1,), np.float32))
+
+    # ------------------------------------------------------------------
+    # p2p over the RPC host plane
+    # ------------------------------------------------------------------
+
+    def send(self, x, dst_rank: int):
+        cw = self._kv()
+        addr = self._kv_get(f"{self.group_name}:member:{dst_rank}").decode()
+        x = np.ascontiguousarray(x)
+
+        async def _send():
+            client = await cw._owner_client(addr)
+            await client.call(f"collective_p2p:{self.group_name}", {
+                "src": self.rank,
+                "data": x.tobytes(),
+                "shape": list(x.shape),
+                "dtype": str(x.dtype),
+            })
+
+        cw.run_sync(_send())
+
+    def recv(self, src_rank: int, timeout: float = 60.0):
+        cw = self._kv()
+
+        async def _recv():
+            q = self._p2p_queues.setdefault(src_rank, asyncio.Queue())
+            return await asyncio.wait_for(q.get(), timeout)
+
+        data, shape, dtype = cw.run_sync(_recv(), timeout + 5)
+        return np.frombuffer(data, dtype=np.dtype(dtype)).reshape(shape)
+
+    def destroy(self):
+        import jax
+
+        # only the group that initialized the process-global distributed
+        # runtime may tear it down — other live groups share it
+        if self._owns_distributed:
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            self._owns_distributed = False
+
+    def info(self) -> GroupInfo:
+        return GroupInfo(self.group_name, self.world_size, self.rank, "xla")

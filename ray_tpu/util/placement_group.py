@@ -72,7 +72,11 @@ def placement_group(
     bundles: List[Dict[str, float]],
     strategy: str = PG_PACK,
     name: str = "",
+    bundle_label_selector: Optional[Dict[str, str]] = None,
 ) -> PlacementGroup:
+    """Gang-reserve resource bundles. `bundle_label_selector` restricts all
+    bundles to nodes whose labels match (reference: label_selector scheduling,
+    src/ray/common/scheduling/label_selector.h:73)."""
     cw = get_core_worker()
     pg_id = PlacementGroupID.from_random()
     wire_bundles = [
@@ -87,6 +91,7 @@ def placement_group(
                 "bundles": wire_bundles,
                 "strategy": strategy,
                 "name": name,
+                "labels": bundle_label_selector or {},
             },
         )
     )

@@ -6,13 +6,22 @@ python/ray/autoscaler/_private/fake_multi_node/node_provider.py).
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# The machine env pins JAX_PLATFORMS to the real TPU ("axon") and a
+# sitecustomize imports jax at interpreter start, so jax has already
+# snapshotted the env — os.environ edits alone are too late. Use
+# jax.config.update (allowed until the backend is first used). Tests run on a
+# virtual 8-device CPU mesh; set RT_TEST_TPU=1 to run on the real chip.
+if not os.environ.get("RT_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

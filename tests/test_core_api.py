@@ -277,8 +277,16 @@ def test_actor_restart(ray_init):
     p = Phoenix.options(max_restarts=1, max_task_retries=0).remote()
     pid1 = ray_tpu.get(p.pid.remote(), timeout=60)
     p.die.remote()
-    time.sleep(0.5)
-    pid2 = ray_tpu.get(p.pid.remote(), timeout=90)
+    # With max_task_retries=0 a call racing the death report fails with
+    # ActorUnavailableError (reference semantics) — retry at the app level.
+    deadline = time.time() + 90
+    while True:
+        try:
+            pid2 = ray_tpu.get(p.pid.remote(), timeout=90)
+            break
+        except ray_tpu.ActorUnavailableError:
+            assert time.time() < deadline, "actor never came back"
+            time.sleep(0.5)
     assert pid2 != pid1
 
 
