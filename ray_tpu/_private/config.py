@@ -125,6 +125,7 @@ _flag("scheduler_spread_threshold", 0.5, "Hybrid policy: pack below this utiliza
 _flag("log_to_driver", True, "Forward worker stdout/stderr to the driver.")
 _flag("actor_creation_timeout_s", 120.0, "Control store waits this long for a daemon to lease+create an actor.")
 _flag("placement_group_timeout_s", 60.0, "Placement group scheduling deadline before marked unschedulable.")
+_flag("actor_ordering_gap_timeout_s", 60.0, "Ordered actor task fails (never reorders) after waiting this long for a missing predecessor sequence number.")
 
 # --- chaos / fault injection (day 1, per SURVEY §4) ---
 _flag("testing_event_loop_delay_us", "", "Inject delays into event-loop handlers. Format: 'method:min_us:max_us,...' ('*' matches all). Mirrors RAY_testing_asio_delay_us.")

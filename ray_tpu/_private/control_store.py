@@ -5,9 +5,7 @@ src/ray/gcs/gcs_server.h:99, wiring gcs_server.cc:260-341): one process per
 cluster holding the authoritative tables for nodes, jobs, actors, placement
 groups, KV, and task events, plus pub/sub fan-out and node health checking
 (reference: src/ray/gcs/gcs_health_check_manager.h). Redesigned on the asyncio
-msgpack RPC transport (runtime/rpc.py) instead of 13 gRPC services; persistence
-is a pluggable store client (in-memory or file-backed snapshot, reference:
-src/ray/gcs/store_client/).
+msgpack RPC transport (runtime/rpc.py) instead of 13 gRPC services.
 
 Actor lifecycle mirrors GcsActorManager/GcsActorScheduler
 (src/ray/gcs/actor/gcs_actor_manager.h:94, gcs_actor_scheduler.h:104): actors
@@ -209,6 +207,23 @@ class ControlStore:
         for rec in list(self.actors.values()):
             if rec.node_id == node_id and rec.state in (pb.ACTOR_ALIVE, pb.ACTOR_PENDING):
                 await self._on_actor_worker_death(rec, f"node died: {reason}")
+        # Reschedule placement groups with bundles on the dead node: return
+        # surviving bundles, reset to PENDING, and re-run placement
+        # (reference: gcs_placement_group_manager.h node-death rescheduling).
+        for pg in list(self.placement_groups.values()):
+            if pg.state == pb.PG_CREATED and node_id in set(pg.placements.values()):
+                for nid in set(pg.placements.values()) - {node_id}:
+                    try:
+                        daemon = await self._daemon(nid)
+                        await daemon.call(
+                            "return_bundles", {"pg_id": pg.pg_id.binary()}, timeout=5
+                        )
+                    except Exception:  # noqa: BLE001 — node may be going too
+                        pass
+                pg.placements = {}
+                pg.state = pb.PG_PENDING
+                self.pubsub.publish("placement_groups", pg.to_wire())
+                spawn(self._schedule_pg(pg))
 
     # ------------------------------------------------------------------
     # node service (reference: gcs_service.proto NodeInfo :771)
@@ -378,6 +393,13 @@ class ControlStore:
                 if rec.state == pb.ACTOR_DEAD:
                     return
                 node_id = self._pick_node_for(rec.spec, exclude or set())
+            # Optimistically deduct from the gossiped view so a burst of
+            # concurrent creates doesn't all pick the same node and thundering-
+            # herd the daemon (reference: GCS scheduler deducts on placement);
+            # the next heartbeat restores ground truth.
+            avail = self.node_available.get(node_id)
+            if avail is not None:
+                self.node_available[node_id] = avail - rec.spec.resources
             daemon = await self._daemon(node_id)
             reply = await daemon.call(
                 "create_actor",

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 from ray_tpu._private.aio import spawn
+import functools
 import logging
 import os
 import threading
@@ -259,16 +260,28 @@ class ActorHandleState:
     """Caller-side per-actor submission state (reference:
     actor_task_submitter.h:69 — ordered sequence numbers, address cache)."""
 
-    __slots__ = ("actor_id", "seq", "address", "client", "state", "death_cause", "event")
+    __slots__ = ("actor_id", "seq", "address", "client", "state", "death_cause",
+                 "event", "creation_keepalive", "incarnation", "ever_alive")
 
     def __init__(self, actor_id: bytes):
         self.actor_id = actor_id
         self.seq = 0
+        # bumped on every ALIVE transition to a replacement worker; per-
+        # incarnation seq numbering restarts at 1 (reference: restart epoch
+        # in actor_task_submitter.h). The first ALIVE keeps incarnation 0 so
+        # tasks submitted while the actor was still PENDING stay ordered.
+        self.incarnation = 0
+        self.ever_alive = False
         self.address = ""
         self.client: Optional[RpcClient] = None
         self.state = pb.ACTOR_PENDING
         self.death_cause = ""
         self.event: Optional[asyncio.Event] = None
+        # Pins ObjectRefs for constructor args promoted to the object store:
+        # restarts re-resolve the creation args, so these live until the
+        # actor is terminally DEAD (dropping the last ref earlier would free
+        # the owned object and hang the actor's __init__).
+        self.creation_keepalive: list = []
 
 
 class CoreWorker:
@@ -493,17 +506,17 @@ class CoreWorker:
         if res is None:
             raise GetTimeoutError(f"get() timed out materializing {ref.hex()}")
         view, meta = res
-        try:
-            if meta == META_ERROR:
+        if meta == META_ERROR:
+            try:
                 raise self._deserialize_error(bytes(view))
-            # Zero-copy: buffers alias shm. The view is pinned for the life
-            # of the returned value via the keepalive in deserialize.
-            value = ser.deserialize(view, copy_buffers=False)
-            return value
-        finally:
-            # note: pin stays (store.get incremented); release when GC'd is
-            # future work — the store evicts only unpinned objects.
-            pass
+            finally:
+                self.store.release(oid)
+        # Zero-copy: buffers alias shm; the store pin is released when the
+        # last array aliasing the segment is GC'd (ser._Pin finalizer).
+        return ser.deserialize(
+            view, copy_buffers=False,
+            release=functools.partial(self.store.release, oid),
+        )
 
     def _materialize(self, data: bytes, meta: int, copy_buffers: bool) -> Any:
         if meta == META_ERROR:
@@ -828,12 +841,20 @@ class CoreWorker:
                     st.client = None
                     self.schedule(old.close())
                 st.address = message["worker_address"]
+                if st.ever_alive:
+                    # replacement worker process = fresh incarnation: its
+                    # executor expects seq to restart at 1
+                    st.incarnation += 1
+                    st.seq = 0
+            st.ever_alive = True
         elif st.state in (pb.ACTOR_RESTARTING, pb.ACTOR_DEAD):
             st.address = ""
             if st.client is not None:
                 old = st.client
                 st.client = None
                 self.schedule(old.close())
+            if st.state == pb.ACTOR_DEAD:
+                st.creation_keepalive = []
         if st.event is not None:
             st.event.set()
 
@@ -864,8 +885,7 @@ class CoreWorker:
             self._actor_index += 1
             actor_id = ActorID.of(self.job_id, self.current_task_id, self._actor_index)
         wire_args = await self.serialize_args(args, kwargs)
-        for a in wire_args:
-            a.pop("_pyref", None)
+        pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
         spec = TaskSpec(
             task_id=TaskID.for_actor_creation(actor_id),
             job_id=self.job_id,
@@ -884,7 +904,7 @@ class CoreWorker:
             runtime_env={"namespace": namespace, "detached": detached},
             name=name,
         )
-        self._actor_state(actor_id.binary())
+        self._actor_state(actor_id.binary()).creation_keepalive = pyrefs
         await self.control.call("register_actor", {"spec": spec.to_wire()})
         return actor_id
 
@@ -930,6 +950,7 @@ class CoreWorker:
             owner_address=self.address,
             actor_id=ActorID(actor_id),
             seq_no=st.seq,
+            incarnation=st.incarnation,
             name=method_name,
         )
         refs = [
@@ -949,6 +970,13 @@ class CoreWorker:
         while True:
             try:
                 await self.wait_actor_alive(st.actor_id)
+                if spec.incarnation != st.incarnation:
+                    # the actor restarted since this spec was stamped: its
+                    # fresh executor numbers from 1, so re-stamp into the
+                    # current incarnation's sequence (order across a crash is
+                    # best-effort, as in the reference's restart epoch)
+                    spec.incarnation = st.incarnation
+                    spec.seq_no = self._next_seq(st)
                 if st.client is None:
                     st.client = RpcClient(st.address, name="to-actor", retries=0)
                     await st.client.connect()
@@ -1034,8 +1062,14 @@ class CoreWorker:
             if res is not None:
                 view, meta = res
                 if meta == META_ERROR:
-                    raise self._deserialize_error(bytes(view))
-                return ser.deserialize(view, copy_buffers=False)
+                    try:
+                        raise self._deserialize_error(bytes(view))
+                    finally:
+                        self.store.release(ref.object_id())
+                return ser.deserialize(
+                    view, copy_buffers=False,
+                    release=functools.partial(self.store.release, ref.object_id()),
+                )
         reply = await self._call_owner(ref, "get_object", {"object_id": ref.binary()})
         if reply.get("error"):
             raise ObjectLostError(ref.hex(), reply["error"])

@@ -50,7 +50,7 @@ class WorkerHandle:
     __slots__ = (
         "worker_id", "proc", "state", "address", "pid", "job_id",
         "client", "lease_id", "actor_id", "ready_event", "idle_since",
-        "actor_resources",
+        "actor_resources", "tpu_chips", "reserved",
     )
 
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen, job_id: bytes):
@@ -66,6 +66,13 @@ class WorkerHandle:
         self.ready_event = asyncio.Event()
         self.idle_since = time.monotonic()
         self.actor_resources: Optional[ResourceSet] = None
+        # chip ids this worker's TPU_VISIBLE_CHIPS was baked with at spawn
+        # (visibility is per-process: it cannot change after libtpu init)
+        self.tpu_chips: Optional[Tuple[int, ...]] = None
+        # spawned for a specific waiting grantee: worker_ready must NOT
+        # publish it to the idle pool (a concurrent _get_idle_worker could
+        # lease it out from under the spawner)
+        self.reserved = False
 
 
 class PendingLease:
@@ -114,6 +121,11 @@ class NodeDaemon:
                 self.labels.update(tpu_labels)
         self.total_resources = ResourceSet(res)
         self.available = ResourceSet(res)
+        # Free TPU chip ids (reference: tpu.py:42-55 visibility semantics —
+        # each granted lease/actor with {"TPU": n} takes n specific chips and
+        # the worker is spawned with TPU_VISIBLE_CHIPS restricted to them).
+        self._tpu_free_chips: List[int] = list(range(int(res.get("TPU", 0))))
+        self._tpu_chips_per_host = len(self._tpu_free_chips)
         self.store_name = store_name or f"rt_{self.node_id.hex()[:12]}"
         self.store: Optional[ShmObjectStore] = None
         self.server = RpcServer(name=f"daemon-{self.node_id.hex()[:6]}")
@@ -162,7 +174,7 @@ class NodeDaemon:
         self._tasks.append(spawn(self._heartbeat_loop()))
         self._tasks.append(spawn(self._reap_loop()))
         for _ in range(GLOBAL_CONFIG.get("worker_pool_prestart")):
-            spawn(self._spawn_worker(job_id=b""))
+            spawn(self._spawn_worker(job_id=b"", reserve=False))
         logger.info(
             "daemon %s up at %s store=%s resources=%s",
             self.node_id.hex()[:8], addr, self.store_name, self.total_resources.to_dict(),
@@ -233,7 +245,9 @@ class NodeDaemon:
     # worker pool (reference: worker_pool.h:284)
     # ------------------------------------------------------------------
 
-    async def _spawn_worker(self, job_id: bytes) -> WorkerHandle:
+    async def _spawn_worker(self, job_id: bytes,
+                            tpu_chips: Optional[List[int]] = None,
+                            reserve: bool = True) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         log_base = os.path.join(
             self.session_dir, "logs", f"worker-{worker_id.hex()[:12]}"
@@ -249,15 +263,31 @@ class NodeDaemon:
             RT_SESSION_DIR=self.session_dir,
             RT_CONFIG_JSON=GLOBAL_CONFIG.serialize_overrides(),
         )
-        out = open(log_base + ".out", "ab")
-        err = open(log_base + ".err", "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.default_worker"],
-            env=env, stdout=out, stderr=err, start_new_session=True,
-        )
-        out.close()
-        err.close()
+        if tpu_chips:
+            from ray_tpu.tpu.accelerator import TpuAcceleratorManager
+
+            TpuAcceleratorManager.set_visible_chips_env(
+                env, list(tpu_chips), self._tpu_chips_per_host
+            )
+        try:
+            out = open(log_base + ".out", "ab")
+            err = open(log_base + ".err", "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.default_worker"],
+                env=env, stdout=out, stderr=err, start_new_session=True,
+            )
+            out.close()
+            err.close()
+        except Exception:
+            if tpu_chips:
+                self._return_chips(tpu_chips)
+            raise
         handle = WorkerHandle(worker_id, proc, job_id)
+        handle.reserved = reserve
+        if tpu_chips:
+            # from here on the chips travel with the handle; _forget_worker
+            # returns them to the pool exactly once
+            handle.tpu_chips = tuple(tpu_chips)
         self.workers[worker_id.binary()] = handle
         try:
             await asyncio.wait_for(
@@ -278,7 +308,8 @@ class NodeDaemon:
             return {"ok": False, "error": "unknown worker"}
         w.address = payload["address"]
         w.state = W_IDLE
-        self.idle_by_job.setdefault(w.job_id, []).append(w.worker_id.binary())
+        if not w.reserved:
+            self.idle_by_job.setdefault(w.job_id, []).append(w.worker_id.binary())
         w.ready_event.set()
         return {"ok": True}
 
@@ -298,6 +329,24 @@ class NodeDaemon:
         idle = self.idle_by_job.get(w.job_id, [])
         if w.worker_id.binary() in idle:
             idle.remove(w.worker_id.binary())
+        if w.tpu_chips:
+            self._return_chips(w.tpu_chips)
+            w.tpu_chips = None
+
+    def _alloc_chips(self, n: int) -> List[int]:
+        if len(self._tpu_free_chips) < n:
+            raise RuntimeError(
+                f"TPU chip accounting out of sync: need {n}, "
+                f"free {self._tpu_free_chips}"
+            )
+        chips, self._tpu_free_chips = (
+            self._tpu_free_chips[:n], self._tpu_free_chips[n:]
+        )
+        return chips
+
+    def _return_chips(self, chips) -> None:
+        self._tpu_free_chips.extend(chips)
+        self._tpu_free_chips.sort()
 
     async def _on_worker_death(self, w: WorkerHandle):
         prev_state = w.state
@@ -335,10 +384,7 @@ class NodeDaemon:
             if w is not None and w.state == W_IDLE and w.proc.poll() is None:
                 w.job_id = job_id
                 return w
-        w = await self._spawn_worker(job_id)
-        # worker_ready put it in the idle list; it is being handed out now
-        self._drop_from_idle(w)
-        return w
+        return await self._spawn_worker(job_id)
 
     def _drop_from_idle(self, w: WorkerHandle):
         idle = self.idle_by_job.get(w.job_id, [])
@@ -357,6 +403,10 @@ class NodeDaemon:
         logger.debug("request_lease res=%s hops=%s", spec_res.to_dict(), hops)
 
         if strategy.kind == pb.STRATEGY_PLACEMENT_GROUP:
+            if self._draining:
+                # DrainRaylet rejects all new leases; the caller retries until
+                # the node dies and the control store reschedules the PG
+                return {"retry": True, "draining": True}
             return await self._grant_pg_lease(spec_res, strategy, job_id)
 
         # Cluster policy: pick the best node; spill if it isn't us.
@@ -370,8 +420,20 @@ class NodeDaemon:
                 peer = self.peer_nodes.get(choice)
                 if peer is not None:
                     return {"spillback": peer.address, "node_id": choice}
+            # Hard node affinity to a node we can't reach (unknown peer, dead,
+            # or hop cap) must fail, not silently run on the wrong node
+            # (reference: node_affinity_scheduling_policy.h — hard affinity to
+            # an unavailable node is infeasible).
+            if strategy.kind == pb.STRATEGY_NODE_AFFINITY and not strategy.soft:
+                return {"infeasible": True,
+                        "error": f"node {choice} not available for hard affinity"}
         if choice is None and not self._feasible_anywhere(spec_res):
             return {"infeasible": True}
+        if self._draining:
+            # Never grant locally while draining; the caller retries until the
+            # drain finishes or another node has capacity (reference:
+            # DrainRaylet rejects new leases during drain).
+            return {"retry": True, "draining": True}
         # Local grant path: queue until available.
         pending = PendingLease(spec_res, strategy, job_id, hops)
         self.pending.append(pending)
@@ -443,10 +505,21 @@ class NodeDaemon:
 
     async def _grant(self, p: PendingLease, pg_id: Optional[bytes],
                      bundle_index: int = -1):
+        n_tpu = int(p.spec_resources.get("TPU"))
         try:
-            w = await self._get_idle_worker(p.job_id)
+            if n_tpu > 0:
+                # TPU visibility is baked into the worker env at spawn, so a
+                # chip-holding lease always gets a fresh worker bound to its
+                # granted chip ids (reference: tpu.py:42-55; workers holding
+                # devices are gang-bound, not pooled)
+                w = await self._spawn_worker(
+                    p.job_id, tpu_chips=self._alloc_chips(n_tpu)
+                )
+            else:
+                w = await self._get_idle_worker(p.job_id)
         except Exception as e:  # noqa: BLE001
-            self.available = self.available + p.spec_resources
+            if pg_id is None:
+                self.available = self.available + p.spec_resources
             if not p.future.done():
                 p.future.set_result({"error": f"worker spawn failed: {e}"})
             return
@@ -502,10 +575,17 @@ class NodeDaemon:
             self.available = self.available + res
         w = self.workers.get(worker_id)
         if w is not None and w.state == W_LEASED:
-            w.state = W_IDLE
-            w.lease_id = None
-            w.idle_since = time.monotonic()
-            self.idle_by_job.setdefault(w.job_id, []).append(worker_id)
+            if w.tpu_chips:
+                # visibility can't be re-narrowed in a live process; retire the
+                # worker and return its chips to the pool
+                w.lease_id = None
+                self._kill_worker_proc(w, "TPU lease returned")
+            else:
+                w.state = W_IDLE
+                w.lease_id = None
+                w.reserved = False
+                w.idle_since = time.monotonic()
+                self.idle_by_job.setdefault(w.job_id, []).append(worker_id)
         self._try_schedule()
 
     async def rpc_return_lease(self, conn_id: int, payload: dict) -> dict:
@@ -540,8 +620,12 @@ class NodeDaemon:
         if not spec.resources.is_subset_of(self.available):
             return {"ok": False, "error": "insufficient resources"}
         self.available = self.available - spec.resources
+        n_tpu = int(spec.resources.get("TPU"))
         try:
-            w = await self._spawn_worker(spec.job_id.binary())
+            w = await self._spawn_worker(
+                spec.job_id.binary(),
+                tpu_chips=self._alloc_chips(n_tpu) if n_tpu > 0 else None,
+            )
         except Exception as e:  # noqa: BLE001
             self.available = self.available + spec.resources
             return {"ok": False, "error": f"worker spawn failed: {e}"}
@@ -608,6 +692,16 @@ class NodeDaemon:
     async def rpc_return_bundles(self, conn_id: int, payload: dict) -> dict:
         pg = self.pg_prepared.pop(payload["pg_id"], None)
         if pg is not None:
+            # Workers still leased from these bundles run in resources that
+            # are being handed back — kill them before crediting, or the node
+            # oversubscribes (their _release_lease path credits nothing once
+            # the pg entry is popped).
+            for lease_id, (wid, _res, l_pg, _b) in list(self.leases.items()):
+                if l_pg == payload["pg_id"]:
+                    self.leases.pop(lease_id, None)
+                    w = self.workers.get(wid)
+                    if w is not None:
+                        self._kill_worker_proc(w, "placement group returned")
             freed = ResourceSet()
             for res in pg["bundles"].values():
                 freed = freed + res

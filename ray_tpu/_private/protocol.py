@@ -180,6 +180,11 @@ class TaskSpec:
     # actor fields
     actor_id: Optional[ActorID] = None
     seq_no: int = -1  # actor-task ordering
+    # caller-observed actor incarnation: seq_no ordering holds within one
+    # incarnation; retries carrying an older incarnation than the executor has
+    # seen run unordered (order across a crash is unknowable — reference:
+    # actor_task_submitter.h restart epoch semantics)
+    incarnation: int = 0
     max_restarts: int = 0
     max_task_retries: int = 0
     max_concurrency: int = 1
@@ -209,6 +214,7 @@ class TaskSpec:
             "owner_address": self.owner_address,
             "actor_id": self.actor_id.binary() if self.actor_id else b"",
             "seq_no": self.seq_no,
+            "incarnation": self.incarnation,
             "max_restarts": self.max_restarts,
             "max_task_retries": self.max_task_retries,
             "max_concurrency": self.max_concurrency,
@@ -235,6 +241,7 @@ class TaskSpec:
             owner_address=w["owner_address"],
             actor_id=ActorID(w["actor_id"]) if w["actor_id"] else None,
             seq_no=w["seq_no"],
+            incarnation=w.get("incarnation", 0),
             max_restarts=w.get("max_restarts", 0),
             max_task_retries=w.get("max_task_retries", 0),
             max_concurrency=w.get("max_concurrency", 1),

@@ -102,18 +102,61 @@ def note_contained_ref(ref) -> None:
         lst.append(ref)
 
 
-def deserialize(data, copy_buffers: bool = False) -> Any:
+class _Pin:
+    """Calls `release` exactly once when the last referrer drops.
+
+    Shared by every out-of-band buffer of one deserialized value: once all
+    arrays aliasing the shm segment are GC'd, the store pin is released and
+    the object becomes evictable again (reference: plasma/client.h Release
+    protocol — pin lifetime == buffer lifetime).
+    """
+
+    __slots__ = ("_release",)
+
+    def __init__(self, release):
+        self._release = release
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class _PinnedBuffer:
+    """Buffer-protocol exporter (PEP 688) holding a _Pin alive.
+
+    numpy keeps the exporter object as the array base, so the pin lives as
+    long as any array view over this buffer does.
+    """
+
+    __slots__ = ("_mv", "_pin")
+
+    def __init__(self, mv, pin):
+        self._mv = mv
+        self._pin = pin
+
+    def __buffer__(self, flags):
+        return memoryview(self._mv)
+
+
+def deserialize(data, copy_buffers: bool = False, release=None) -> Any:
     """Deserialize from bytes/memoryview produced by SerializedObject.
 
     When `data` is a memoryview over shared memory and copy_buffers is False,
     numpy arrays in the value alias the shm segment (zero-copy reads), exactly
     like the reference's plasma-backed numpy views (reference: plasma/client.h).
+
+    `release`, if given, is called once the deserialized value no longer
+    references `data` (immediately when everything was copied in-band, or when
+    the last aliasing array is GC'd otherwise).
     """
     mv = memoryview(data)
     nbuf, inband_len = _HEADER.unpack_from(mv, 0)
     off = _HEADER.size
     inband = mv[off : off + inband_len]
     off += inband_len
+    pin = _Pin(release) if (release is not None and not copy_buffers) else None
     bufs = []
     for _ in range(nbuf):
         (blen,) = _LEN.unpack_from(mv, off)
@@ -121,6 +164,14 @@ def deserialize(data, copy_buffers: bool = False) -> Any:
         b = mv[off : off + blen]
         if copy_buffers:
             b = memoryview(bytes(b))
-        bufs.append(b)
+        bufs.append(b if pin is None else _PinnedBuffer(b, pin))
         off += blen
-    return pickle.loads(inband, buffers=bufs)
+    try:
+        value = pickle.loads(inband, buffers=bufs)
+    finally:
+        # pickle copies in-band data; if no out-of-band buffer survived into
+        # the value, `pin`'s last reference drops here and release fires.
+        del bufs, pin
+    if release is not None and copy_buffers:
+        release()
+    return value

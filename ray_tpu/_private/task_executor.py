@@ -9,10 +9,14 @@ and the Python seam _raylet.pyx:2540 task_execution_handler /
 - actor creation instantiates the user class and pins it in-process;
 - sync actor tasks are executed in per-caller sequence order (reorder buffer
   keyed by (caller, seq_no), matching SequentialActorSubmitQueue semantics);
+  a missing predecessor fails the waiting task after a timeout rather than
+  ever executing out of order;
 - async actors run methods as coroutines bounded by max_concurrency;
 - threaded actors use a pool of max_concurrency threads;
 - duplicate deliveries (client retries after reconnect) are answered from a
-  bounded reply cache keyed by task id.
+  bounded reply cache keyed by task id; a retry that races the original
+  in-flight execution coalesces onto the same future instead of running the
+  method twice.
 """
 
 from __future__ import annotations
@@ -33,6 +37,13 @@ from ray_tpu.runtime.object_store import META_NORMAL
 logger = logging.getLogger(__name__)
 
 
+class _StaleSequenceError(Exception):
+    """An ordered actor task arrived with a seq below the current window and
+    no cached reply — either a duplicate whose reply cache entry expired or a
+    late delivery of a predecessor already declared lost. Executing it now
+    would reorder actor-state mutations, so it is rejected."""
+
+
 class TaskExecutor:
     def __init__(self, core_worker):
         self.cw = core_worker
@@ -40,26 +51,47 @@ class TaskExecutor:
         self.actor_instance: Any = None
         self.actor_spec = None
         self._actor_sem: Optional[asyncio.Semaphore] = None
-        # per-caller ordering for sync actors
+        # per-caller ordering for sync actors (keyed by caller; ordering holds
+        # within the newest incarnation the caller has shown us)
         self._expected_seq: Dict[bytes, int] = {}
+        self._caller_incarnation: Dict[bytes, int] = {}
         self._buffered: Dict[bytes, Dict[int, asyncio.Event]] = {}
         self._reply_cache: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._in_flight: Dict[bytes, asyncio.Future] = {}
         self._exec_lock = asyncio.Lock()
 
     # ------------------------------------------------------------------
 
     async def execute(self, spec: pb.TaskSpec) -> dict:
-        cached = self._reply_cache.get(spec.task_id.binary())
+        tid = spec.task_id.binary()
+        cached = self._reply_cache.get(tid)
         if cached is not None:
             return cached
-        if spec.kind == pb.TASK_KIND_NORMAL:
-            reply = await self._execute_normal(spec)
-        elif spec.kind == pb.TASK_KIND_ACTOR_CREATION:
-            reply = await self._execute_actor_creation(spec)
-        else:
-            reply = await self._execute_actor_task(spec)
+        # A client retry arriving while the original delivery is still
+        # executing must not run the method a second time — coalesce onto
+        # the in-flight execution's future.
+        inflight = self._in_flight.get(tid)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut = asyncio.get_running_loop().create_future()
+        self._in_flight[tid] = fut
+        try:
+            if spec.kind == pb.TASK_KIND_NORMAL:
+                reply = await self._execute_normal(spec)
+            elif spec.kind == pb.TASK_KIND_ACTOR_CREATION:
+                reply = await self._execute_actor_creation(spec)
+            else:
+                reply = await self._execute_actor_task(spec)
+            fut.set_result(reply)
+        except BaseException as e:  # noqa: BLE001 — propagate to duplicates too
+            fut.set_exception(e)
+            # an un-awaited duplicate future must not warn on GC
+            fut.exception()
+            raise
+        finally:
+            self._in_flight.pop(tid, None)
         if spec.kind == pb.TASK_KIND_ACTOR_TASK:
-            self._reply_cache[spec.task_id.binary()] = reply
+            self._reply_cache[tid] = reply
             while len(self._reply_cache) > 1024:
                 self._reply_cache.popitem(last=False)
         return reply
@@ -153,31 +185,79 @@ class TaskExecutor:
             self.actor_spec is not None and self.actor_spec.max_concurrency > 1
         )
         if not is_async and not threaded:
-            await self._wait_turn(caller, spec.seq_no)
+            try:
+                await self._wait_turn(caller, spec.seq_no, spec.incarnation)
+            except asyncio.TimeoutError as e:
+                # Never execute out of order: a hole in the sequence after the
+                # timeout means the predecessor was lost for good (caller died
+                # mid-retry); fail this task instead of corrupting actor-state
+                # ordering (reference: SequentialActorSubmitQueue never
+                # reorders). Acknowledge the hole as permanently lost so later
+                # sequence numbers from this caller regain liveness.
+                self._advance(caller, spec.seq_no, spec.incarnation)
+                return self._error_reply(spec, e)
+            except _StaleSequenceError as e:
+                return self._error_reply(spec, e)
         try:
             return await self._run_method(spec, is_async)
         finally:
             if not is_async and not threaded:
-                self._advance(caller, spec.seq_no)
+                self._advance(caller, spec.seq_no, spec.incarnation)
 
-    async def _wait_turn(self, caller: bytes, seq: int):
-        """Per-caller in-order execution (reference: sequential actor queues)."""
+    async def _wait_turn(self, caller: bytes, seq: int, incarnation: int = 0):
+        """Per-caller in-order execution (reference: sequential actor queues).
+
+        Ordering holds within the newest caller incarnation. A task from an
+        OLDER incarnation (a retry straddling an actor restart) runs
+        unordered — its predecessors may have executed in a previous worker
+        process, so there is nothing to wait for. A task from a NEWER
+        incarnation resets the sequence window and releases stale waiters.
+        """
         if seq < 0:
             return
-        expected = self._expected_seq.setdefault(caller, 1)
-        if seq <= expected:
+        cur = self._caller_incarnation.setdefault(caller, incarnation)
+        if incarnation < cur:
             return
+        if incarnation > cur:
+            self._caller_incarnation[caller] = incarnation
+            self._expected_seq[caller] = 1
+            for ev in self._buffered.get(caller, {}).values():
+                ev.set()  # stale waiters from the old incarnation
+        expected = self._expected_seq.setdefault(caller, 1)
+        if seq == expected:
+            return
+        if seq < expected:
+            # below the window with no cached reply: a predecessor already
+            # declared lost (gap timeout advanced past it) or an expired
+            # duplicate — running it now would reorder state mutations
+            raise _StaleSequenceError(
+                f"ordered actor task seq={seq} is below the current window "
+                f"(expected seq={expected}); predecessor slot already "
+                f"abandoned or reply cache expired"
+            )
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
         event = asyncio.Event()
         self._buffered.setdefault(caller, {})[seq] = event
         try:
-            await asyncio.wait_for(event.wait(), timeout=60.0)
+            await asyncio.wait_for(
+                event.wait(),
+                timeout=GLOBAL_CONFIG.get("actor_ordering_gap_timeout_s"),
+            )
         except asyncio.TimeoutError:
-            logger.warning("gave up waiting for seq %d from caller; executing", seq)
+            raise asyncio.TimeoutError(
+                f"ordered actor task seq={seq} timed out waiting for missing "
+                f"predecessor (expected seq={self._expected_seq.get(caller)})"
+            ) from None
         finally:
             self._buffered.get(caller, {}).pop(seq, None)
 
-    def _advance(self, caller: bytes, seq: int):
+    def _advance(self, caller: bytes, seq: int, incarnation: int = 0):
         if seq < 0:
+            return
+        # a finishing task from an older incarnation must not move the new
+        # incarnation's sequence window
+        if incarnation != self._caller_incarnation.get(caller, incarnation):
             return
         nxt = max(self._expected_seq.get(caller, 1), seq + 1)
         self._expected_seq[caller] = nxt

@@ -178,6 +178,15 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     ).astype(jnp.bfloat16 if x.dtype == jnp.bfloat16 else x.dtype)
 
 
+def apply_rope_bhsd(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (b, h, s, hd); cos/sin: (s, hd/2)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos, sin = cos[None, None, :, :], sin[None, None, :, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(jnp.bfloat16 if x.dtype == jnp.bfloat16 else x.dtype)
+
+
 def _attention_xla(q, k, v, causal: bool = True):
     """Plain XLA attention; fp32 softmax. q: (b, s, h, hd), k/v (b, s, kv, hd)."""
     b, sq, h, hd = q.shape
@@ -209,31 +218,57 @@ def attention(cfg: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
     return _attention_xla(q, k, v, causal=True)
 
 
-def _layer(cfg: LlamaConfig, mesh: Optional[Mesh], h, layer_params, cos, sin):
-    p = layer_params
-    hd = cfg.head_dim
-    b, s, _ = h.shape
+def _ffn(cfg: LlamaConfig, mesh: Optional[Mesh], h, p):
     dt = cfg.dtype
-
-    x = rms_norm(h, p["ln1"], cfg.norm_eps)
-    q = (x @ p["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
-    k = (x @ p["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (x @ p["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
-    attn = attention(cfg, q, k, v, mesh)
-    attn = attn.reshape(b, s, cfg.n_heads * hd) @ p["wo"].astype(dt)
-    if mesh is not None:
-        attn = constrain(attn, mesh, P(BATCH_AXES, "sp", None))
-    h = h + attn
-
     x = rms_norm(h, p["ln2"], cfg.norm_eps)
     gate = jax.nn.silu(x @ p["w1"].astype(dt))
     up = x @ p["w3"].astype(dt)
     out = (gate * up) @ p["w2"].astype(dt)
     if mesh is not None:
         out = constrain(out, mesh, P(BATCH_AXES, "sp", None))
-    return h + out
+    return out
+
+
+def _layer(cfg: LlamaConfig, mesh: Optional[Mesh], h, layer_params, cos, sin,
+           remat_ffn: bool = False):
+    p = layer_params
+    hd = cfg.head_dim
+    b, s, _ = h.shape
+    dt = cfg.dtype
+
+    x = rms_norm(h, p["ln1"], cfg.norm_eps)
+    if cfg.attention_impl == "flash":
+        # bhsd hot path: projections emit (b, h, s, hd) directly — head_dim
+        # rides the 128-lane dimension into the kernel, no transposes.
+        from ray_tpu.ops.flash_attention import flash_attention_bhsd
+
+        wq = p["wq"].astype(dt).reshape(cfg.dim, cfg.n_heads, hd)
+        wk = p["wk"].astype(dt).reshape(cfg.dim, cfg.n_kv_heads, hd)
+        wv = p["wv"].astype(dt).reshape(cfg.dim, cfg.n_kv_heads, hd)
+        q = jnp.einsum("bsd,dhk->bhsk", x, wq)
+        k = jnp.einsum("bsd,dhk->bhsk", x, wk)
+        v = jnp.einsum("bsd,dhk->bhsk", x, wv)
+        q = apply_rope_bhsd(q, cos, sin)
+        k = apply_rope_bhsd(k, cos, sin)
+        o = flash_attention_bhsd(q, k, v, causal=True)
+        wo = p["wo"].astype(dt).reshape(cfg.n_heads, hd, cfg.dim)
+        attn = jnp.einsum("bhsk,hkd->bsd", o, wo)
+    else:
+        q = (x @ p["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
+        k = (x @ p["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        v = (x @ p["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = attention(cfg, q, k, v, mesh)
+        attn = attn.reshape(b, s, cfg.n_heads * hd) @ p["wo"].astype(dt)
+    if mesh is not None:
+        attn = constrain(attn, mesh, P(BATCH_AXES, "sp", None))
+    h = h + attn
+
+    ffn = _ffn
+    if remat_ffn:
+        ffn = jax.checkpoint(_ffn, static_argnums=(0, 1))
+    return h + ffn(cfg, mesh, h, p)
 
 
 def forward(
@@ -276,12 +311,17 @@ def loss_fn(cfg, params, tokens, mesh=None):
 
 
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, learning_rate: float = 3e-4,
-                    remat: bool = False):
+                    remat=False, loss_chunk: int = 512):
     """Build (init_state, jitted train_step) sharded over `mesh`.
 
     State = (params, opt_state). Donated on update. AdamW via optax.
-    `remat=True` rematerializes each layer (HBM↔FLOPs trade, the standard
-    long-context lever — jax.checkpoint around the scanned layer body).
+    `remat` selects the HBM↔FLOPs trade per scanned layer:
+      False  — save all layer activations (fastest when memory allows; the
+               flash-attention custom VJP already avoids (s,s) residuals)
+      "ffn"  — rematerialize only the FFN block (recomputes the cheap
+               elementwise + 3 matmuls; attention residuals kept)
+      "dots" — jax.checkpoint with dots_with_no_batch_dims_saveable policy
+      True   — full per-layer rematerialization (long-context fallback)
     """
     import optax
 
@@ -293,12 +333,15 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, learning_rate: float = 3e-4,
 
     lcfg = cfg
     layer = partial(_layer, lcfg, mesh)
-    if remat:
-        # rematerialize each scanned layer: activations are recomputed in the
-        # backward pass instead of stored — the standard HBM↔FLOPs trade
+    if remat == "ffn":
+        layer = partial(_layer, lcfg, mesh, remat_ffn=True)
+    elif remat == "dots":
+        layer = jax.checkpoint(
+            layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif remat:
         layer = jax.checkpoint(layer)
 
-    def fwd(params, tokens):
+    def backbone(params, tokens):
         dt = lcfg.dtype
         h = params["tok_emb"].astype(dt)[tokens]
         h = constrain(h, mesh, P(BATCH_AXES, "sp", None))
@@ -309,17 +352,41 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, learning_rate: float = 3e-4,
             return layer(carry, lp, cos, sin), None
 
         h, _ = jax.lax.scan(body, h, params["layers"])
-        h = rms_norm(h, params["norm"], lcfg.norm_eps)
-        return (h @ params["lm_head"].astype(dt)).astype(jnp.float32)
+        return rms_norm(h, params["norm"], lcfg.norm_eps)
+
+    # The (b, s, vocab) fp32 logits (and their log_softmax) are by far the
+    # largest activations; computing the loss in sequence chunks under
+    # jax.checkpoint keeps only one chunk's logits live at a time in both
+    # directions (the chunk is recomputed from `h` in the backward pass).
+    chunk = loss_chunk
+
+    def _chunk_nll(params, h_c, tgt_c, mask_c):
+        """Masked NLL sum over one sequence chunk. tgt -1 = no target."""
+        dt = lcfg.dtype
+        logits = (h_c @ params["lm_head"].astype(dt)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tgt = jnp.maximum(tgt_c, 0)
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        return (nll * mask_c).sum()
 
     def compute_loss(params, tokens):
         # forward on the FULL sequence (keeps the input length divisible by
-        # the sp axis for sharding); the shift happens on logits
-        logits = fwd(params, tokens)
-        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-        targets = tokens[:, 1:]
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        # the sp axis for sharding); position s-1 has no target and is masked
+        # out instead of sliced off, so the chunking below divides evenly
+        h = backbone(params, tokens)
+        b, s = tokens.shape
+        targets = jnp.concatenate(
+            [tokens[:, 1:], jnp.full((b, 1), -1, tokens.dtype)], axis=1)
+        mask = (targets >= 0).astype(jnp.float32)
+        denom = mask.sum()
+        if chunk and s % chunk == 0 and s > chunk:
+            hs = h.reshape(b, s // chunk, chunk, lcfg.dim).swapaxes(0, 1)
+            ts = targets.reshape(b, s // chunk, chunk).swapaxes(0, 1)
+            ms = mask.reshape(b, s // chunk, chunk).swapaxes(0, 1)
+            nll_fn = jax.checkpoint(partial(_chunk_nll, params))
+            total = jax.lax.map(lambda htm: nll_fn(*htm), (hs, ts, ms)).sum()
+            return total / denom
+        return _chunk_nll(params, h, targets, mask) / denom
 
     def init_state(key):
         params = init_params(cfg, key)

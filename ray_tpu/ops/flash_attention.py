@@ -1,14 +1,26 @@
-"""Flash attention forward kernel in Pallas (TPU).
+"""Flash attention (forward + backward) in Pallas for TPU.
 
 Blockwise online-softmax attention that never materializes the (s, s) score
-matrix: for each query block the kernel streams key/value blocks through VMEM,
-keeping fp32 running max/denominator/accumulator in registers. Causal blocks
-after the diagonal are skipped entirely (work ∝ s²/2). On non-TPU backends
-(CPU tests) it transparently falls back to a fused XLA implementation.
+matrix in either direction:
 
-Backward currently recomputes attention under `jax.custom_vjp` with the XLA
-path — functional everywhere, with the memory win applying to inference and
-the forward pass. (A full Pallas backward kernel is the known next step.)
+* forward: for each query block the kernel streams key/value blocks through
+  VMEM, keeping fp32 running max/denominator/accumulator in registers, and
+  writes out the per-row logsumexp for the backward pass. Causal blocks after
+  the diagonal are skipped (work ∝ s²/2).
+* backward: two kernels (FlashAttention-2 style). `dq` iterates key blocks for
+  each query block; `dk/dv` iterates query blocks for each key block. Both
+  recompute p = exp(qkᵀ·scale − lse) from the saved logsumexp — no (s, s)
+  residual is ever stored, which is what lets the surrounding model train
+  without global rematerialization.
+
+Layout is (batch, heads, seq, head_dim) end-to-end ("bhsd"): head_dim rides
+the 128-wide lane dimension and no transposes are introduced around the
+kernel. A (batch, seq, heads, head_dim) wrapper is kept for callers that use
+the attention-standard layout. GQA is handled in the BlockSpec index maps
+(query heads sharing a kv head read the same k/v block).
+
+On non-TPU backends (CPU tests) everything transparently falls back to a
+fused XLA implementation with identical semantics.
 
 Reference gap: the reference has no attention kernels at all (delegated to
 vLLM/torch — SURVEY §2b); pallas_guide.md is the kernel playbook used here.
@@ -25,144 +37,364 @@ from jax import lax
 
 _INTERPRET = False  # set True to debug kernels on CPU interpreter
 
+NEG_INF = -1e30
 
-def _xla_attention(q, k, v, causal: bool):
-    b, sq, h, hd = q.shape
-    kvh = k.shape[2]
+
+# ---------------------------------------------------------------------------
+# XLA fallback (CPU tests / unsupported shapes)
+# ---------------------------------------------------------------------------
+
+
+def _xla_attention_bhsd(q, k, v, causal: bool):
+    """q: (b, h, s, hd); k/v: (b, kvh, s, hd) → (b, h, s, hd)."""
+    b, h, sq, hd = q.shape
+    kvh = k.shape[1]
     if kvh != h:
         rep = h // kvh
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     scale = 1.0 / math.sqrt(hd)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
-        sk = k.shape[1]
+        sk = k.shape[2]
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(q.dtype)
 
 
-def _flash_fwd_tpu(q, k, v, causal: bool, block_q: int, block_k: int):
-    """q: (b, s, h, hd) bf16/f32; returns same. Requires s % block_q == 0."""
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
+                block_q, block_k, seq_len):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    qb = q_ref[0, 0].astype(jnp.float32) * scale           # (block_q, hd)
+    hd = qb.shape[-1]
+
+    num_kb = (
+        pl.cdiv(qi * block_q + block_q, block_k) if causal
+        else seq_len // block_k
+    )
+
+    def body(j, carry):
+        o, m, l = carry
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        block_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, block_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        new_o = o * corr + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return new_o, new_m, new_l
+
+    o0 = jnp.zeros((block_q, hd), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    o, m, l = lax.fori_loop(0, jnp.asarray(num_kb, jnp.int32), body,
+                            (o0, m0, l0))
+    o_ref[0, 0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _flash_fwd_tpu(q, k, v, causal, block_q, block_k):
+    """q: (b, h, s, hd); k/v: (b, kvh, s, hd). Returns (o, lse)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, s, h, hd = q.shape
-    kvh = k.shape[2]
+    b, h, s, hd = q.shape
+    kvh = k.shape[1]
     rep = h // kvh
     scale = 1.0 / math.sqrt(hd)
-    num_q_blocks = s // block_q
+    grid = (b, h, s // block_q)
 
-    # layout: (b*h, s, hd) programs over (bh, q_block)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, hd)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * kvh, s, hd)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * kvh, s, hd)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, seq_len=s)
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
-        qi = pl.program_id(1)
-        qb = q_ref[0].astype(jnp.float32) * scale          # (block_q, hd)
-        # dynamic bound: causal → only K blocks up to (and including) the
-        # diagonal; ceiling division so a partial diagonal block is processed
-        # when block_q < block_k (masking handles the overhang)
-        num_kb = (
-            pl.cdiv(qi * block_q + block_q, block_k) if causal
-            else s // block_k
-        )
-        n_steps = jnp.asarray(num_kb, jnp.int32)
-
-        def body(j, carry):
-            o, m, l = carry
-            kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            logits = jax.lax.dot_general(
-                qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                               # (block_q, block_k)
-            if causal:
-                q_pos = qi * block_q + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                k_pos = j * block_k + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                logits = jnp.where(q_pos >= k_pos, logits, -1e30)
-            block_max = jnp.max(logits, axis=-1, keepdims=True)  # (bq, 1)
-            new_m = jnp.maximum(m, block_max)
-            corr = jnp.exp(m - new_m)
-            p = jnp.exp(logits - new_m)
-            new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-            new_o = o * corr + jax.lax.dot_general(
-                p, vb, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return new_o, new_m, new_l
-
-        o0 = jnp.zeros((block_q, hd), jnp.float32)
-        m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
-        l0 = jnp.zeros((block_q, 1), jnp.float32)
-        o, m, l = lax.fori_loop(0, n_steps, body, (o0, m0, l0))
-        o_ref[0] = (o / l).astype(o_ref.dtype)
-
-    grid = (b * h, num_q_blocks)
-    out = pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, hd), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, s, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, hd), lambda bh, qi: (bh, qi, 0)),
-            # GQA: several q heads share one kv head — index map folds bh
-            pl.BlockSpec((1, s, hd), lambda bh, qi: (bh // rep, 0, 0)),
-            pl.BlockSpec((1, s, hd), lambda bh, qi: (bh // rep, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, hd), lambda bh, qi: (bh, qi, 0)),
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
             flops=int(2 * 2 * b * h * s * s * hd * (0.5 if causal else 1.0)),
-            bytes_accessed=(qt.size + kt.size + vt.size) * qt.dtype.itemsize,
-            transcendentals=b * h * s * s,
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=int(b * h * s * s * (0.5 if causal else 1.0)),
         ),
         interpret=_INTERPRET,
-    )(qt, kt, vt)
-    return out.reshape(b, h, s, hd).transpose(0, 2, 1, 3)
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               causal, scale, block_q, block_k, seq_len):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    qb = q_ref[0, 0].astype(jnp.float32)                    # (block_q, hd)
+    dob = do_ref[0, 0].astype(jnp.float32)                  # (block_q, hd)
+    lse = lse_ref[0, 0]                                     # (block_q, 1)
+    delta = delta_ref[0, 0]                                 # (block_q, 1)
+    hd = qb.shape[-1]
+
+    num_kb = (
+        pl.cdiv(qi * block_q + block_q, block_k) if causal
+        else seq_len // block_k
+    )
+
+    def body(j, dq):
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                 # (block_q, block_k)
+        dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, jnp.asarray(num_kb, jnp.int32), body,
+                       jnp.zeros((block_q, hd), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, causal, scale, block_q, block_k, seq_len):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    kb = k_ref[0, 0].astype(jnp.float32)                    # (block_k, hd)
+    vb = v_ref[0, 0].astype(jnp.float32)                    # (block_k, hd)
+    hd = kb.shape[-1]
+
+    num_qb = seq_len // block_q
+    # causal: only query blocks at/after this key block contribute
+    start_qb = (ki * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                 # (block_q, block_k)
+        # dv += pᵀ @ dO
+        dv = dv + lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # dk += dsᵀ @ q
+        dk = dk + lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((block_k, hd), jnp.float32)
+    dk, dv = lax.fori_loop(jnp.asarray(start_qb, jnp.int32),
+                           jnp.asarray(num_qb, jnp.int32), body, (z, z))
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, hd = q.shape
+    kvh = k.shape[1]
+    rep = h // kvh
+    scale = 1.0 / math.sqrt(hd)
+
+    # delta[i] = Σ_d dO[i,d]·O[i,d] — cheap rowwise reduce, fused by XLA
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+
+    dq_kernel = functools.partial(
+        _dq_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, seq_len=s)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, s, hd), q.dtype),
+        grid=(b, h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, hd),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(3 * 2 * b * h * s * s * hd * (0.5 if causal else 1.0)),
+            bytes_accessed=(q.size * 3) * q.dtype.itemsize,
+            transcendentals=int(b * h * s * s * (0.5 if causal else 1.0)),
+        ),
+        interpret=_INTERPRET,
+    )(q, k, v, g, lse, delta)
+
+    # dk/dv per *query* head (grid over h), reduced over the GQA group after.
+    dkv_kernel = functools.partial(
+        _dkv_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, seq_len=s)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, s, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, hd), jnp.float32),
+        ),
+        grid=(b, h, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * 2 * b * h * s * s * hd * (0.5 if causal else 1.0)),
+            bytes_accessed=(q.size * 4) * q.dtype.itemsize,
+            transcendentals=int(b * h * s * s * (0.5 if causal else 1.0)),
+        ),
+        interpret=_INTERPRET,
+    )(q, k, v, g, lse, delta)
+
+    if rep != 1:
+        dk = dk.reshape(b, kvh, rep, s, hd).sum(axis=2)
+        dv = dv.reshape(b, kvh, rep, s, hd).sum(axis=2)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wiring (bhsd core)
+# ---------------------------------------------------------------------------
 
 
 def _supported_on_tpu(q, k, block_q, block_k):
-    b, s, h, hd = q.shape
+    b, h, s, hd = q.shape
     return (
         jax.default_backend() == "tpu"
         and s % block_q == 0
         and s % block_k == 0
+        and block_k % block_q == 0  # causal start-block math in dkv
         and hd % 128 == 0
-        and h % k.shape[2] == 0
+        and h % k.shape[1] == 0
     )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
+def _flash_bhsd(q, k, v, causal, block_q, block_k):
     if _supported_on_tpu(q, k, block_q, block_k):
-        return _flash_fwd_tpu(q, k, v, causal, block_q, block_k)
-    return _xla_attention(q, k, v, causal)
+        return _flash_fwd_tpu(q, k, v, causal, block_q, block_k)[0]
+    return _xla_attention_bhsd(q, k, v, causal)
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
-    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+    if _supported_on_tpu(q, k, block_q, block_k):
+        o, lse = _flash_fwd_tpu(q, k, v, causal, block_q, block_k)
+        return o, (q, k, v, o, lse)
+    return _xla_attention_bhsd(q, k, v, causal), (q, k, v, None, None)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
+    q, k, v, o, lse = res
+    if o is not None:
+        return _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k)
+    _, vjp = jax.vjp(
+        lambda q, k, v: _xla_attention_bhsd(q, k, v, causal), q, k, v)
     return vjp(g)
 
 
-_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+
+def flash_attention_bhsd(q, k, v, causal: bool = True,
+                         block_q: int = 512, block_k: int = 512):
+    """q: (batch, heads, seq, head_dim); k/v: (batch, kv_heads, seq, head_dim).
+
+    The TPU-native layout: head_dim on the lane dimension, no transposes.
+    """
+    s = q.shape[2]
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if block_k % block_q != 0:
+        block_q = block_k = min(block_q, block_k)
+    return _flash_bhsd(q, k, v, causal, block_q, block_k)
 
 
 def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int = 256, block_k: int = 256):
-    """Public entry. q/k/v: (batch, seq, heads, head_dim); GQA supported."""
-    s = q.shape[1]
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    return _flash(q, k, v, causal, block_q, block_k)
+                    block_q: int = 512, block_k: int = 512):
+    """Layout-standard entry. q/k/v: (batch, seq, heads, head_dim)."""
+    q = q.transpose(0, 2, 1, 3)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    out = flash_attention_bhsd(q, k, v, causal, block_q, block_k)
+    return out.transpose(0, 2, 1, 3)

@@ -76,12 +76,19 @@ def ring_attention_sharded(
     def local_fn(q, k, v):
         idx = lax.axis_index("sp")
         b, sq, h, hd = q.shape
-        # pvary: fresh accumulators must carry the same varying-manual-axes
-        # type as the shard_map inputs or the fori carry types mismatch
+        # fresh accumulators must carry the same varying-manual-axes type as
+        # the shard_map inputs or the fori carry types mismatch
         varying = tuple(a for a in ("dp", "fsdp", "sp") if a in mesh.shape)
-        o = lax.pvary(jnp.zeros((b, sq, h, hd), jnp.float32), varying)
-        m = lax.pvary(jnp.full((b, h, sq), -jnp.inf, jnp.float32), varying)
-        l = lax.pvary(jnp.zeros((b, h, sq), jnp.float32), varying)
+
+        def _vary(x):
+            pcast = getattr(lax, "pcast", None)
+            if pcast is not None:
+                return pcast(x, varying, to="varying")
+            return lax.pvary(x, varying)  # pre-0.9 JAX
+
+        o = _vary(jnp.zeros((b, sq, h, hd), jnp.float32))
+        m = _vary(jnp.full((b, h, sq), -jnp.inf, jnp.float32))
+        l = _vary(jnp.zeros((b, h, sq), jnp.float32))
         perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
 
         def step(i, carry):

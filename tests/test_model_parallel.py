@@ -17,7 +17,7 @@ from ray_tpu.models.llama import (
     make_train_step,
     param_specs,
 )
-from ray_tpu.ops.flash_attention import _xla_attention, flash_attention
+from ray_tpu.ops.flash_attention import _xla_attention_bhsd, flash_attention
 from ray_tpu.parallel.mesh import MeshSpec, logical_to_sharding
 from ray_tpu.parallel.ring_attention import (
     ring_attention_reference,
@@ -137,12 +137,15 @@ def test_model_with_ring_attention_end_to_end():
 
 
 def test_flash_attention_fallback_matches():
-    # on CPU this exercises the XLA fallback path + custom_vjp
+    # on CPU this exercises the XLA fallback path + custom_vjp (bshd wrapper)
     b, s, h, hd = 2, 128, 4, 64
     q = jax.random.normal(jax.random.key(0), (b, s, h, hd))
     k = jax.random.normal(jax.random.key(1), (b, s, h, hd))
     v = jax.random.normal(jax.random.key(2), (b, s, h, hd))
-    expected = _xla_attention(q, k, v, causal=True)
+    expected = _xla_attention_bhsd(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=True,
+    ).transpose(0, 2, 1, 3)
     got = flash_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=1e-5, atol=1e-5)
@@ -152,19 +155,47 @@ def test_flash_attention_fallback_matches():
 
 
 def test_flash_attention_kernel_interpreted():
-    """Run the actual Pallas kernel in interpreter mode on CPU."""
+    """Run the actual Pallas forward kernel in interpreter mode on CPU."""
     from ray_tpu.ops import flash_attention as fa
 
     b, s, h, hd = 1, 256, 2, 128
-    q = jax.random.normal(jax.random.key(0), (b, s, h, hd), jnp.float32)
-    k = jax.random.normal(jax.random.key(1), (b, s, 1, hd), jnp.float32)
-    v = jax.random.normal(jax.random.key(2), (b, s, 1, hd), jnp.float32)
-    expected = fa._xla_attention(q, k, v, causal=True)
+    q = jax.random.normal(jax.random.key(0), (b, h, s, hd), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, 1, s, hd), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, 1, s, hd), jnp.float32)
+    expected = fa._xla_attention_bhsd(q, k, v, causal=True)
     old = fa._INTERPRET
     fa._INTERPRET = True
     try:
-        got = fa._flash_fwd_tpu(q, k, v, causal=True, block_q=128, block_k=128)
+        got, lse = fa._flash_fwd_tpu(q, k, v, causal=True,
+                                     block_q=128, block_k=128)
     finally:
         fa._INTERPRET = old
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=2e-4, atol=2e-4)
+    assert lse.shape == (b, h, s, 1)
+
+
+def test_flash_attention_backward_kernels_interpreted():
+    """Pallas dq/dkv kernels in interpreter mode vs XLA autodiff (incl. GQA)."""
+    from ray_tpu.ops import flash_attention as fa
+
+    b, s, h, kvh, hd = 1, 256, 4, 2, 128
+    q = jax.random.normal(jax.random.key(0), (b, h, s, hd), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, kvh, s, hd), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, kvh, s, hd), jnp.float32)
+    g = jax.random.normal(jax.random.key(3), (b, h, s, hd), jnp.float32)
+
+    for causal in (True, False):
+        _, vjp = jax.vjp(
+            lambda q, k, v: fa._xla_attention_bhsd(q, k, v, causal), q, k, v)
+        want_dq, want_dk, want_dv = vjp(g)
+        old = fa._INTERPRET
+        fa._INTERPRET = True
+        try:
+            o, lse = fa._flash_fwd_tpu(q, k, v, causal, 128, 128)
+            dq, dk, dv = fa._flash_bwd_tpu(q, k, v, o, lse, g, causal, 128, 128)
+        finally:
+            fa._INTERPRET = old
+        for got, want in ((dq, want_dq), (dk, want_dk), (dv, want_dv)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-3, atol=2e-3)

