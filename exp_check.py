@@ -1,0 +1,32 @@
+"""Numerics check: Pallas flash fwd+bwd vs XLA attention on TPU."""
+import jax, jax.numpy as jnp
+import numpy as np
+from ray_tpu.ops.flash_attention import (
+    flash_attention_bhsd, _xla_attention_bhsd)
+
+b, h, kvh, s, hd = 2, 4, 2, 1024, 128
+key = jax.random.key(0)
+kq, kk, kv, kg = jax.random.split(key, 4)
+q = jax.random.normal(kq, (b, h, s, hd), jnp.bfloat16)
+k = jax.random.normal(kk, (b, kvh, s, hd), jnp.bfloat16)
+v = jax.random.normal(kv, (b, kvh, s, hd), jnp.bfloat16)
+g = jax.random.normal(kg, (b, h, s, hd), jnp.bfloat16)
+
+for causal in (True, False):
+    for bq, bk in ((512, 512), (256, 512), (512, 1024)):
+        def f_flash(q, k, v):
+            return flash_attention_bhsd(q, k, v, causal=causal,
+                                        block_q=bq, block_k=bk)
+        def f_xla(q, k, v):
+            return _xla_attention_bhsd(q, k, v, causal)
+
+        o1, vjp1 = jax.vjp(f_flash, q, k, v)
+        o2, vjp2 = jax.vjp(f_xla, q, k, v)
+        g1 = vjp1(g); g2 = vjp2(g)
+        eo = float(jnp.max(jnp.abs(o1.astype(jnp.float32) - o2.astype(jnp.float32))))
+        errs = [float(jnp.max(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32))))
+                for a, b_ in zip(g1, g2)]
+        print(f"causal={causal} bq={bq} bk={bk} o_err={eo:.4f} "
+              f"dq={errs[0]:.4f} dk={errs[1]:.4f} dv={errs[2]:.4f}")
+        assert eo < 0.1 and all(e < 0.25 for e in errs), "MISMATCH"
+print("OK")

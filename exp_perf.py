@@ -1,0 +1,45 @@
+"""Perf experiment harness (not part of the framework). Usage:
+  python exp_perf.py remat=0 heads=16 kv=8 impl=xla batch=8
+"""
+import sys, time, json
+import jax, jax.numpy as jnp
+
+args = dict(a.split("=") for a in sys.argv[1:])
+remat = args.get("remat", "1")
+remat = {"0": False, "1": True}.get(remat, remat)
+n_heads = int(args.get("heads", 16))
+n_kv = int(args.get("kv", 8))
+impl = args.get("impl", "xla")
+batch = int(args.get("batch", 8))
+steps = int(args.get("steps", 10))
+seq = int(args.get("seq", 2048))
+chunk = int(args.get("chunk", 512))
+
+from ray_tpu.models.llama import LlamaConfig, make_train_step
+from ray_tpu.parallel.mesh import MeshSpec
+
+cfg = LlamaConfig(
+    vocab_size=32000, dim=1024, n_layers=16, n_heads=n_heads, n_kv_heads=n_kv,
+    ffn_dim=4096, max_seq_len=seq, attention_impl=impl,
+)
+mesh = MeshSpec(dp=1, fsdp=1, tp=1, sp=1).build(jax.devices()[:1])
+init_state, shard_state, train_step, data_sharding = make_train_step(
+    cfg, mesh, learning_rate=1e-4, remat=remat, loss_chunk=chunk)
+state = shard_state(init_state(jax.random.key(0)))
+tokens = jax.device_put(
+    jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size,
+                       dtype=jnp.int32), data_sharding)
+state, loss = train_step(state, tokens)
+print("compiled; loss", float(loss))
+t0 = time.perf_counter()
+for _ in range(steps):
+    state, loss = train_step(state, tokens)
+fl = float(loss)
+dt = (time.perf_counter() - t0) / steps
+n = cfg.num_params()
+tps = batch * seq / dt
+mfu = 6.0 * n * tps / 197e12
+print(json.dumps({"remat": str(remat), "heads": n_heads, "impl": impl,
+                  "batch": batch, "step_ms": round(dt*1e3, 2),
+                  "tok_s": round(tps, 1), "mfu": round(mfu, 4),
+                  "params": n, "loss": round(fl, 4)}))

@@ -1,0 +1,54 @@
+"""ray_tpu.train — distributed training on TPU gangs.
+
+Capability parity with Ray Train v2 (reference: python/ray/train/v2/):
+controller + worker group + scaling/failure policies + checkpoint manager +
+report/barrier, with a JaxTrainer as the TPU-native flagship entry point.
+"""
+
+from ray_tpu.train._checkpoint import (
+    AsyncCheckpointWriter,
+    Checkpoint,
+    CheckpointManager,
+)
+from ray_tpu.train._context import TrainContext, get_context, report
+from ray_tpu.train._controller import TrainController, TrainResult
+from ray_tpu.train._policies import (
+    ElasticScalingPolicy,
+    FailurePolicy,
+    FixedScalingPolicy,
+)
+from ray_tpu.train._worker_group import SyncActor, TrainWorker, WorkerGroup
+from ray_tpu.train.trainer import (
+    CheckpointConfig,
+    DataParallelTrainer,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+    setup_jax_distributed,
+)
+
+__all__ = [
+    "AsyncCheckpointWriter",
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "DataParallelTrainer",
+    "ElasticScalingPolicy",
+    "FailureConfig",
+    "FailurePolicy",
+    "FixedScalingPolicy",
+    "JaxTrainer",
+    "RunConfig",
+    "ScalingConfig",
+    "SyncActor",
+    "TrainContext",
+    "TrainController",
+    "TrainResult",
+    "TrainWorker",
+    "TrainingFailedError",
+    "WorkerGroup",
+    "get_context",
+    "report",
+]

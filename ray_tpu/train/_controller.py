@@ -1,0 +1,189 @@
+"""Train controller: the run loop driving worker groups to completion.
+
+Reference: python/ray/train/v2/_internal/execution/controller/controller.py:105
+— the controller owns the worker-group lifecycle: consult the scaling policy,
+create the group, poll it, finalize checkpoints as rank shards land, and on
+failure consult the failure policy, tear down, and re-create (resuming from
+the latest finalized checkpoint).
+
+Redesigned driver-side (a plain object run by Trainer.fit) rather than as a
+detached actor: the TPU framework's north-star path is a single driver owning
+a slice gang, and driver-failure isolation can be layered on by running fit()
+itself inside an actor.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train._policies import FailurePolicy, ScalingPolicy
+from ray_tpu.train._worker_group import WorkerGroup, WorkerStatus
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TrainResult:
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    best_checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class TrainController:
+    def __init__(
+        self,
+        train_fn: Callable,
+        train_config: Optional[dict],
+        scaling_policy: ScalingPolicy,
+        failure_policy: FailurePolicy,
+        resources_per_worker: Dict[str, float],
+        run_name: str,
+        storage_path: str,
+        checkpoint_manager: CheckpointManager,
+        use_tpu_slices: bool = False,
+        topology: str = "",
+        accelerator_type: str = "",
+        poll_interval_s: float = 0.2,
+    ):
+        self.train_fn = train_fn
+        self.train_config = train_config
+        self.scaling_policy = scaling_policy
+        self.failure_policy = failure_policy
+        self.resources_per_worker = resources_per_worker
+        self.run_name = run_name
+        self.storage_path = storage_path
+        self.ckpt = checkpoint_manager
+        self.use_tpu_slices = use_tpu_slices
+        self.topology = topology
+        self.accelerator_type = accelerator_type
+        self.poll_interval_s = poll_interval_s
+        self.failure_count = 0
+        self._group: Optional[WorkerGroup] = None
+        # checkpoint steps reported but not yet finalized (async rank shards
+        # may land after the report that announced them)
+        self._pending_ckpt: Dict[int, Dict[str, Any]] = {}
+
+    # -- helpers --------------------------------------------------------
+
+    def _cluster_cpus(self) -> float:
+        try:
+            return float(ray_tpu.cluster_resources().get("CPU", 1.0))
+        except Exception:  # noqa: BLE001
+            return 1.0
+
+    def _make_group(self) -> WorkerGroup:
+        decision = self.scaling_policy.target_size(
+            self._cluster_cpus(), self.resources_per_worker
+        )
+        logger.info("worker group size %d (%s)", decision.num_workers,
+                    decision.reason)
+        group = WorkerGroup(
+            num_workers=decision.num_workers,
+            resources_per_worker=self.resources_per_worker,
+            run_name=self.run_name,
+            storage_path=self.storage_path,
+            run_dir=self.ckpt.run_dir,
+            use_tpu_slices=self.use_tpu_slices,
+            topology=self.topology,
+            accelerator_type=self.accelerator_type,
+        )
+        group.create(latest_checkpoint=self.ckpt.latest)
+        group.start_training(self.train_fn, self.train_config)
+        return group
+
+    def _ingest_reports(self, statuses: List[WorkerStatus],
+                        result: TrainResult, world_size: int):
+        """Collect metrics; finalize checkpoints once all rank shards landed."""
+        for st in statuses:
+            for rep in st.reports:
+                result.metrics_history.append(rep["metrics"])
+                if rep["metrics"]:
+                    result.metrics = rep["metrics"]
+                if "checkpoint_step" in rep:
+                    self._pending_ckpt[rep["checkpoint_step"]] = rep["metrics"]
+        for step in sorted(self._pending_ckpt):
+            ckpt = self.ckpt.finalize(
+                step, self._pending_ckpt[step], expected_ranks=world_size
+            )
+            if ckpt is not None:
+                del self._pending_ckpt[step]
+                result.checkpoint = ckpt
+                logger.info("checkpoint finalized: %s", ckpt.path)
+
+    # -- run loop -------------------------------------------------------
+
+    def run(self) -> TrainResult:
+        result = TrainResult()
+        while True:
+            try:
+                self._group = self._make_group()
+            except Exception as e:  # noqa: BLE001 — group creation failed
+                self.failure_count += 1
+                if not self.failure_policy.decide(self.failure_count):
+                    result.error = f"worker group creation failed: {e}"
+                    return result
+                time.sleep(min(2.0 ** self.failure_count * 0.2, 10.0))
+                continue
+
+            group = self._group
+            world = group.num_workers
+            failed = False
+            try:
+                while True:
+                    statuses = group.poll()
+                    self._ingest_reports(statuses, result, world)
+                    dead = [s for s in statuses if not s.alive]
+                    errored = [s for s in statuses if s.error and s.alive]
+                    if dead or errored:
+                        failed = True
+                        cause = (dead or errored)[0].error
+                        logger.warning("worker failure: %s", cause)
+                        result.error = cause
+                        break
+                    if all(s.done for s in statuses):
+                        # final drain: async checkpoint writes + last reports
+                        group.flush_checkpoints()
+                        self._ingest_reports(group.poll(), result, world)
+                        break
+                    time.sleep(self.poll_interval_s)
+            finally:
+                group.shutdown()
+                self._group = None
+
+            if not failed:
+                result.error = None
+                result.best_checkpoint = self.ckpt.best
+                result.checkpoint = self.ckpt.latest
+                return result
+
+            # drop partial staging shards from the failed incarnation: a
+            # differently-sized restart would otherwise mix incarnations
+            self._pending_ckpt.clear()
+            self._purge_staging()
+            self.failure_count += 1
+            if not self.failure_policy.decide(self.failure_count):
+                return result
+            logger.info(
+                "restarting worker group (failure %d), resuming from %s",
+                self.failure_count,
+                self.ckpt.latest.path if self.ckpt.latest else "scratch",
+            )
+
+    def _purge_staging(self):
+        import shutil
+
+        try:
+            for name in os.listdir(self.ckpt.run_dir):
+                if name.startswith(".staging_checkpoint_"):
+                    shutil.rmtree(os.path.join(self.ckpt.run_dir, name),
+                                  ignore_errors=True)
+        except OSError:
+            pass

@@ -1,0 +1,61 @@
+"""Scaling and failure policies for the Train controller.
+
+Reference: python/ray/train/v2/_internal/execution/scaling_policy/{fixed,
+elastic}.py and failure_handling/ — the controller consults the scaling
+policy for a target worker-group size and the failure policy for whether a
+failure is retryable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class ScalingDecision:
+    num_workers: int
+    reason: str = ""
+
+
+class ScalingPolicy:
+    def target_size(self, cluster_cpus: float,
+                    resources_per_worker: dict) -> ScalingDecision:
+        raise NotImplementedError
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    def __init__(self, num_workers: int):
+        self.num_workers = num_workers
+
+    def target_size(self, cluster_cpus, resources_per_worker):
+        return ScalingDecision(self.num_workers, "fixed")
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Size the group to what the cluster can currently hold, within
+    [min_workers, max_workers] (reference: scaling_policy/elastic.py)."""
+
+    def __init__(self, min_workers: int, max_workers: int):
+        assert 1 <= min_workers <= max_workers
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+
+    def target_size(self, cluster_cpus, resources_per_worker):
+        per = max(float(resources_per_worker.get("CPU", 1.0)), 1e-9)
+        fit = int(cluster_cpus // per)
+        n = max(self.min_workers, min(self.max_workers, fit))
+        return ScalingDecision(n, f"elastic fit={fit}")
+
+
+@dataclass
+class FailurePolicy:
+    """Retry budget for worker-group failures (reference: FailureConfig)."""
+
+    max_failures: int = 0  # -1 = unlimited
+
+    def decide(self, failure_count: int) -> bool:
+        """True = retry (recreate the group), False = raise."""
+        if self.max_failures == -1:
+            return True
+        return failure_count <= self.max_failures

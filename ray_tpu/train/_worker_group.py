@@ -1,0 +1,302 @@
+"""Worker group: the gang of training worker actors + synchronization actor.
+
+Reference: python/ray/train/v2/_internal/execution/worker_group/
+worker_group.py:88 (create/poll/shutdown lifecycle over a placement group)
+and checkpoint/sync_actor.py (barrier/broadcast among workers).
+
+TPU-first redesign: the group is placed either on a STRICT_SPREAD placement
+group of per-worker bundles (CPU / one-process-per-host) or on TPU slices via
+ray_tpu.tpu.slice.SlicePlacementGroup; rank-0's node becomes the
+jax.distributed coordinator, and the MEGASCALE/coordinator env vars are
+injected exactly as the reference's JaxConfig does
+(reference: python/ray/train/v2/jax/config.py:60-121).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train import _context as ctx_mod
+
+
+@ray_tpu.remote
+class SyncActor:
+    """Barrier + rank-0 broadcast rendezvous (reference: sync_actor.py)."""
+
+    def __init__(self):
+        self._counts: Dict[str, int] = {}
+        self._gen: Dict[str, int] = {}
+        self._kv: Dict[str, Any] = {}
+
+    async def barrier(self, name: str, world_size: int):
+        import asyncio
+
+        self._counts[name] = self._counts.get(name, 0) + 1
+        gen = self._gen.get(name, 0)
+        if self._counts[name] >= world_size:
+            self._counts[name] = 0
+            self._gen[name] = gen + 1
+            return True
+        while self._gen.get(name, 0) == gen:
+            await asyncio.sleep(0.01)
+        return True
+
+    async def put(self, key: str, value: Any):
+        self._kv[key] = value
+        return True
+
+    async def wait_for(self, key: str, poll_s: float = 0.01):
+        import asyncio
+
+        while key not in self._kv:
+            await asyncio.sleep(poll_s)
+        return self._kv[key]
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """One training process. Runs the user's train fn on a thread with a
+    TrainContext installed; buffers reports for the controller's polls."""
+
+    def __init__(self, rank: int, world_size: int, local_rank: int,
+                 node_rank: int, run_name: str, storage_path: str,
+                 run_dir: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self.run_name = run_name
+        self.storage_path = storage_path
+        self.run_dir = run_dir
+        self._thread: Optional[threading.Thread] = None
+        self._ctx: Optional[ctx_mod.TrainContext] = None
+        self._error: Optional[str] = None
+        self._done = False
+
+    def node_ip(self) -> str:
+        return socket.gethostbyname(socket.gethostname())
+
+    def start(self, train_fn_pickled: bytes, config: Optional[dict],
+              latest_checkpoint: Optional[dict],
+              sync_actor, env_vars: Optional[Dict[str, str]] = None) -> bool:
+        import os
+
+        import cloudpickle
+
+        # cloudpickle: the user's train fn is typically a closure/local def,
+        # beyond plain pickle (same treatment as exported remote functions)
+        train_fn = cloudpickle.loads(train_fn_pickled)
+        if env_vars:
+            os.environ.update(env_vars)
+        staging_fn = (
+            lambda step: f"{self.run_dir}/.staging_checkpoint_{step:09d}"
+        )
+        ctx = ctx_mod.TrainContext(
+            rank=self.rank, world_size=self.world_size,
+            local_rank=self.local_rank, node_rank=self.node_rank,
+            run_name=self.run_name, storage_path=self.storage_path,
+            staging_dir_fn=staging_fn,
+            latest_checkpoint=(
+                Checkpoint.from_wire(latest_checkpoint)
+                if latest_checkpoint else None
+            ),
+        )
+        ctx._sync_client = sync_actor
+        self._ctx = ctx
+
+        def run():
+            ctx_mod.set_context(ctx)
+            try:
+                if config is not None:
+                    train_fn(config)
+                else:
+                    train_fn()
+            except BaseException:  # noqa: BLE001 — reported to controller
+                self._error = traceback.format_exc()
+            finally:
+                self._done = True
+                ctx_mod.set_context(None)
+
+        self._thread = threading.Thread(target=run, name="train-fn", daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self) -> dict:
+        """Drain buffered reports; include liveness/error state."""
+        reports = []
+        if self._ctx is not None:
+            while True:
+                try:
+                    reports.append(self._ctx.report_queue.get_nowait())
+                except queue.Empty:
+                    break
+        return {"reports": reports, "done": self._done, "error": self._error}
+
+    def stop(self) -> bool:
+        if self._ctx is not None:
+            self._ctx.stop_event.set()
+        return True
+
+    def flush_checkpoints(self) -> bool:
+        """Block until any in-flight async checkpoint write lands."""
+        if self._ctx is not None:
+            self._ctx._writer.wait()
+        return True
+
+
+@dataclass
+class WorkerStatus:
+    alive: bool
+    done: bool = False
+    error: Optional[str] = None
+    reports: List[dict] = field(default_factory=list)
+
+
+class WorkerGroup:
+    """Creates, polls, and tears down the gang of TrainWorker actors."""
+
+    def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
+                 run_name: str, storage_path: str, run_dir: str,
+                 use_tpu_slices: bool = False, topology: str = "",
+                 accelerator_type: str = ""):
+        self.num_workers = num_workers
+        self.resources_per_worker = dict(resources_per_worker)
+        self.run_name = run_name
+        self.storage_path = storage_path
+        self.run_dir = run_dir
+        self.use_tpu_slices = use_tpu_slices
+        self.topology = topology
+        self.accelerator_type = accelerator_type
+        self.workers: List[Any] = []
+        self.sync_actor = None
+        self._pg = None
+        self._slice_pg = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def create(self, latest_checkpoint: Optional[Checkpoint] = None):
+        from ray_tpu.util.placement_group import placement_group
+
+        self.sync_actor = SyncActor.options(
+            name=f"{self.run_name}-sync", namespace="_train"
+        ).remote()
+
+        if self.use_tpu_slices:
+            from ray_tpu.tpu.slice import slice_placement_group
+
+            self._slice_pg = slice_placement_group(
+                pod_type=self.accelerator_type, num_slices=1,
+                topology=self.topology,
+            )
+            self._slice_pg.ready()
+            pg = self._slice_pg.placement_group
+        else:
+            pg = placement_group(
+                [dict(self.resources_per_worker)
+                 for _ in range(self.num_workers)],
+                strategy="SPREAD",
+            )
+            if not pg.ready(timeout=120):
+                raise TimeoutError("worker-group placement group not ready")
+        self._pg = pg
+
+        self.workers = [
+            TrainWorker.options(
+                resources=self.resources_per_worker,
+                placement_group=pg, placement_group_bundle_index=i,
+            ).remote(
+                rank=i, world_size=self.num_workers, local_rank=0,
+                node_rank=i, run_name=self.run_name,
+                storage_path=self.storage_path, run_dir=self.run_dir,
+            )
+            for i in range(self.num_workers)
+        ]
+        # rank-0's host becomes the jax.distributed coordinator
+        ips = ray_tpu.get([w.node_ip.remote() for w in self.workers],
+                          timeout=120)
+        coordinator = f"{ips[0]}:{_pick_port(self.run_name)}"
+        env_base = {
+            "RT_TRAIN_COORDINATOR": coordinator,
+            "RT_TRAIN_WORLD_SIZE": str(self.num_workers),
+        }
+        self._env_base = env_base
+        self._latest = latest_checkpoint
+        return self
+
+    def start_training(self, train_fn: Callable, config: Optional[dict]):
+        import cloudpickle
+
+        fn_bytes = cloudpickle.dumps(train_fn)
+        wire_ckpt = self._latest.to_wire() if self._latest else None
+        starts = []
+        for i, w in enumerate(self.workers):
+            env = dict(self._env_base)
+            env["RT_TRAIN_RANK"] = str(i)
+            starts.append(w.start.remote(
+                fn_bytes, config, wire_ckpt, self.sync_actor, env))
+        ray_tpu.get(starts, timeout=120)
+
+    def poll(self) -> List[WorkerStatus]:
+        out: List[WorkerStatus] = []
+        refs = [w.poll.remote() for w in self.workers]
+        for ref in refs:
+            try:
+                r = ray_tpu.get(ref, timeout=60)
+                out.append(WorkerStatus(alive=True, done=r["done"],
+                                        error=r["error"], reports=r["reports"]))
+            except (ray_tpu.ActorDiedError, ray_tpu.ActorUnavailableError,
+                    ray_tpu.GetTimeoutError) as e:
+                out.append(WorkerStatus(alive=False, error=str(e)))
+        return out
+
+    def flush_checkpoints(self):
+        try:
+            ray_tpu.get(
+                [w.flush_checkpoints.remote() for w in self.workers],
+                timeout=300,
+            )
+        except (ray_tpu.ActorDiedError, ray_tpu.ActorUnavailableError):
+            pass
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                w.stop.remote()
+            except Exception:  # noqa: BLE001
+                pass
+        time.sleep(0.2)
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        if self.sync_actor is not None:
+            try:
+                ray_tpu.kill(self.sync_actor)
+            except Exception:  # noqa: BLE001
+                pass
+        if self._slice_pg is not None:
+            try:
+                self._slice_pg.remove()
+            except Exception:  # noqa: BLE001
+                pass
+        elif self._pg is not None:
+            try:
+                from ray_tpu.util.placement_group import remove_placement_group
+
+                remove_placement_group(self._pg)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []
+
+
+def _pick_port(seed: str) -> int:
+    return 20000 + (hash(seed) % 20000)

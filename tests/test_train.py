@@ -1,0 +1,254 @@
+"""Train layer tests: controller, worker group, checkpointing, fault
+tolerance — mirroring the reference's train/v2 test strategy
+(reference: python/ray/train/v2/tests/) against a real local cluster.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    CheckpointManager,
+    DataParallelTrainer,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+)
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _run_cfg(tmp_path, name, **kw):
+    return RunConfig(name=name, storage_path=str(tmp_path), **kw)
+
+
+def test_data_parallel_basic(ray_init, tmp_path):
+    def train_fn(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        for step in range(config["steps"]):
+            train.report({"step": step, "loss": 1.0 / (step + 1),
+                          "rank": ctx.get_world_rank(),
+                          "world": ctx.get_world_size()})
+
+    result = DataParallelTrainer(
+        train_fn,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=_run_cfg(tmp_path, "basic"),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["world"] == 2
+    # 2 workers x 3 steps
+    assert len(result.metrics_history) == 6
+
+
+def test_checkpoint_topk_and_best(ray_init, tmp_path):
+    def train_fn():
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        for step in range(4):
+            state = {"w": np.full(4, float(step)), "step": step}
+            train.report({"step": step, "loss": [3.0, 1.0, 2.0, 4.0][step]},
+                         checkpoint_state=state)
+
+    result = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=_run_cfg(
+            tmp_path, "topk",
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="loss",
+                checkpoint_score_order="min"),
+        ),
+    ).fit()
+    assert result.checkpoint is not None
+    run_dir = os.path.join(str(tmp_path), "topk")
+    kept = sorted(d for d in os.listdir(run_dir) if d.startswith("checkpoint_"))
+    assert len(kept) == 2  # latest + best
+    # best by min loss is step 1; latest is step 3
+    assert kept == ["checkpoint_000000001", "checkpoint_000000003"]
+    assert result.best_checkpoint.step == 1
+    # both rank shards present and loadable
+    state = result.checkpoint.load_state({"w": np.zeros(4), "step": 0}, rank=1)
+    assert state["step"] == 3 and state["w"][0] == 3.0
+
+
+def test_barrier_and_broadcast(ray_init, tmp_path):
+    def train_fn():
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        token = ctx.broadcast_from_rank_zero(
+            "coord", f"addr-of-rank0" if ctx.get_world_rank() == 0 else None)
+        ctx.barrier("start")
+        train.report({"token": token, "step": 0})
+
+    result = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=3),
+        run_config=_run_cfg(tmp_path, "sync"),
+    ).fit()
+    toks = {m["token"] for m in result.metrics_history}
+    assert toks == {"addr-of-rank0"}
+
+
+def test_worker_failure_restart_and_resume(ray_init, tmp_path):
+    """Kill rank 0 mid-run; controller restarts the group and training
+    resumes from the latest finalized checkpoint (VERDICT #2 'done' bar)."""
+    marker = str(tmp_path / "died_once")
+
+    def train_fn(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        start = 0
+        ckpt = ctx.get_checkpoint()
+        if ckpt is not None:
+            state = ckpt.load_state({"w": np.zeros(2), "step": 0},
+                                    rank=ctx.get_world_rank())
+            start = int(state["step"]) + 1
+        for step in range(start, config["steps"]):
+            if (step == 2 and ctx.get_world_rank() == 0
+                    and not os.path.exists(config["marker"])):
+                open(config["marker"], "w").close()
+                os._exit(1)  # hard kill: actor dies, no cleanup
+            train.report(
+                {"step": step, "resumed_from": start},
+                checkpoint_state={"w": np.ones(2) * step, "step": step},
+            )
+
+    result = DataParallelTrainer(
+        train_fn,
+        train_loop_config={"steps": 5, "marker": marker},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=_run_cfg(
+            tmp_path, "phoenix",
+            failure_config=FailureConfig(max_failures=2),
+        ),
+    ).fit()
+    assert result.error is None
+    assert os.path.exists(marker)
+    assert result.metrics["step"] == 4
+    # the restarted incarnation resumed from a checkpoint, not from scratch
+    resumed = [m for m in result.metrics_history if m.get("resumed_from", 0) > 0]
+    assert resumed, "second incarnation should resume from checkpoint"
+    assert result.checkpoint.step == 4
+
+
+def test_failure_budget_exhausted(ray_init, tmp_path):
+    def train_fn():
+        raise RuntimeError("boom")
+
+    with pytest.raises(TrainingFailedError):
+        DataParallelTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=_run_cfg(tmp_path, "budget",
+                                failure_config=FailureConfig(max_failures=0)),
+        ).fit()
+
+
+def test_jax_trainer_sharded_state_roundtrip(ray_init, tmp_path):
+    """JaxTrainer with real jax.Array state through snapshot/restore."""
+
+    def train_fn():
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        params = {"w": jnp.arange(8.0), "b": jnp.zeros(4)}
+        start = 0
+        ckpt = ctx.get_checkpoint()
+        if ckpt is not None:
+            state = ckpt.load_state({"params": params, "step": 0})
+            start = int(state["step"]) + 1
+            params = state["params"]
+
+        @jax.jit
+        def update(p):
+            return jax.tree.map(lambda x: x + 1.0, p)
+
+        for step in range(start, 3):
+            params = update(params)
+            train.report({"step": step, "w0": float(params["w"][0])},
+                         checkpoint_state={"params": params, "step": step})
+
+    result = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=_run_cfg(tmp_path, "jaxstate"),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["w0"] == 3.0
+    ckpt = result.checkpoint
+    import jax.numpy as jnp
+
+    state = ckpt.load_state(
+        {"params": {"w": jnp.zeros(8), "b": jnp.zeros(4)}, "step": 0})
+    assert float(state["params"]["w"][0]) == 3.0
+
+
+def test_checkpoint_manager_recovers_existing(tmp_path):
+    """A new manager over an existing run dir finds prior checkpoints."""
+    mgr = CheckpointManager(str(tmp_path), "recover", num_to_keep=3)
+    os.makedirs(mgr.staging_dir(0))
+    np.savez(os.path.join(mgr.staging_dir(0), "rank_0.npz"), w=np.ones(2))
+    assert mgr.finalize(0, {"loss": 1.0}, expected_ranks=1) is not None
+
+    mgr2 = CheckpointManager(str(tmp_path), "recover", num_to_keep=3)
+    assert mgr2.latest is not None
+    assert mgr2.latest.path == mgr.latest.path
+
+
+def test_jax_distributed_two_process_mesh(ray_init, tmp_path):
+    """Two worker processes join one global JAX mesh via setup_jax_distributed
+    (the KV-rendezvous coordinator contract, reference: v2/jax/config.py:60)
+    and allreduce across it."""
+
+    def train_fn():
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import train
+        from ray_tpu.train import setup_jax_distributed
+
+        setup_jax_distributed()
+        ctx = train.get_context()
+        assert jax.process_count() == 2
+        # one global computation over both processes' devices
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.devices()
+        mesh = Mesh(devs, ("dp",))
+        x = jax.device_put(
+            jnp.ones(len(devs)), NamedSharding(mesh, P("dp"))
+        )
+        total = jax.jit(lambda v: v.sum())(x)
+        train.report({"step": 0, "procs": jax.process_count(),
+                      "total": float(total)})
+
+    result = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=_run_cfg(tmp_path, "jaxdist"),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["procs"] == 2
+    assert result.metrics["total"] == 16.0  # 2 procs x 8 virtual devices
