@@ -1,0 +1,171 @@
+"""Core-runtime microbenchmarks: tasks/s, actor calls/s, put/get RTT,
+large-object transfer.
+
+Counterpart of the reference's perf suite (reference:
+python/ray/_private/ray_perf.py:95-243 — single_client_tasks_sync,
+single_client_put_gigabytes, actor calls classes). Emits one JSON line per
+benchmark: {"bench": ..., "value": ..., "unit": ...}.
+
+Run: python bench_core.py [--quick]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def timed(fn, *, warmup=1, reps=1):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def bench_tasks_sync(ray_tpu, n):
+    """Sequential round-trip task latency (ray_perf: single_client_tasks_sync)."""
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote(), timeout=60)  # warm the worker pool
+
+    def run():
+        for _ in range(n):
+            ray_tpu.get(nop.remote(), timeout=60)
+
+    dt = timed(run)
+    return {"bench": "tasks_sync", "value": round(n / dt, 1), "unit": "tasks/s"}
+
+
+def bench_tasks_async(ray_tpu, n):
+    """Pipelined task throughput (ray_perf: single_client_tasks_async)."""
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote(), timeout=60)
+
+    def run():
+        ray_tpu.get([nop.remote() for _ in range(n)], timeout=120)
+
+    dt = timed(run)
+    return {"bench": "tasks_async", "value": round(n / dt, 1), "unit": "tasks/s"}
+
+
+def bench_actor_calls_sync(ray_tpu, n):
+    """Sequential actor method round-trips (ray_perf: single_client_actor_calls_sync)."""
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote(), timeout=60)
+
+    def run():
+        for _ in range(n):
+            ray_tpu.get(a.m.remote(), timeout=60)
+
+    dt = timed(run)
+    return {"bench": "actor_calls_sync", "value": round(n / dt, 1), "unit": "calls/s"}
+
+
+def bench_actor_calls_async(ray_tpu, n):
+    """Pipelined actor calls (ray_perf: single_client_actor_calls_async)."""
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote(), timeout=60)
+
+    def run():
+        ray_tpu.get([a.m.remote() for _ in range(n)], timeout=120)
+
+    dt = timed(run)
+    return {"bench": "actor_calls_async", "value": round(n / dt, 1), "unit": "calls/s"}
+
+
+def bench_put_small(ray_tpu, n):
+    """Small-object put latency (inline path)."""
+    payload = b"x" * 1024
+
+    def run():
+        for _ in range(n):
+            ray_tpu.put(payload)
+
+    dt = timed(run)
+    return {"bench": "put_1kb", "value": round(n / dt, 1), "unit": "puts/s"}
+
+
+def bench_put_get_gigabytes(ray_tpu, total_mb):
+    """Large-object put+get bandwidth through shm zero-copy
+    (ray_perf: single_client_put_gigabytes)."""
+    chunk = np.random.randint(0, 255, size=8 * 1024 * 1024, dtype=np.uint8)  # 8 MB
+    reps = max(1, total_mb // 8)
+
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(chunk) for _ in range(reps)]
+    put_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for r in refs:
+        v = ray_tpu.get(r, timeout=120)
+        assert v.nbytes == chunk.nbytes
+        del v
+    get_dt = time.perf_counter() - t0
+    mb = reps * 8
+    return [
+        {"bench": "put_bandwidth", "value": round(mb / put_dt, 1), "unit": "MB/s"},
+        {"bench": "get_bandwidth_zero_copy", "value": round(mb / get_dt, 1), "unit": "MB/s"},
+    ]
+
+
+def bench_task_arg_passthrough(ray_tpu, n_mb):
+    """Ship an n_mb array into a task and a result back (object plane RTT)."""
+    arr = np.random.randint(0, 255, size=n_mb * 1024 * 1024, dtype=np.uint8)
+
+    @ray_tpu.remote
+    def echo_sum(a):
+        return int(a[0]) + int(a[-1])
+
+    ref = ray_tpu.put(arr)
+    ray_tpu.get(echo_sum.remote(ref), timeout=120)  # warm
+    dt = timed(lambda: ray_tpu.get(echo_sum.remote(ref), timeout=120), reps=3)
+    return {"bench": f"task_arg_{n_mb}mb_rtt", "value": round(dt * 1000, 2), "unit": "ms"}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    scale = 1 if args.quick else 5
+    results = []
+    try:
+        results.append(bench_tasks_sync(ray_tpu, 100 * scale))
+        results.append(bench_tasks_async(ray_tpu, 200 * scale))
+        results.append(bench_actor_calls_sync(ray_tpu, 200 * scale))
+        results.append(bench_actor_calls_async(ray_tpu, 400 * scale))
+        results.append(bench_put_small(ray_tpu, 200 * scale))
+        results.extend(bench_put_get_gigabytes(ray_tpu, 40 * scale))
+        results.append(bench_task_arg_passthrough(ray_tpu, 16))
+    finally:
+        for r in results:
+            print(json.dumps(r))
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,11 @@ store, and gang scheduling is slice-topology native.
 Public API parity reference: python/ray/__init__.py of the reference.
 """
 
-from ray_tpu._private.core_worker import ObjectRef, get_core_worker
+from ray_tpu._private.core_worker import (
+    ObjectRef,
+    ObjectRefGenerator,
+    get_core_worker,
+)
 from ray_tpu._private.errors import (
     ActorDiedError,
     ActorUnavailableError,
@@ -16,11 +20,13 @@ from ray_tpu._private.errors import (
     ObjectLostError,
     ObjectStoreFullError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
 from ray_tpu._private.worker import (
     available_resources,
+    cancel,
     cluster_resources,
     get,
     get_actor,
@@ -66,6 +72,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "ObjectRef",
+    "ObjectRefGenerator",
     "init",
     "shutdown",
     "is_initialized",
@@ -73,7 +80,9 @@ __all__ = [
     "get",
     "put",
     "wait",
+    "cancel",
     "kill",
+    "TaskCancelledError",
     "get_actor",
     "nodes",
     "cluster_resources",

@@ -126,6 +126,12 @@ _flag("log_to_driver", True, "Forward worker stdout/stderr to the driver.")
 _flag("actor_creation_timeout_s", 120.0, "Control store waits this long for a daemon to lease+create an actor.")
 _flag("placement_group_timeout_s", 60.0, "Placement group scheduling deadline before marked unschedulable.")
 _flag("actor_ordering_gap_timeout_s", 60.0, "Ordered actor task fails (never reorders) after waiting this long for a missing predecessor sequence number.")
+_flag("object_spill_enabled", True, "Spill cold sealed objects to disk under store memory pressure (reference: raylet local_object_manager spilling).")
+_flag("object_spill_high_water", 0.7, "Store fullness fraction that triggers spilling.")
+_flag("object_spill_low_water", 0.5, "Spill until store fullness drops below this fraction.")
+_flag("object_spill_check_period_s", 0.25, "Spill loop poll period.")
+_flag("control_store_persist", False, "Persist control-store state (nodes/actors/PGs/KV/jobs) to a WAL+snapshot in the session dir; a restarted control store recovers it (reference: gcs redis/rocksdb store clients).")
+_flag("control_store_wal_compact_every", 512, "WAL records between snapshot compactions.")
 
 # --- chaos / fault injection (day 1, per SURVEY §4) ---
 _flag("testing_event_loop_delay_us", "", "Inject delays into event-loop handlers. Format: 'method:min_us:max_us,...' ('*' matches all). Mirrors RAY_testing_asio_delay_us.")

@@ -90,6 +90,23 @@ class ActorRecord:
             "name": self.name,
         }
 
+    def to_persist(self) -> dict:
+        return {"spec": self.spec.to_wire(), **self.to_wire()}
+
+    @classmethod
+    def from_persist(cls, d: dict) -> "ActorRecord":
+        rec = cls(TaskSpec.from_wire(d["spec"]))
+        rec.apply_update(d)
+        return rec
+
+    def apply_update(self, d: dict):
+        self.state = d["state"]
+        self.node_id = d["node_id"] or None
+        self.worker_id = d["worker_id"] or None
+        self.worker_address = d["worker_address"]
+        self.num_restarts = d["num_restarts"]
+        self.death_cause = d["death_cause"]
+
 
 class PlacementGroupRecord:
     __slots__ = (
@@ -119,11 +136,34 @@ class PlacementGroupRecord:
             "name": self.name,
         }
 
+    def to_persist(self) -> dict:
+        return {**self.to_wire(), "labels": self.label_selector}
+
+    @classmethod
+    def from_persist(cls, d: dict) -> "PlacementGroupRecord":
+        rec = cls(
+            PlacementGroupID(d["pg_id"]),
+            [pb.Bundle.from_wire(b) for b in d["bundles"]],
+            d["strategy"], d["name"], label_selector=d.get("labels") or {},
+        )
+        rec.apply_update(d)
+        return rec
+
+    def apply_update(self, d: dict):
+        self.state = d["state"]
+        self.placements = {int(k): v for k, v in d["placements"].items()}
+
 
 class ControlStore:
-    """The cluster control plane service."""
+    """The cluster control plane service.
 
-    def __init__(self):
+    With `control_store_persist` on, every table mutation is WAL-logged (and
+    periodically snapshot-compacted) via persistence.WalStore; `start()`
+    replays the log so a restarted control store resumes with nodes, actors,
+    PGs, jobs, and KV intact (reference: gcs store_client persistence +
+    GcsActorManager/GcsNodeManager restart recovery)."""
+
+    def __init__(self, persist_dir: Optional[str] = None):
         self.server = RpcServer(name="control_store")
         self.pubsub = PubSub(self.server)
         # node_id bytes -> NodeInfo
@@ -142,12 +182,142 @@ class ControlStore:
         self.placement_groups: Dict[bytes, PlacementGroupRecord] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._stopped = False
+        self._wal = None
+        self._compacting = False
+        if persist_dir and GLOBAL_CONFIG.get("control_store_persist"):
+            from ray_tpu._private.persistence import WalStore
+
+            self._wal = WalStore(
+                persist_dir,
+                compact_every=GLOBAL_CONFIG.get("control_store_wal_compact_every"),
+            )
+
+    # ------------------------------------------------------------------
+    # persistence (reference: gcs/store_client/)
+    # ------------------------------------------------------------------
+
+    def _persist(self, op: str, data: dict):
+        if self._wal is None:
+            return
+        due = self._wal.append({"op": op, "d": data})
+        if due and not self._compacting:
+            # copy state + rotate synchronously (cheap, consistent with all
+            # appends so far), then pack+fsync on a worker thread so the
+            # event loop keeps serving heartbeats/leases during compaction
+            self._compacting = True
+            state = self._snapshot_state()
+            self._wal.rotate()
+
+            async def compact():
+                try:
+                    await asyncio.to_thread(self._wal.write_snapshot, state)
+                except Exception:  # noqa: BLE001 — wal.old survives; rotate() merges it
+                    logger.exception("snapshot compaction failed; WAL retained")
+                finally:
+                    self._compacting = False
+
+            spawn(compact())
+
+    def _persist_actor(self, rec: ActorRecord):
+        self._persist("actor_up", rec.to_wire())
+
+    def _snapshot_state(self) -> dict:
+        # Every container is freshly built (to_wire/to_persist allocate new
+        # dicts; kv namespaces and job records are copied) because the pack +
+        # fsync runs on a worker thread while the event loop keeps mutating
+        # the live tables.
+        return {
+            "nodes": [n.to_wire() for n in self.nodes.values()],
+            "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
+            "jobs": [dict(j) for j in self.jobs.values()],
+            "next_job": self._next_job,
+            "actors": [r.to_persist() for r in self.actors.values()],
+            "pgs": [r.to_persist() for r in self.placement_groups.values()],
+        }
+
+    def _apply_snapshot(self, snap: dict):
+        for nw in snap.get("nodes", []):
+            info = NodeInfo.from_wire(nw)
+            self.nodes[info.node_id.binary()] = info
+        self.kv = {ns: dict(kvs) for ns, kvs in snap.get("kv", {}).items()}
+        for job in snap.get("jobs", []):
+            self.jobs[job["job_id"]] = job
+        self._next_job = snap.get("next_job", self._next_job)
+        for aw in snap.get("actors", []):
+            rec = ActorRecord.from_persist(aw)
+            self.actors[rec.spec.actor_id.binary()] = rec
+        for pw in snap.get("pgs", []):
+            rec = PlacementGroupRecord.from_persist(pw)
+            self.placement_groups[rec.pg_id.binary()] = rec
+
+    def _apply_wal_record(self, rec: dict):
+        op, d = rec["op"], rec["d"]
+        if op == "node":
+            info = NodeInfo.from_wire(d)
+            self.nodes[info.node_id.binary()] = info
+        elif op == "kv_put":
+            self.kv.setdefault(d["ns"], {})[d["key"]] = d["value"]
+        elif op == "kv_del":
+            self.kv.get(d["ns"], {}).pop(d["key"], None)
+        elif op == "job":
+            self.jobs[d["job"]["job_id"]] = d["job"]
+            if "next_job" in d:
+                self._next_job = d["next_job"]
+        elif op == "actor":
+            arec = ActorRecord.from_persist(d)
+            self.actors[arec.spec.actor_id.binary()] = arec
+        elif op == "actor_up":
+            arec = self.actors.get(d["actor_id"])
+            if arec is not None:
+                arec.apply_update(d)
+        elif op == "pg":
+            prec = PlacementGroupRecord.from_persist(d)
+            self.placement_groups[prec.pg_id.binary()] = prec
+        elif op == "pg_up":
+            prec = self.placement_groups.get(d["pg_id"])
+            if prec is not None:
+                prec.apply_update(d)
+
+    def _recover(self):
+        snap, wal_records = self._wal.recover()
+        if snap:
+            self._apply_snapshot(snap)
+        for rec in wal_records:
+            try:
+                self._apply_wal_record(rec)
+            except Exception:  # noqa: BLE001 — skip bad record, keep the rest
+                logger.exception("skipping bad WAL record")
+        if not snap and not wal_records:
+            return
+        now = time.monotonic()
+        for nid, info in self.nodes.items():
+            if info.state == pb.NODE_ALIVE:
+                # grace period: the daemon re-heartbeats (and re-registers on
+                # the "unknown" reply) or the health loop declares it dead
+                self.node_last_beat[nid] = now
+                self.node_available[nid] = info.resources
+        for aid, rec in self.actors.items():
+            if rec.name:
+                self.named_actors[(rec.spec.runtime_env.get("namespace", ""), rec.name)] = aid
+            if rec.state in (pb.ACTOR_PENDING, pb.ACTOR_RESTARTING):
+                # creation was in flight when we died: restart it
+                rec.pending_create = spawn(self._create_actor(rec))
+        for pg in self.placement_groups.values():
+            if pg.state == pb.PG_PENDING:
+                spawn(self._schedule_pg(pg))
+        logger.info(
+            "recovered control-store state: %d nodes, %d actors, %d PGs, "
+            "%d jobs", len(self.nodes), len(self.actors),
+            len(self.placement_groups), len(self.jobs),
+        )
 
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        if self._wal is not None:
+            self._recover()
         self.server.register_service(self)
         self.server.on_disconnect(self._on_disconnect)
         addr = await self.server.start(host, port)
@@ -202,6 +372,7 @@ class ControlStore:
         if client:
             await client.close()
         logger.warning("node %s marked DEAD: %s", info.node_id.hex()[:8], reason)
+        self._persist("node", info.to_wire())
         self.pubsub.publish("nodes", info.to_wire())
         # Fail over actors that lived on the node.
         for rec in list(self.actors.values()):
@@ -235,15 +406,29 @@ class ControlStore:
         self.node_available[info.node_id.binary()] = info.resources
         self.node_last_beat[info.node_id.binary()] = time.monotonic()
         self.node_conns[info.node_id.binary()] = conn_id
+        self._persist("node", info.to_wire())
         logger.info(
             "node %s registered at %s resources=%s",
             info.node_id.hex()[:8], info.address, info.resources.to_dict(),
         )
         self.pubsub.publish("nodes", info.to_wire())
-        return {"ok": True}
+        # seed the joiner with the existing membership (it only receives
+        # pushes for changes after its subscription)
+        return {
+            "ok": True,
+            "nodes": [
+                n.to_wire() for n in self.nodes.values()
+                if n.state == pb.NODE_ALIVE
+            ],
+        }
 
     async def rpc_heartbeat(self, conn_id: int, payload: dict) -> dict:
         node_id = payload["node_id"]
+        if node_id not in self.nodes or self.nodes[node_id].state == pb.NODE_DEAD:
+            # no record (restarted / unpersisted control store) or declared
+            # dead during a partition: tell the daemon to re-register
+            # (node_daemon._heartbeat_loop reacts to this key)
+            return {"unknown": True}
         self.node_last_beat[node_id] = time.monotonic()
         if "available" in payload:
             self.node_available[node_id] = ResourceSet.from_wire(payload["available"])
@@ -284,6 +469,7 @@ class ControlStore:
         if info is None:
             return {"ok": False}
         info.state = pb.NODE_DRAINING
+        self._persist("node", info.to_wire())
         self.pubsub.publish("nodes", info.to_wire())
         return {"ok": True}
 
@@ -300,6 +486,10 @@ class ControlStore:
         existed = payload["key"] in ns
         if not existed or payload.get("overwrite", True):
             ns[payload["key"]] = payload["value"]
+            self._persist("kv_put", {
+                "ns": payload.get("ns", ""), "key": payload["key"],
+                "value": payload["value"],
+            })
         return {"existed": existed}
 
     async def rpc_kv_get(self, conn_id: int, payload: dict) -> dict:
@@ -308,7 +498,10 @@ class ControlStore:
 
     async def rpc_kv_del(self, conn_id: int, payload: dict) -> dict:
         ns = self.kv.get(payload.get("ns", ""), {})
-        return {"deleted": ns.pop(payload["key"], None) is not None}
+        deleted = ns.pop(payload["key"], None) is not None
+        if deleted:
+            self._persist("kv_del", {"ns": payload.get("ns", ""), "key": payload["key"]})
+        return {"deleted": deleted}
 
     async def rpc_kv_keys(self, conn_id: int, payload: dict) -> dict:
         ns = self.kv.get(payload.get("ns", ""), {})
@@ -340,6 +533,8 @@ class ControlStore:
             "start_time": time.time(),
             "finished": False,
         }
+        self._persist("job", {"job": self.jobs[job_id.binary()],
+                              "next_job": self._next_job})
         return {"job_id": job_id.binary()}
 
     async def rpc_finish_job(self, conn_id: int, payload: dict) -> dict:
@@ -347,6 +542,7 @@ class ControlStore:
         if job:
             job["finished"] = True
             job["end_time"] = time.time()
+            self._persist("job", {"job": job})
             self.pubsub.publish("jobs", job)
             # Kill detached-from-driver resources: actors owned by the job.
             for rec in list(self.actors.values()):
@@ -380,6 +576,7 @@ class ControlStore:
                     del self.actors[actor_id]
                     raise ValueError(f"Actor name {rec.name!r} already taken")
             self.named_actors[key] = actor_id
+        self._persist("actor", rec.to_persist())
         rec.pending_create = spawn(self._create_actor(rec))
         return {"ok": True}
 
@@ -413,6 +610,7 @@ class ControlStore:
             rec.worker_address = reply["worker_address"]
             rec.state = pb.ACTOR_ALIVE
             logger.info("actor %s ALIVE on %s", actor_hex, rec.worker_address)
+            self._persist_actor(rec)
             self.pubsub.publish("actors", rec.to_wire())
         except asyncio.CancelledError:
             raise
@@ -420,6 +618,7 @@ class ControlStore:
             logger.warning("actor %s creation failed: %s", actor_hex, e)
             rec.state = pb.ACTOR_DEAD
             rec.death_cause = f"creation failed: {e}"
+            self._persist_actor(rec)
             self.pubsub.publish("actors", rec.to_wire())
 
     def _pick_node_for(self, spec: TaskSpec, exclude: Set[bytes]) -> Optional[bytes]:
@@ -476,6 +675,7 @@ class ControlStore:
             dead_node = rec.node_id
             rec.worker_id = None
             rec.worker_address = ""
+            self._persist_actor(rec)
             self.pubsub.publish("actors", rec.to_wire())
             exclude = set()
             if dead_node is not None and self.nodes.get(dead_node, None) is not None:
@@ -485,6 +685,7 @@ class ControlStore:
         else:
             rec.state = pb.ACTOR_DEAD
             rec.death_cause = reason
+            self._persist_actor(rec)
             self.pubsub.publish("actors", rec.to_wire())
 
     async def rpc_get_actor_info(self, conn_id: int, payload: dict) -> dict:
@@ -531,6 +732,7 @@ class ControlStore:
         if not no_restart:
             await self._on_actor_worker_death(rec, reason)
         else:
+            self._persist_actor(rec)
             self.pubsub.publish("actors", rec.to_wire())
 
     # ------------------------------------------------------------------
@@ -547,6 +749,7 @@ class ControlStore:
             label_selector=payload.get("labels") or {},
         )
         self.placement_groups[pg_id.binary()] = rec
+        self._persist("pg", rec.to_persist())
         spawn(self._schedule_pg(rec))
         return {"ok": True}
 
@@ -597,6 +800,7 @@ class ControlStore:
             if placements is None:
                 if time.monotonic() > deadline:
                     rec.state = pb.PG_REMOVED
+                    self._persist("pg_up", rec.to_wire())
                     self.pubsub.publish("placement_groups", rec.to_wire())
                     return
                 await asyncio.sleep(0.2)
@@ -643,6 +847,7 @@ class ControlStore:
                 continue
             rec.placements = placements
             rec.state = pb.PG_CREATED
+            self._persist("pg_up", rec.to_wire())
             self.pubsub.publish("placement_groups", rec.to_wire())
             return
 
@@ -655,6 +860,7 @@ class ControlStore:
         if rec is None:
             return {"ok": False}
         rec.state = pb.PG_REMOVED
+        self._persist("pg_up", rec.to_wire())
         for nid in set(rec.placements.values()):
             try:
                 daemon = await self._daemon(nid)
@@ -665,8 +871,9 @@ class ControlStore:
         return {"ok": True}
 
 
-async def run_control_store(host: str, port: int, ready_file: Optional[str] = None):
-    store = ControlStore()
+async def run_control_store(host: str, port: int, ready_file: Optional[str] = None,
+                            persist_dir: Optional[str] = None):
+    store = ControlStore(persist_dir=persist_dir)
     addr = await store.start(host, port)
     if ready_file:
         with open(ready_file, "w") as f:
@@ -683,6 +890,7 @@ def main():
     parser.add_argument("--ready-file", default=None)
     parser.add_argument("--config-json", default="")
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument("--persist-dir", default=None)
     args = parser.parse_args()
     logging.basicConfig(
         level=os.environ.get("RT_LOG_LEVEL", args.log_level),
@@ -691,7 +899,9 @@ def main():
     if args.config_json:
         GLOBAL_CONFIG.load_overrides(args.config_json)
     try:
-        asyncio.run(run_control_store(args.host, args.port, args.ready_file))
+        asyncio.run(run_control_store(
+            args.host, args.port, args.ready_file, persist_dir=args.persist_dir
+        ))
     except KeyboardInterrupt:
         pass
 

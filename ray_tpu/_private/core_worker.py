@@ -38,8 +38,10 @@ from ray_tpu._private.errors import (
     ActorUnavailableError,
     GetTimeoutError,
     ObjectLostError,
+    ObjectStoreFullError,
     RayTpuError,
     RpcError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -256,6 +258,94 @@ class MemoryStore:
         self.locations.pop(oid, None)
 
 
+class StreamState:
+    """Owner-side state of one streaming-generator task (reference:
+    src/ray/core_worker/task_manager.h:88 ObjectRefStream).
+
+    The executor reports items strictly in order (it awaits each report ack),
+    so `produced` is a contiguous count. `consumed` advances as the user's
+    iterator takes refs; the executor blocks when produced - consumed exceeds
+    the task's backpressure threshold."""
+
+    __slots__ = ("task_id", "produced", "consumed", "next_read", "end",
+                 "waiters", "consume_waiters", "cancelled")
+
+    def __init__(self, task_id: bytes):
+        self.task_id = task_id
+        self.produced = 0
+        self.consumed = 0
+        self.next_read = 0
+        self.end: Optional[int] = None
+        self.waiters: List[asyncio.Future] = []     # item-available / end
+        self.consume_waiters: List[Tuple[int, asyncio.Future]] = []
+        self.cancelled = False
+
+    def wake_all(self):
+        for fut in self.waiters:
+            if not fut.done():
+                fut.set_result(True)
+        self.waiters.clear()
+
+    def wake_consumers(self, force: bool = False):
+        keep = []
+        for until, fut in self.consume_waiters:
+            if force or self.consumed >= until or self.cancelled or self.end is not None:
+                if not fut.done():
+                    fut.set_result(True)
+            else:
+                keep.append((until, fut))
+        self.consume_waiters = keep
+
+
+class ObjectRefGenerator:
+    """Iterator over the return refs of a `num_returns="streaming"` task.
+
+    Reference: python/ray/_raylet.pyx ObjectRefGenerator. Sync iteration from
+    driver threads; async iteration inside async actors. Dropping the
+    generator cancels the producer and frees unconsumed items. Not
+    serializable — consume it in the owning process."""
+
+    def __init__(self, cw: "CoreWorker", task_id: bytes):
+        self._cw = cw
+        self._task_id = task_id
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        ref = self._cw.run_sync(self._cw.stream_next(self._task_id))
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        ref = await self._cw.stream_next(self._task_id)
+        if ref is None:
+            raise StopAsyncIteration
+        return ref
+
+    def completed(self) -> bool:
+        st = self._cw._streams.get(self._task_id)
+        return st is None or (st.end is not None and st.next_read >= st.end)
+
+    def __reduce__(self):
+        raise TypeError(
+            "ObjectRefGenerator is not serializable; iterate it in the "
+            "process that created it"
+        )
+
+    def __del__(self):
+        cw = self._cw
+        if cw is not None and not cw._closed and self._task_id in cw._streams:
+            try:
+                cw.schedule(cw.stream_drop(self._task_id))
+            except Exception:  # noqa: BLE001 — interpreter shutdown
+                pass
+
+
 class ActorHandleState:
     """Caller-side per-actor submission state (reference:
     actor_task_submitter.h:69 — ordered sequence numbers, address cache)."""
@@ -319,6 +409,12 @@ class CoreWorker:
         self._actor_index = 0
         self._lock = threading.Lock()
         # submitter state
+        self._streams: Dict[bytes, StreamState] = {}
+        # task-id -> {"state", "worker", "cancelled", "atask", "return_oids",
+        # "spec"} for ray_tpu.cancel (reference: normal_task_submitter
+        # CancelTask / actor_task_submitter queued-task cancellation)
+        self._submissions: Dict[bytes, dict] = {}
+        self._return_to_task: Dict[bytes, bytes] = {}
         self._actor_states: Dict[bytes, ActorHandleState] = {}
         self._owned_actor_handles: Dict[bytes, int] = {}
         self._bg_futures: set = set()
@@ -342,6 +438,10 @@ class CoreWorker:
         await self.daemon.connect()
         self.control.subscribe_channel("actors", self._on_actor_update)
         await self.control.call("subscribe", {"channel": "actors"})
+        # a restarted control store loses server-side subscription state
+        self.control.on_reconnect(
+            lambda: self.control.call("subscribe", {"channel": "actors"})
+        )
 
     async def close(self):
         self._closed = True
@@ -428,7 +528,7 @@ class CoreWorker:
         if sobj.total_bytes <= self._inline_max:
             self.memory_store.put(oid.binary(), sobj.to_bytes(), META_NORMAL)
         else:
-            view = self.store.create(oid, sobj.total_bytes)
+            view = await self._create_with_spill(oid, sobj.total_bytes)
             sobj.write_into(view)
             view.release()
             self.store.seal(oid)
@@ -489,22 +589,42 @@ class CoreWorker:
 
     async def _read_store_object(self, ref: ObjectRef, location: dict, deadline) -> Any:
         oid = ref.object_id()
-        if not self.store.contains(oid):
-            # remote: ask local daemon to pull into our node's store
-            remote_daemon = location["daemon"]
-            if location.get("node_id") != self.node_id_hex:
+        is_local = location.get("node_id") == self.node_id_hex
+        pulled = False
+        # Pin-or-recover loop: between any check and the pinning get() the
+        # spill loop may write the object to disk and delete it from shm, so
+        # a one-shot contains()/restore decision can hang forever. Each miss
+        # retries the applicable recovery (remote pull / spill restore) until
+        # the pin lands or the deadline passes.
+        last_restore = 0.0
+        while True:
+            res = self.store.get(oid)  # pins on success
+            if res is not None:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(f"get() timed out materializing {ref.hex()}")
+            if not is_local and not pulled:
                 reply = await self.daemon.call(
                     "pull_object",
-                    {"object_id": oid.binary(), "from_address": remote_daemon},
+                    {"object_id": oid.binary(), "from_address": location["daemon"]},
                     timeout=None if deadline is None else max(0.1, deadline - time.monotonic()),
                 )
                 if not reply.get("ok"):
                     raise ObjectLostError(ref.hex(), reply.get("error", "pull failed"))
-        res = self.store.get_blocking(
-            oid, timeout=None if deadline is None else max(0.0, deadline - time.monotonic())
-        )
-        if res is None:
-            raise GetTimeoutError(f"get() timed out materializing {ref.hex()}")
+                pulled = True
+                continue
+            # local (or already pulled): possibly spilled to disk. Throttle
+            # the restore RPC — the common miss is a producer mid-seal, which
+            # the cheap local shm poll below picks up without daemon traffic.
+            now = time.monotonic()
+            if now - last_restore > 0.2:
+                last_restore = now
+                reply = await self.daemon.call(
+                    "restore_object", {"object_id": oid.binary()}, timeout=30
+                )
+                if reply.get("ok"):
+                    continue
+            await asyncio.sleep(0.002)
         view, meta = res
         if meta == META_ERROR:
             try:
@@ -590,6 +710,146 @@ class CoreWorker:
     async def rpc_remove_borrow(self, conn_id: int, payload: dict) -> dict:
         self.ref_counter.remove_borrower(payload["object_id"])
         return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # streaming generators — owner side (reference: task_manager.h:88
+    # ObjectRefStream + core_worker.proto ReportGeneratorItemReturns)
+    # ------------------------------------------------------------------
+
+    def _record_return_entry(self, ret: dict):
+        oid = ret["object_id"]
+        if ret.get("inline") is not None:
+            self.memory_store.put(oid, ret["inline"], ret.get("meta", META_NORMAL))
+        else:
+            self.memory_store.set_location(oid, ret["location"])
+
+    def _stream_end(self, tid: bytes, total: int):
+        st = self._streams.get(tid)
+        if st is None or st.end is not None:
+            return
+        st.produced = max(st.produced, total)
+        st.end = st.produced
+        st.wake_all()
+        st.wake_consumers()
+
+    async def rpc_report_stream_item(self, conn_id: int, payload: dict) -> dict:
+        tid = payload["task_id"]
+        st = self._streams.get(tid)
+        if st is None or st.cancelled:
+            return {"cancelled": True, "consumed": 0}
+        self._record_return_entry(payload["ret"])
+        st.produced = max(st.produced, payload["index"] + 1)
+        st.wake_all()
+        return {"cancelled": False, "consumed": st.consumed}
+
+    async def rpc_stream_wait_consumed(self, conn_id: int, payload: dict) -> dict:
+        """Executor-side backpressure: block until the consumer has taken
+        `until` items (or the stream is cancelled/dropped)."""
+        tid = payload["task_id"]
+        st = self._streams.get(tid)
+        if st is None or st.cancelled or st.consumed >= payload["until"]:
+            return {"cancelled": st is None or st.cancelled, "consumed": 0 if st is None else st.consumed}
+        fut = self.loop.create_future()
+        st.consume_waiters.append((payload["until"], fut))
+        await fut
+        st2 = self._streams.get(tid)
+        return {
+            "cancelled": st2 is None or st2.cancelled,
+            "consumed": 0 if st2 is None else st2.consumed,
+        }
+
+    async def stream_next(self, tid: bytes) -> Optional["ObjectRef"]:
+        """Next item ref, or None when the stream is exhausted. The end is
+        signalled by a sentinel (not an exception) because raising through
+        run_coroutine_threadsafe chains tracebacks into a Task↔exception
+        reference cycle that pins caller frames until a full GC."""
+        st = self._streams.get(tid)
+        if st is None:
+            return None
+        while True:
+            if st.next_read < st.produced:
+                idx = st.next_read
+                st.next_read += 1
+                st.consumed += 1
+                st.wake_consumers()
+                oid = ObjectID.for_task_return(TaskID(tid), idx)
+                return ObjectRef(oid, self.address, self.worker_id.binary())
+            if st.cancelled or (st.end is not None and st.next_read >= st.end):
+                return None
+            fut = self.loop.create_future()
+            st.waiters.append(fut)
+            await fut
+
+    async def stream_drop(self, tid: bytes):
+        """Generator GC'd: cancel the producer, release backpressure waiters,
+        and free unconsumed item objects."""
+        st = self._streams.pop(tid, None)
+        if st is None:
+            return
+        st.cancelled = True
+        st.wake_all()
+        st.wake_consumers()
+        try:
+            await self.cancel_task_by_id(tid, force=False)
+        except Exception:  # noqa: BLE001 — producer may have finished already
+            pass
+        for idx in range(st.next_read, st.produced):
+            oid = ObjectID.for_task_return(TaskID(tid), idx)
+            await self.free_owned_object(oid)
+
+    # ------------------------------------------------------------------
+    # task cancellation (reference: core_worker.proto CancelTask,
+    # normal_task_submitter.cc CancelTask)
+    # ------------------------------------------------------------------
+
+    async def cancel_task(self, ref: "ObjectRef", force: bool = False,
+                          recursive: bool = False) -> bool:
+        tid = self._return_to_task.get(ref.binary())
+        if tid is None:
+            return False
+        return await self.cancel_task_by_id(tid, force=force)
+
+    async def cancel_task_by_id(self, tid: bytes, force: bool = False) -> bool:
+        sub = self._submissions.get(tid)
+        if sub is None:
+            return False
+        sub["cancelled"] = True
+        spec: TaskSpec = sub["spec"]
+        if spec.is_streaming:
+            # Mark the owner-side stream cancelled and release both waiter
+            # groups: a producer parked in stream_wait_consumed (or its next
+            # report_stream_item) sees cancelled and aborts; the consumer
+            # drains already-produced items and then stops.
+            st = self._streams.get(tid)
+            if st is not None:
+                st.cancelled = True
+                st.wake_all()
+                st.wake_consumers(force=True)
+        if sub["state"] == "running" and sub["worker"]:
+            try:
+                client = await self._worker_client(sub["worker"])
+                await client.call(
+                    "cancel_task", {"task_id": tid, "force": force}, timeout=10
+                )
+            except Exception:  # noqa: BLE001 — worker already gone
+                pass
+            # the running push_task reply (an error for a cancelled task)
+            # resolves the returns; force-kill resolves via the retry loop
+            # seeing the cancelled flag
+        elif spec.kind == pb.TASK_KIND_ACTOR_TASK:
+            # queued actor task: do NOT hard-cancel the submit coroutine — it
+            # must still deliver a tombstone for its sequence slot (see
+            # _submit_actor_with_retries)
+            pass
+        else:
+            sub["atask"].cancel()
+        return True
+
+    # executor side: delegate to the task executor
+    async def rpc_cancel_task(self, conn_id: int, payload: dict) -> dict:
+        if self.executor is None:
+            return {"ok": False}
+        return self.executor.cancel(payload["task_id"], payload.get("force", False))
 
     async def notify_owner(self, owner_address: str, method: str, oid: bytes):
         if owner_address == self.address:
@@ -680,7 +940,8 @@ class CoreWorker:
         max_retries: Optional[int] = None,
         name: str = "",
         runtime_env: Optional[dict] = None,
-    ) -> List[ObjectRef]:
+        stream_backpressure: int = -1,
+    ):
         task_id = self.next_task_id()
         wire_args = await self.serialize_args(args, kwargs)
         pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
@@ -701,38 +962,94 @@ class CoreWorker:
             owner_address=self.address,
             name=name,
             runtime_env=runtime_env or {},
+            stream_backpressure=stream_backpressure,
         )
         refs = [
             ObjectRef(oid, self.address, self.worker_id.binary())
             for oid in spec.return_ids()
         ]
-        spawn(self._submit_with_retries(spec, pyrefs))
+        if spec.is_streaming:
+            self._streams[task_id.binary()] = StreamState(task_id.binary())
+        atask = spawn(self._submit_with_retries(spec, pyrefs))
+        self._track_submission(spec, atask)
+        if spec.is_streaming:
+            return ObjectRefGenerator(self, task_id.binary())
         return refs
+
+    def _track_submission(self, spec: TaskSpec, atask: asyncio.Task):
+        tid = spec.task_id.binary()
+        entry = {
+            "state": "pending", "worker": "", "cancelled": False,
+            "atask": atask, "spec": spec,
+        }
+        self._submissions[tid] = entry
+        for oid in spec.return_ids():
+            self._return_to_task[oid.binary()] = tid
+        atask.add_done_callback(lambda _t: self._untrack_submission(spec))
+
+    def _untrack_submission(self, spec: TaskSpec):
+        self._submissions.pop(spec.task_id.binary(), None)
+        for oid in spec.return_ids():
+            self._return_to_task.pop(oid.binary(), None)
+
+    def _fail_task(self, spec: TaskSpec, exc: Exception):
+        """Resolve every return of a task (fixed or streaming) to an error."""
+        for oid in spec.return_ids():
+            self.memory_store.fail(oid.binary(), exc)
+        if spec.is_streaming:
+            self._stream_fail(spec.task_id.binary(), exc)
+
+    def _stream_fail(self, tid: bytes, exc: Exception):
+        """Terminate a stream with a trailing error item so iteration raises
+        (at get of the final ref) instead of hanging."""
+        st = self._streams.get(tid)
+        if st is None or st.end is not None:
+            return
+        oid = ObjectID.for_task_return(TaskID(tid), st.produced)
+        self.memory_store.fail(oid.binary(), exc)
+        st.produced += 1
+        st.end = st.produced
+        st.wake_all()
+        st.wake_consumers()
 
     async def _submit_with_retries(self, spec: TaskSpec, keepalive):
         retries = spec.max_retries
         attempt = 0
+        sub = None
         while True:
+            sub = self._submissions.get(spec.task_id.binary())
+            if sub is not None and sub["cancelled"]:
+                self._fail_task(spec, TaskCancelledError(
+                    f"task {spec.name or spec.function_key} was cancelled"))
+                return
             try:
                 await self._submit_once(spec)
                 return
+            except asyncio.CancelledError:
+                # ray_tpu.cancel() of a queued/leasing task cancels this
+                # coroutine; resolve the returns so get() raises
+                self._fail_task(spec, TaskCancelledError(
+                    f"task {spec.name or spec.function_key} was cancelled"))
+                raise
             except (WorkerCrashedError, RpcError, ConnectionError, asyncio.TimeoutError) as e:
+                if sub is not None and sub["cancelled"]:
+                    self._fail_task(spec, TaskCancelledError(
+                        f"task {spec.name or spec.function_key} was cancelled"))
+                    return
                 attempt += 1
                 if attempt > retries:
-                    for oid in spec.return_ids():
-                        self.memory_store.fail(
-                            oid.binary(),
-                            WorkerCrashedError(
-                                f"task {spec.name or spec.function_key} failed after "
-                                f"{retries} retries: {e}"
-                            ),
-                        )
+                    self._fail_task(
+                        spec,
+                        WorkerCrashedError(
+                            f"task {spec.name or spec.function_key} failed after "
+                            f"{retries} retries: {e}"
+                        ),
+                    )
                     return
                 logger.info("retrying task %s (attempt %d): %s", spec.name, attempt, e)
                 await asyncio.sleep(min(0.2 * (2 ** attempt), 5.0))
             except Exception as e:  # noqa: BLE001 — scheduling-level failure
-                for oid in spec.return_ids():
-                    self.memory_store.fail(oid.binary(), RayTpuError(f"submit failed: {e}"))
+                self._fail_task(spec, RayTpuError(f"submit failed: {e}"))
                 return
         # `keepalive` pins arg refs for the life of this coroutine.
 
@@ -741,6 +1058,10 @@ class CoreWorker:
         worker_addr = lease["worker_address"]
         lease_id = lease["lease_id"]
         daemon_addr = lease["daemon_address"]
+        sub = self._submissions.get(spec.task_id.binary())
+        if sub is not None:
+            sub["state"] = "running"
+            sub["worker"] = worker_addr
         try:
             client = await self._worker_client(worker_addr)
             reply = await client.call("push_task", {"spec": spec.to_wire()}, timeout=None)
@@ -765,15 +1086,15 @@ class CoreWorker:
                     exc = self._deserialize_error(err["pickled"])
                 except Exception:  # noqa: BLE001
                     pass
-            for oid in spec.return_ids():
-                self.memory_store.fail(oid.binary(), exc)
+            self._fail_task(spec, exc)
+            return
+        if spec.is_streaming:
+            # items flowed via report_stream_item; the final reply closes the
+            # stream (backup in case the last report raced the reply)
+            self._stream_end(spec.task_id.binary(), reply.get("stream_end", 0))
             return
         for ret in reply["returns"]:
-            oid = ret["object_id"]
-            if ret.get("inline") is not None:
-                self.memory_store.put(oid, ret["inline"], ret.get("meta", META_NORMAL))
-            else:
-                self.memory_store.set_location(oid, ret["location"])
+            self._record_return_entry(ret)
 
     async def _acquire_lease(self, spec: TaskSpec) -> dict:
         address = self.daemon_address
@@ -781,12 +1102,22 @@ class CoreWorker:
         last_warn = 0.0
         while True:
             client = await self._owner_client(address)
-            reply = await client.call("request_lease", {
+            inner = spawn(client.call("request_lease", {
                 "resources": spec.resources.to_wire(),
                 "strategy": spec.strategy.to_wire(),
                 "job_id": self.job_id.binary(),
                 "hops": hops,
-            }, timeout=None)
+            }, timeout=None))
+            try:
+                reply = await asyncio.shield(inner)
+            except asyncio.CancelledError:
+                # ray_tpu.cancel() of a queued task: the daemon may still
+                # grant this request later — return that orphan lease so its
+                # resources don't leak
+                inner.add_done_callback(
+                    functools.partial(self._return_orphan_lease, address)
+                )
+                raise
             if reply.get("granted"):
                 reply["daemon_address"] = address
                 return reply
@@ -815,6 +1146,20 @@ class CoreWorker:
                 address = self.daemon_address
                 continue
             raise RayTpuError(f"lease request failed: {reply}")
+
+    def _return_orphan_lease(self, daemon_address: str, t: asyncio.Task):
+        if t.cancelled() or t.exception() is not None:
+            return
+        reply = t.result()
+        if reply.get("granted"):
+            self.schedule(self._return_lease_quiet(daemon_address, reply["lease_id"]))
+
+    async def _return_lease_quiet(self, daemon_address: str, lease_id):
+        try:
+            client = await self._owner_client(daemon_address)
+            await client.call("return_lease", {"lease_id": lease_id}, timeout=5)
+        except Exception:  # noqa: BLE001 — daemon may be gone
+            pass
 
     async def _worker_client(self, address: str) -> RpcClient:
         client = self._worker_clients.get(address)
@@ -932,7 +1277,8 @@ class CoreWorker:
         kwargs: dict,
         num_returns: int = 1,
         max_task_retries: int = 0,
-    ) -> List[ObjectRef]:
+        stream_backpressure: int = -1,
+    ):
         st = self._actor_state(actor_id)
         task_id = TaskID.for_actor_task(
             self.job_id, ActorID(actor_id), self.current_task_id, self._next_seq(st)
@@ -952,12 +1298,18 @@ class CoreWorker:
             seq_no=st.seq,
             incarnation=st.incarnation,
             name=method_name,
+            stream_backpressure=stream_backpressure,
         )
         refs = [
             ObjectRef(oid, self.address, self.worker_id.binary())
             for oid in spec.return_ids()
         ]
-        spawn(self._submit_actor_with_retries(st, spec, max_task_retries, pyrefs))
+        if spec.is_streaming:
+            self._streams[task_id.binary()] = StreamState(task_id.binary())
+        atask = spawn(self._submit_actor_with_retries(st, spec, max_task_retries, pyrefs))
+        self._track_submission(spec, atask)
+        if spec.is_streaming:
+            return ObjectRefGenerator(self, task_id.binary())
         return refs
 
     def _next_seq(self, st: ActorHandleState) -> int:
@@ -968,6 +1320,14 @@ class CoreWorker:
                                          max_task_retries: int, keepalive):
         attempt = 0
         while True:
+            sub = self._submissions.get(spec.task_id.binary())
+            if sub is not None and sub["cancelled"]:
+                # Push a tombstone instead of dropping the spec: its sequence
+                # slot must advance on the executor or every later task from
+                # this caller stalls on the hole (ordered actors never
+                # reorder). The executor replies TaskCancelledError without
+                # running the method.
+                spec.cancelled = True
             try:
                 await self.wait_actor_alive(st.actor_id)
                 if spec.incarnation != st.incarnation:
@@ -981,14 +1341,26 @@ class CoreWorker:
                     st.client = RpcClient(st.address, name="to-actor", retries=0)
                     await st.client.connect()
                 client = st.client
+                if sub is not None:
+                    if sub["cancelled"]:
+                        spec.cancelled = True  # flag set while waiting above
+                    sub["state"] = "running"
+                    sub["worker"] = st.address
                 reply = await client.call("push_task", {"spec": spec.to_wire()}, timeout=None)
                 self._record_task_reply(spec, reply)
                 return
+            except asyncio.CancelledError:
+                self._fail_task(spec, TaskCancelledError(
+                    f"actor task {spec.method_name} was cancelled"))
+                raise
             except (ActorDiedError, ActorUnavailableError) as e:
-                for oid in spec.return_ids():
-                    self.memory_store.fail(oid.binary(), e)
+                self._fail_task(spec, e)
                 return
             except (RpcError, ConnectionError, asyncio.TimeoutError) as e:
+                if sub is not None and sub["cancelled"]:
+                    self._fail_task(spec, TaskCancelledError(
+                        f"actor task {spec.method_name} was cancelled"))
+                    return
                 attempt += 1
                 if st.state == pb.ACTOR_ALIVE:
                     # connection died but no death report yet: nudge state
@@ -998,15 +1370,14 @@ class CoreWorker:
                     if reply["actor"]:
                         self._on_actor_update(reply["actor"])
                 if attempt > max_task_retries:
-                    for oid in spec.return_ids():
-                        self.memory_store.fail(
-                            oid.binary(),
-                            ActorUnavailableError(
-                                f"actor task {spec.method_name} failed: {e}"
-                            ) if st.state != pb.ACTOR_DEAD else ActorDiedError(
-                                f"actor died: {st.death_cause or e}"
-                            ),
-                        )
+                    self._fail_task(
+                        spec,
+                        ActorUnavailableError(
+                            f"actor task {spec.method_name} failed: {e}"
+                        ) if st.state != pb.ACTOR_DEAD else ActorDiedError(
+                            f"actor died: {st.death_cause or e}"
+                        ),
+                    )
                     return
                 await asyncio.sleep(min(0.2 * (2 ** attempt), 5.0))
 
@@ -1077,13 +1448,23 @@ class CoreWorker:
             return self._materialize(reply["data"], reply["meta"], copy_buffers=True)
         return await self._read_store_object(ref, reply["location"], None)
 
-    def store_return(self, oid: ObjectID, sobj: ser.SerializedObject,
-                     meta: int = META_NORMAL) -> dict:
+    async def _create_with_spill(self, oid: ObjectID, size: int,
+                                 meta: int = META_NORMAL) -> memoryview:
+        """create() with one retry after asking the daemon to spill — a burst
+        of seals can outrun the proactive spill loop."""
+        try:
+            return self.store.create(oid, size, meta)
+        except ObjectStoreFullError:
+            await self.daemon.call("spill_now", {"need_bytes": size}, timeout=120)
+            return self.store.create(oid, size, meta)
+
+    async def store_return(self, oid: ObjectID, sobj: ser.SerializedObject,
+                           meta: int = META_NORMAL) -> dict:
         """Store one return value; small→inline reply, large→local shm."""
         if sobj.total_bytes <= self._inline_max:
             return {"object_id": oid.binary(), "inline": sobj.to_bytes(), "meta": meta}
         try:
-            view = self.store.create(oid, sobj.total_bytes, metadata=meta)
+            view = await self._create_with_spill(oid, sobj.total_bytes, meta)
             sobj.write_into(view)
             view.release()
             self.store.seal(oid)

@@ -57,6 +57,12 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing a task died unexpectedly."""
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel() (reference:
+    python/ray/exceptions.py TaskCancelledError) — raised by `get()` on any of
+    the cancelled task's return refs and inside a cancelled running task."""
+
+
 class ActorError(RayTpuError):
     pass
 

@@ -58,6 +58,7 @@ def start_control_store(session_dir: str, port: int = 0) -> tuple:
             sys.executable, "-m", "ray_tpu._private.control_store",
             "--port", str(port), "--ready-file", ready,
             "--config-json", GLOBAL_CONFIG.serialize_overrides(),
+            "--persist-dir", os.path.join(session_dir, "control_store"),
         ],
         stdout=log, stderr=subprocess.STDOUT, start_new_session=True,
     )

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import protocol as pb
 from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.errors import ObjectStoreFullError
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ray_tpu._private.protocol import NodeInfo, ResourceSet, TaskSpec
 from ray_tpu.runtime.object_store import ShmObjectStore
@@ -147,6 +148,13 @@ class NodeDaemon:
         self._stopped = False
         self._draining = False
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        # spilled objects: oid bytes -> (path, metadata, size). Reference:
+        # raylet local_object_manager.h:45 spill/restore of primary copies.
+        self.spilled: Dict[bytes, Tuple[str, int, int]] = {}
+        self.spill_dir = os.path.join(
+            session_dir, "spill", self.node_id.hex()[:12]
+        )
+        self._spill_lock: Optional[asyncio.Lock] = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -170,9 +178,24 @@ class NodeDaemon:
             resources=self.total_resources,
             labels=self.labels,
         )
-        await self.control.call("register_node", {"node": info.to_wire()})
+        self._node_info = info
+        # Event-driven peer discovery: node registrations/deaths push over
+        # the "nodes" channel, so the scheduler's cluster view is populated
+        # at member-change time instead of waiting for heartbeat gossip
+        # (reference: GcsNodeManager node add/removed pubsub).
+        self.control.subscribe_channel("nodes", self._on_node_update)
+        await self.control.call("subscribe", {"channel": "nodes"})
+        self.control.on_reconnect(
+            lambda: self.control.call("subscribe", {"channel": "nodes"})
+        )
+        reg = await self.control.call("register_node", {"node": info.to_wire()})
+        for nw in reg.get("nodes", []):
+            self._on_node_update(nw)
         self._tasks.append(spawn(self._heartbeat_loop()))
         self._tasks.append(spawn(self._reap_loop()))
+        if GLOBAL_CONFIG.get("object_spill_enabled"):
+            os.makedirs(self.spill_dir, exist_ok=True)
+            self._tasks.append(spawn(self._spill_loop()))
         for _ in range(GLOBAL_CONFIG.get("worker_pool_prestart")):
             spawn(self._spawn_worker(job_id=b"", reserve=False))
         logger.info(
@@ -195,6 +218,20 @@ class NodeDaemon:
         if self.store:
             self.store.destroy()
 
+    def _on_node_update(self, message: dict):
+        info = NodeInfo.from_wire(message)
+        hexid = info.node_id.hex()
+        if hexid == self.node_id.hex():
+            return
+        if info.state == pb.NODE_ALIVE:
+            self.peer_nodes[hexid] = info
+            # seed with total resources; the next gossip beat corrects it
+            self.cluster_view.setdefault(hexid, info.resources)
+            self._try_schedule()
+        else:
+            self.peer_nodes.pop(hexid, None)
+            self.cluster_view.pop(hexid, None)
+
     async def _heartbeat_loop(self):
         period = GLOBAL_CONFIG.get("health_check_period_s")
         while not self._stopped:
@@ -207,6 +244,13 @@ class NodeDaemon:
                     },
                     timeout=period * 5,
                 )
+                if reply.get("unknown"):
+                    # the control store restarted without (or before) our
+                    # record: re-register so the cluster view includes us
+                    await self.control.call(
+                        "register_node", {"node": self._node_info.to_wire()}
+                    )
+                    continue
                 self.cluster_view = {
                     nid: ResourceSet.from_wire(w)
                     for nid, w in reply.get("view", {}).items()
@@ -710,11 +754,144 @@ class NodeDaemon:
         return {"ok": True}
 
     # ------------------------------------------------------------------
+    # object spilling (reference: raylet local_object_manager.h:45 —
+    # SpillObjects under memory pressure, restore on demand)
+    # ------------------------------------------------------------------
+
+    async def _spill_loop(self):
+        """Spill cold sealed objects to disk when the store passes the
+        high-water mark, down to the low-water mark, so in-store eviction
+        (which destroys data) rarely has to fire."""
+        period = GLOBAL_CONFIG.get("object_spill_check_period_s")
+        high = GLOBAL_CONFIG.get("object_spill_high_water")
+        low = GLOBAL_CONFIG.get("object_spill_low_water")
+        while not self._stopped:
+            await asyncio.sleep(period)
+            try:
+                st = self.store.stats()
+                if st["heap_size"] and st["bytes_in_use"] / st["heap_size"] > high:
+                    target = int(st["heap_size"] * low)
+                    await self._spill_down_to(target)
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.exception("spill loop iteration failed")
+
+    async def _spill_down_to(self, target_bytes: int):
+        if self._spill_lock is None:
+            self._spill_lock = asyncio.Lock()
+        async with self._spill_lock:
+            spilled_bytes = 0
+            for oid, size in self.store.list_evictable(max_n=512):
+                st = self.store.stats()
+                if st["bytes_in_use"] <= target_bytes:
+                    break
+                if await self._spill_one(oid):
+                    spilled_bytes += size
+            if spilled_bytes:
+                logger.info(
+                    "spilled %.1f MiB to %s (%d objects on disk)",
+                    spilled_bytes / 2**20, self.spill_dir, len(self.spilled),
+                )
+
+    async def rpc_spill_now(self, conn_id: int, payload: dict) -> dict:
+        """Synchronous spill request from a worker whose create() hit
+        ObjectStoreFullError (reference: raylet triggers spilling when a
+        plasma allocation stalls)."""
+        need = payload.get("need_bytes", 0)
+        st = self.store.stats()
+        low = GLOBAL_CONFIG.get("object_spill_low_water")
+        target = min(
+            int(st["heap_size"] * low),
+            max(0, st["bytes_in_use"] - need),
+        )
+        await self._spill_down_to(target)
+        return {"ok": True}
+
+    @staticmethod
+    def _write_file(path: str, view: memoryview):
+        with open(path, "wb") as f:
+            f.write(view)
+
+    async def _spill_one(self, oid: ObjectID) -> bool:
+        res = self.store.get(oid)  # pins
+        if res is None:
+            return False
+        view, meta = res
+        path = os.path.join(self.spill_dir, oid.hex())
+        try:
+            size = len(view)
+            # thread: a multi-GiB write must not stall heartbeats/leases
+            # (the pin keeps the view valid across the await)
+            await asyncio.to_thread(self._write_file, path, view)
+        finally:
+            view.release()
+            self.store.release(oid)
+        if not self.store.delete(oid):
+            # someone pinned it between our release and delete; keep it in
+            # store, drop the file
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        self.spilled[oid.binary()] = (path, meta, size)
+        return True
+
+    async def _create_making_room(self, oid: ObjectID, size: int, meta: int):
+        """store.create with one retry after spilling `size` bytes of cold
+        objects (shared by restore and pull)."""
+        try:
+            return self.store.create(oid, size, metadata=meta)
+        except ObjectStoreFullError:
+            st = self.store.stats()
+            await self._spill_down_to(max(0, st["bytes_in_use"] - size))
+            return self.store.create(oid, size, metadata=meta)
+
+    async def _restore_object(self, oid: ObjectID) -> bool:
+        """Bring a spilled object back into the shm store (spilling other
+        cold objects out if the store is full)."""
+        rec = self.spilled.get(oid.binary())
+        if rec is None:
+            return self.store.contains(oid)
+        path, meta, _size = rec
+        if not self.store.contains(oid):
+            def read_file():
+                with open(path, "rb") as f:
+                    return f.read()
+
+            try:
+                data = await asyncio.to_thread(read_file)
+            except OSError:
+                return False
+            try:
+                view = await self._create_making_room(oid, len(data), meta)
+                view[:] = data
+                view.release()
+                self.store.seal(oid)
+            except FileExistsError:
+                pass
+        self.spilled.pop(oid.binary(), None)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return True
+
+    async def rpc_restore_object(self, conn_id: int, payload: dict) -> dict:
+        oid = ObjectID(payload["object_id"])
+        if self.store.contains(oid):
+            return {"ok": True}
+        if oid.binary() in self.spilled:
+            return {"ok": await self._restore_object(oid)}
+        return {"ok": False, "unknown": True}
+
+    # ------------------------------------------------------------------
     # object transfer (reference: object_manager.h:137, pull_manager.h:52)
     # ------------------------------------------------------------------
 
     async def rpc_fetch_object_info(self, conn_id: int, payload: dict) -> dict:
         oid = ObjectID(payload["object_id"])
+        if oid.binary() in self.spilled:
+            await self._restore_object(oid)
         res = self.store.get(oid)
         if res is None:
             return {"found": False}
@@ -726,6 +903,8 @@ class NodeDaemon:
 
     async def rpc_fetch_chunk(self, conn_id: int, payload: dict) -> dict:
         oid = ObjectID(payload["object_id"])
+        if oid.binary() in self.spilled:
+            await self._restore_object(oid)
         res = self.store.get(oid)
         if res is None:
             return {"found": False}
@@ -742,6 +921,9 @@ class NodeDaemon:
         oid = ObjectID(payload["object_id"])
         if self.store.contains(oid):
             return {"ok": True}
+        if oid.binary() in self.spilled:
+            # pulled previously, then spilled: restore from local disk
+            return {"ok": await self._restore_object(oid)}
         key = oid.binary()
         fut = self._pulls_inflight.get(key)
         if fut is None:
@@ -775,7 +957,7 @@ class NodeDaemon:
         size, meta = info["size"], info["metadata"]
         chunk = GLOBAL_CONFIG.get("object_chunk_bytes")
         try:
-            view = self.store.create(oid, size, metadata=meta)
+            view = await self._create_making_room(oid, size, meta)
         except FileExistsError:
             return
         # Parallel chunk fetch (reference: push_manager chunking).
@@ -804,10 +986,19 @@ class NodeDaemon:
     async def rpc_free_objects(self, conn_id: int, payload: dict) -> dict:
         for ob in payload["object_ids"]:
             self.store.delete(ObjectID(ob))
+            rec = self.spilled.pop(ob, None)
+            if rec is not None:
+                try:
+                    os.unlink(rec[0])
+                except OSError:
+                    pass
         return {"ok": True}
 
     async def rpc_store_stats(self, conn_id: int, payload) -> dict:
-        return self.store.stats()
+        st = self.store.stats()
+        st["spilled_objects"] = len(self.spilled)
+        st["spilled_bytes"] = sum(r[2] for r in self.spilled.values())
+        return st
 
     async def rpc_node_info(self, conn_id: int, payload) -> dict:
         return {

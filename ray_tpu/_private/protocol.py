@@ -152,6 +152,10 @@ TASK_KIND_NORMAL = 0
 TASK_KIND_ACTOR_CREATION = 1
 TASK_KIND_ACTOR_TASK = 2
 
+# Sentinel num_returns for `num_returns="streaming"` tasks (reference:
+# python/ray/_raylet.pyx streaming generator protocol).
+NUM_RETURNS_STREAMING = -2
+
 
 @dataclass
 class TaskSpec:
@@ -191,8 +195,23 @@ class TaskSpec:
     is_async_actor: bool = False
     runtime_env: dict = field(default_factory=dict)
     name: str = ""
+    # streaming generators: num_returns == NUM_RETURNS_STREAMING; executor
+    # reports each yielded item to the owner and pauses when more than
+    # `stream_backpressure` items are unconsumed (-1 = unbounded). Reference:
+    # _generator_backpressure_num_objects in common.proto:510.
+    stream_backpressure: int = -1
+    # tombstone: an ordered actor task cancelled before delivery is still
+    # pushed (with this flag) so its sequence slot advances on the executor
+    # instead of leaving a hole that stalls successors.
+    cancelled: bool = False
+
+    @property
+    def is_streaming(self) -> bool:
+        return self.num_returns == NUM_RETURNS_STREAMING
 
     def return_ids(self) -> List[ObjectID]:
+        if self.is_streaming:
+            return []
         return [
             ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
         ]
@@ -221,6 +240,8 @@ class TaskSpec:
             "is_async_actor": self.is_async_actor,
             "runtime_env": self.runtime_env,
             "name": self.name,
+            "stream_backpressure": self.stream_backpressure,
+            "cancelled": self.cancelled,
         }
 
     @classmethod
@@ -248,6 +269,8 @@ class TaskSpec:
             is_async_actor=w.get("is_async_actor", False),
             runtime_env=w.get("runtime_env") or {},
             name=w.get("name", ""),
+            stream_backpressure=w.get("stream_backpressure", -1),
+            cancelled=w.get("cancelled", False),
         )
 
 

@@ -22,8 +22,10 @@ and the Python seam _raylet.pyx:2540 task_execution_handler /
 from __future__ import annotations
 
 import asyncio
+import ctypes
 import inspect
 import logging
+import os
 import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
@@ -31,10 +33,14 @@ from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private import protocol as pb
 from ray_tpu._private import serialization as ser
-from ray_tpu._private.errors import TaskError
+from ray_tpu._private.errors import TaskCancelledError, TaskError
+from ray_tpu._private.ids import ObjectID
 from ray_tpu.runtime.object_store import META_NORMAL
 
 logger = logging.getLogger(__name__)
+
+_STREAM_END = object()  # sentinel: sync generator exhausted (StopIteration
+# cannot cross run_in_executor futures cleanly)
 
 
 class _StaleSequenceError(Exception):
@@ -59,6 +65,51 @@ class TaskExecutor:
         self._reply_cache: "OrderedDict[bytes, dict]" = OrderedDict()
         self._in_flight: Dict[bytes, asyncio.Future] = {}
         self._exec_lock = asyncio.Lock()
+        # cancellation state (reference: core_worker.proto CancelTask +
+        # _raylet.pyx execute_task_with_cancellation_handler)
+        self._cancelled: set = set()
+        self._running_threads: Dict[bytes, int] = {}   # task id -> thread ident
+        self._running_atasks: Dict[bytes, asyncio.Task] = {}
+
+    # ------------------------------------------------------------------
+    # cancellation
+    # ------------------------------------------------------------------
+
+    def cancel(self, tid: bytes, force: bool = False) -> dict:
+        """Cancel a queued or running task on this worker.
+
+        Sync tasks get TaskCancelledError raised asynchronously into their
+        executor thread (the reference raises KeyboardInterrupt into the
+        worker main thread); async tasks get their asyncio task cancelled;
+        `force` kills the whole worker process after replying."""
+        self._cancelled.add(tid)
+        running = tid in self._in_flight
+        if force:
+            loop = asyncio.get_running_loop()
+            loop.call_later(0.05, os._exit, 1)
+            return {"ok": True, "running": running, "force": True}
+        ident = self._running_threads.get(tid)
+        if ident is not None:
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError)
+            )
+        atask = self._running_atasks.get(tid)
+        if atask is not None:
+            atask.cancel()
+        return {"ok": True, "running": running}
+
+    def _call_traced(self, tid: bytes, fn, *args, **kwargs):
+        """Run `fn` on this pool thread with the thread ident registered so
+        cancel() can raise into it. The ident is cleared before returning;
+        a cancel landing in the tiny window after clearing is benign (the
+        async exc is delivered at a later bytecode boundary and surfaces as
+        a TaskCancelledError in whatever task runs next — matching the
+        reference's best-effort interrupt semantics)."""
+        self._running_threads[tid] = threading.get_ident()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._running_threads.pop(tid, None)
 
     # ------------------------------------------------------------------
 
@@ -90,6 +141,7 @@ class TaskExecutor:
             raise
         finally:
             self._in_flight.pop(tid, None)
+            self._cancelled.discard(tid)
         if spec.kind == pb.TASK_KIND_ACTOR_TASK:
             self._reply_cache[tid] = reply
             while len(self._reply_cache) > 1024:
@@ -109,6 +161,12 @@ class TaskExecutor:
         return tuple(args), kwargs
 
     def _error_reply(self, spec: pb.TaskSpec, exc: BaseException) -> dict:
+        if isinstance(exc, TaskCancelledError):
+            # system error: surfaces directly at get(), not wrapped in
+            # TaskError (reference: TaskCancelledError in ray.exceptions)
+            return {"error": {
+                "traceback": "", "pickled": ser.serialize(exc).to_bytes(),
+            }}
         terr = TaskError.from_exception(spec.name or spec.method_name or spec.function_key, exc)
         try:
             pickled = ser.serialize(terr).to_bytes()
@@ -118,7 +176,7 @@ class TaskExecutor:
             ).to_bytes()
         return {"error": {"traceback": terr.traceback_str, "pickled": pickled}}
 
-    def _returns_reply(self, spec: pb.TaskSpec, result: Any) -> dict:
+    async def _returns_reply(self, spec: pb.TaskSpec, result: Any) -> dict:
         oids = spec.return_ids()
         if spec.num_returns == 1:
             values = [result]
@@ -132,11 +190,14 @@ class TaskExecutor:
         returns = []
         for oid, value in zip(oids, values):
             sobj = ser.serialize(value)
-            returns.append(self.cw.store_return(oid, sobj, META_NORMAL))
+            returns.append(await self.cw.store_return(oid, sobj, META_NORMAL))
         return {"returns": returns}
 
     async def _execute_normal(self, spec: pb.TaskSpec) -> dict:
+        tid = spec.task_id.binary()
         try:
+            if tid in self._cancelled:
+                raise TaskCancelledError(f"task {spec.name} was cancelled")
             fn = await self.cw.fetch_function(spec.function_key)
             args, kwargs = await self._resolve_args(spec.args)
             self.cw.current_task_id = spec.task_id
@@ -146,18 +207,30 @@ class TaskExecutor:
             # task start since workers are pooled per job)
             env_vars = (spec.runtime_env or {}).get("env_vars") or {}
             if env_vars:
-                import os as _os
-
-                _os.environ.update(env_vars)
-            if inspect.iscoroutinefunction(fn):
-                result = await fn(*args, **kwargs)
-            else:
-                result = await asyncio.get_running_loop().run_in_executor(
-                    self.thread_pool, lambda: fn(*args, **kwargs)
-                )
-            return self._returns_reply(spec, result)
+                os.environ.update(env_vars)
+            result = await self._invoke(tid, fn, args, kwargs)
+            if spec.is_streaming:
+                return await self._stream_out(spec, result)
+            return await self._returns_reply(spec, result)
         except BaseException as e:  # noqa: BLE001 — all errors cross the wire
             return self._error_reply(spec, e)
+
+    async def _invoke(self, tid: bytes, fn, args, kwargs) -> Any:
+        """Call the user function with cancellation hooks installed."""
+        if inspect.iscoroutinefunction(fn):
+            atask = asyncio.ensure_future(fn(*args, **kwargs))
+            self._running_atasks[tid] = atask
+            try:
+                return await atask
+            except asyncio.CancelledError:
+                if tid in self._cancelled:
+                    raise TaskCancelledError("task was cancelled") from None
+                raise
+            finally:
+                self._running_atasks.pop(tid, None)
+        return await asyncio.get_running_loop().run_in_executor(
+            self.thread_pool, lambda: self._call_traced(tid, fn, *args, **kwargs)
+        )
 
     async def _execute_actor_creation(self, spec: pb.TaskSpec) -> dict:
         try:
@@ -199,6 +272,11 @@ class TaskExecutor:
             except _StaleSequenceError as e:
                 return self._error_reply(spec, e)
         try:
+            if spec.cancelled:
+                # tombstone for a task cancelled before delivery: consume the
+                # sequence slot, never run the method
+                return self._error_reply(spec, TaskCancelledError(
+                    f"actor task {spec.method_name} was cancelled"))
             return await self._run_method(spec, is_async)
         finally:
             if not is_async and not threaded:
@@ -266,24 +344,108 @@ class TaskExecutor:
             buf[nxt].set()
 
     async def _run_method(self, spec: pb.TaskSpec, is_async: bool) -> dict:
+        tid = spec.task_id.binary()
         try:
             if self.actor_instance is None:
                 raise RuntimeError("actor instance not initialized")
+            if tid in self._cancelled:
+                raise TaskCancelledError(f"actor task {spec.method_name} was cancelled")
             method = getattr(self.actor_instance, spec.method_name)
             args, kwargs = await self._resolve_args(spec.args)
             self.cw.current_task_id = spec.task_id
             if is_async:
                 async with self._actor_sem:
                     if inspect.iscoroutinefunction(method):
-                        result = await method(*args, **kwargs)
+                        result = await self._invoke(tid, method, args, kwargs)
                     else:
                         result = method(*args, **kwargs)
-            elif inspect.iscoroutinefunction(method):
-                result = await method(*args, **kwargs)
             else:
-                result = await asyncio.get_running_loop().run_in_executor(
-                    self.thread_pool, lambda: method(*args, **kwargs)
-                )
-            return self._returns_reply(spec, result)
+                result = await self._invoke(tid, method, args, kwargs)
+            if spec.is_streaming:
+                return await self._stream_out(spec, result)
+            return await self._returns_reply(spec, result)
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(spec, e)
+
+    # ------------------------------------------------------------------
+    # streaming generators — executor side (reference: _raylet.pyx
+    # execute_streaming_generator + ReportGeneratorItemReturns RPCs)
+    # ------------------------------------------------------------------
+
+    async def _stream_out(self, spec: pb.TaskSpec, result: Any) -> dict:
+        """Drive a generator result, reporting each item to the owner in
+        order. Backpressure: pause when more than spec.stream_backpressure
+        items are unconsumed. A mid-generator exception (including
+        cancellation) becomes an error reply; the owner appends it as the
+        stream's final errored item."""
+        tid = spec.task_id.binary()
+        is_agen = inspect.isasyncgen(result)
+        if not is_agen and not inspect.isgenerator(result):
+            result = iter([result])  # plain value: one-item stream
+        client = await self.cw._owner_client(spec.owner_address)
+        loop = asyncio.get_running_loop()
+        idx = 0
+        bp = spec.stream_backpressure
+        try:
+            while True:
+                if tid in self._cancelled:
+                    raise TaskCancelledError(f"task {spec.name} was cancelled")
+                if is_agen:
+                    # register the item fetch so cancel() can interrupt an
+                    # await inside the user's async generator body
+                    atask = asyncio.ensure_future(result.__anext__())
+                    self._running_atasks[tid] = atask
+                    try:
+                        item = await atask
+                    except StopAsyncIteration:
+                        break
+                    except asyncio.CancelledError:
+                        if tid in self._cancelled:
+                            raise TaskCancelledError(
+                                f"task {spec.name} was cancelled") from None
+                        raise
+                    finally:
+                        self._running_atasks.pop(tid, None)
+                else:
+                    item = await loop.run_in_executor(
+                        self.thread_pool,
+                        lambda: self._call_traced(tid, self._next_or_end, result),
+                    )
+                    if item is _STREAM_END:
+                        break
+                sobj = ser.serialize(item)
+                oid = ObjectID.for_task_return(spec.task_id, idx)
+                ret = await self.cw.store_return(oid, sobj, META_NORMAL)
+                reply = await client.call(
+                    "report_stream_item",
+                    {"task_id": tid, "index": idx, "ret": ret},
+                    timeout=None,
+                )
+                idx += 1
+                if reply.get("cancelled"):
+                    raise TaskCancelledError(f"stream {spec.name} was dropped")
+                if bp > 0 and idx - reply.get("consumed", 0) >= bp:
+                    r2 = await client.call(
+                        "stream_wait_consumed",
+                        {"task_id": tid, "until": idx - bp + 1},
+                        timeout=None,
+                    )
+                    if r2.get("cancelled"):
+                        raise TaskCancelledError(f"stream {spec.name} was dropped")
+            return {"returns": [], "stream_end": idx}
+        except BaseException as e:  # noqa: BLE001 — becomes the final errored item
+            if is_agen:
+                try:
+                    await result.aclose()
+                except Exception:  # noqa: BLE001
+                    pass
+            else:
+                result.close()
+            return self._error_reply(spec, e)
+
+    @staticmethod
+    def _next_or_end(gen):
+        try:
+            return next(gen)
+        except StopIteration:
+            return _STREAM_END

@@ -267,6 +267,25 @@ def _sum_resources(dicts) -> Dict[str, float]:
     return total
 
 
+def cancel(ref_or_gen, *, force: bool = False, recursive: bool = False) -> bool:
+    """Cancel a submitted task (reference: ray.cancel,
+    python/ray/_private/worker.py). Queued tasks are dequeued and their
+    returns resolve to TaskCancelledError; running tasks get the error raised
+    into their execution (best-effort for sync tasks); `force=True` kills the
+    executing worker process. `recursive` is accepted for API parity; child
+    tasks are not chased."""
+    from ray_tpu._private.core_worker import ObjectRefGenerator
+
+    cw = get_core_worker()
+    if isinstance(ref_or_gen, ObjectRefGenerator):
+        return cw.run_sync(
+            cw.cancel_task_by_id(ref_or_gen._task_id, force=force), 30
+        )
+    if not isinstance(ref_or_gen, ObjectRef):
+        raise TypeError("ray_tpu.cancel() expects an ObjectRef or ObjectRefGenerator")
+    return cw.run_sync(cw.cancel_task(ref_or_gen, force=force, recursive=recursive), 30)
+
+
 def kill(actor, no_restart: bool = True):
     from ray_tpu.actor import ActorHandle
 

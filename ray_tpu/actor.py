@@ -65,14 +65,20 @@ class ActorHandle:
                 pass
 
     def _submit(self, method_name: str, args, kwargs, num_returns: int = 1):
+        from ray_tpu._private.protocol import NUM_RETURNS_STREAMING
+
         cw = get_core_worker()
-        refs = cw.run_sync(
+        streaming = num_returns == "streaming"
+        result = cw.run_sync(
             cw.submit_actor_task(
                 self._actor_id.binary(), method_name, args, kwargs,
-                num_returns=num_returns, max_task_retries=self._max_task_retries,
+                num_returns=NUM_RETURNS_STREAMING if streaming else num_returns,
+                max_task_retries=self._max_task_retries,
             )
         )
-        return refs[0] if num_returns == 1 else refs
+        if streaming:
+            return result
+        return result[0] if num_returns == 1 else result
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

@@ -342,8 +342,13 @@ void rt_store_destroy(const char* name) { shm_unlink(name); }
 
 // Allocates space for an object. On success *out_offset is the byte offset of
 // the data region from the mapped base (stable across processes).
-int rt_object_create(void* handle, const uint8_t* id, uint64_t data_size,
-                     uint64_t metadata, uint64_t* out_offset) {
+// allow_evict=0 makes a failed allocation return RT_ERR_FULL instead of
+// destroying LRU objects — required when the node daemon spills under
+// pressure (eviction would delete sole copies the spiller could have saved;
+// reference: plasma eviction is only safe because raylet spills first).
+int rt_object_create_ex(void* handle, const uint8_t* id, uint64_t data_size,
+                        uint64_t metadata, int allow_evict,
+                        uint64_t* out_offset) {
   Store* s = static_cast<Store*>(handle);
   Guard g(s->hdr);
   ObjectEntry* e = find_entry(s, id, true);
@@ -351,6 +356,7 @@ int rt_object_create(void* handle, const uint8_t* id, uint64_t data_size,
   if (e->state != kEntryFree) return RT_ERR_EXISTS;
   uint64_t off = heap_alloc(s, data_size ? data_size : 8);
   if (off == 0) {
+    if (!allow_evict) return RT_ERR_FULL;
     evict_lru(s, data_size + 64);
     off = heap_alloc(s, data_size ? data_size : 8);
     if (off == 0) return RT_ERR_FULL;
@@ -369,6 +375,12 @@ int rt_object_create(void* handle, const uint8_t* id, uint64_t data_size,
   s->hdr->num_objects++;
   *out_offset = off;
   return RT_OK;
+}
+
+int rt_object_create(void* handle, const uint8_t* id, uint64_t data_size,
+                     uint64_t metadata, uint64_t* out_offset) {
+  return rt_object_create_ex(handle, id, data_size, metadata, /*allow_evict=*/1,
+                             out_offset);
 }
 
 int rt_object_seal(void* handle, const uint8_t* id) {
@@ -427,6 +439,36 @@ int rt_object_delete(void* handle, const uint8_t* id) {
   e->state = kEntryFree;
   rehash_cluster(s, idx);
   return RT_OK;
+}
+
+// List spill/eviction candidates (sealed, unpinned), LRU-first. Fills up to
+// max_n ids (kIdSize bytes each) and sizes; returns the count written. Used
+// by the node daemon's spill loop (reference: local_object_manager.h:45
+// SpillObjectsOfSize choosing from the eviction policy's LRU order).
+uint64_t rt_store_list_evictable(void* handle, uint8_t* out_ids,
+                                 uint64_t* out_sizes, uint64_t max_n) {
+  Store* s = static_cast<Store*>(handle);
+  Guard g(s->hdr);
+  struct Cand {
+    uint64_t tick;
+    uint64_t size;
+    const uint8_t* id;
+  };
+  std::vector<Cand> cands;
+  for (uint64_t i = 0; i < s->hdr->capacity; i++) {
+    ObjectEntry* e = &s->table[i];
+    if (e->state == kEntrySealed && e->refcount == 0) {
+      cands.push_back({e->lru_tick, e->data_size, e->id});
+    }
+  }
+  std::sort(cands.begin(), cands.end(),
+            [](const Cand& a, const Cand& b) { return a.tick < b.tick; });
+  uint64_t n = std::min<uint64_t>(cands.size(), max_n);
+  for (uint64_t i = 0; i < n; i++) {
+    memcpy(out_ids + i * kIdSize, cands[i].id, kIdSize);
+    out_sizes[i] = cands[i].size;
+  }
+  return n;
 }
 
 void rt_store_stats(void* handle, uint64_t* bytes_in_use, uint64_t* num_objects,

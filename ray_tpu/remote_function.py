@@ -26,6 +26,7 @@ _VALID_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
     "retry_exceptions", "scheduling_strategy", "name", "label_selector",
     "placement_group", "placement_group_bundle_index", "runtime_env",
+    "_generator_backpressure_num_objects",
 }
 
 
@@ -96,8 +97,12 @@ class RemoteFunction:
         return clone
 
     def remote(self, *args, **kwargs):
+        from ray_tpu._private.protocol import NUM_RETURNS_STREAMING
+
         cw = get_core_worker()
         opts = self._options
+        num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
 
         async def submit():
             await cw.export_function(self._function_key, self._fn)
@@ -105,18 +110,19 @@ class RemoteFunction:
                 self._function_key,
                 args,
                 kwargs,
-                num_returns=opts.get("num_returns", 1),
+                num_returns=NUM_RETURNS_STREAMING if streaming else num_returns,
                 resources=build_resources(opts),
                 strategy=build_strategy(opts),
                 max_retries=opts.get("max_retries"),
                 name=self._function_name,
                 runtime_env=opts.get("runtime_env"),
+                stream_backpressure=opts.get("_generator_backpressure_num_objects", -1),
             )
 
-        refs = cw.run_sync(submit())
-        if opts.get("num_returns", 1) == 1:
-            return refs[0]
-        return refs
+        result = cw.run_sync(submit())
+        if streaming or num_returns == 1:
+            return result[0] if not streaming else result
+        return result
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -39,6 +39,10 @@ class _Lib:
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
                 ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
             ]
+            lib.rt_object_create_ex.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+            ]
             lib.rt_object_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.rt_object_get.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p,
@@ -49,6 +53,11 @@ class _Lib:
             lib.rt_object_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.rt_object_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.rt_store_stats.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 4
+            lib.rt_store_list_evictable.restype = ctypes.c_uint64
+            lib.rt_store_list_evictable.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ]
             lib.rt_store_base.restype = ctypes.POINTER(ctypes.c_uint8)
             lib.rt_store_base.argtypes = [ctypes.c_void_p]
             lib.rt_store_map_size.restype = ctypes.c_uint64
@@ -67,9 +76,18 @@ RT_ERR_STATE = -4
 class ShmObjectStore:
     """Handle to a node's shm object store. Thread-safe (locking is in the shm)."""
 
-    def __init__(self, name: str, create: bool = False, size: int = 0, capacity: int = 65536):
+    def __init__(self, name: str, create: bool = False, size: int = 0,
+                 capacity: int = 65536, allow_evict: bool | None = None):
         self._lib = _Lib()
         self.name = name
+        if allow_evict is None:
+            # With spilling on (default), a full store returns FULL and the
+            # daemon spills; in-store LRU eviction (which destroys data) only
+            # backstops spilling-disabled deployments.
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            allow_evict = not GLOBAL_CONFIG.get("object_spill_enabled")
+        self._allow_evict = 1 if allow_evict else 0
         if create:
             self._handle = self._lib.rt_store_create(name.encode(), size, capacity)
         else:
@@ -98,11 +116,29 @@ class ShmObjectStore:
             "seal_seq": seal_seq,
         }
 
+    def list_evictable(self, max_n: int = 256) -> list:
+        """Spill candidates (sealed, unpinned) as [(ObjectID, size)], LRU-first."""
+        ids = (ctypes.c_uint8 * (24 * max_n))()
+        sizes = (ctypes.c_uint64 * max_n)()
+        n = self._lib.rt_store_list_evictable(
+            self._handle, ids,
+            ctypes.cast(sizes, ctypes.POINTER(ctypes.c_uint64)), max_n,
+        )
+        raw = bytes(ids)
+        return [
+            (ObjectID(raw[i * 24:(i + 1) * 24]), sizes[i]) for i in range(n)
+        ]
+
     def create(self, object_id: ObjectID, size: int, metadata: int = META_NORMAL) -> memoryview:
-        """Allocate an object and return a writable view; call seal() when done."""
+        """Allocate an object and return a writable view; call seal() when done.
+
+        With allow_evict off (the default while spilling is enabled), a
+        failed allocation raises ObjectStoreFullError instead of destroying
+        LRU objects — the caller asks the daemon to spill and retries."""
         off = ctypes.c_uint64()
-        rc = self._lib.rt_object_create(
-            self._handle, object_id.binary(), size, metadata, ctypes.byref(off)
+        rc = self._lib.rt_object_create_ex(
+            self._handle, object_id.binary(), size, metadata, self._allow_evict,
+            ctypes.byref(off)
         )
         if rc == RT_ERR_EXISTS:
             raise FileExistsError(f"Object {object_id} already in store")

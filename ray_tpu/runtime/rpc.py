@@ -180,6 +180,14 @@ class RpcClient:
         self._lock = asyncio.Lock()
         self._write_lock = asyncio.Lock()
         self._closed = False
+        self._connected_once = False
+        self._reconnect_cbs: list = []
+
+    def on_reconnect(self, cb: Callable[[], Awaitable[None]]):
+        """Register an async callback fired after every re-established
+        connection (NOT the first connect) — e.g. to replay server-side
+        subscriptions lost when the server restarted."""
+        self._reconnect_cbs.append(cb)
 
     async def connect(self):
         async with self._lock:
@@ -194,6 +202,10 @@ class RpcClient:
             host, port = self.address.rsplit(":", 1)
             self._reader, self._writer = await asyncio.open_connection(host, int(port))
         self._recv_task = spawn(self._recv_loop())
+        if self._connected_once:
+            for cb in self._reconnect_cbs:
+                spawn(cb())
+        self._connected_once = True
 
     async def _recv_loop(self):
         try:

@@ -13,6 +13,12 @@ import os
 # virtual 8-device CPU mesh; set RT_TEST_TPU=1 to run on the real chip.
 if not os.environ.get("RT_TEST_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # The machine sitecustomize registers (and may initialize) the real-TPU
+    # PJRT backend in EVERY python process when this trigger env is set —
+    # including spawned daemons/workers, where a pre-initialized 1-device
+    # backend makes jax.distributed.initialize a silent no-op. CPU-mesh tests
+    # must not let cluster subprocesses touch the chip.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     xla_flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in xla_flags:
         os.environ["XLA_FLAGS"] = (

@@ -79,7 +79,20 @@ def test_delete_and_pin(store):
     assert store.get(oid) is None
 
 
-def test_eviction_under_pressure(store):
+@pytest.fixture()
+def evicting_store():
+    """In-store LRU eviction only runs with allow_evict (a daemon-less raw
+    store has no spiller; the spilling default makes create() return FULL so
+    the daemon spills instead of destroying data)."""
+    name = f"/rtpu_test_evict_{os.getpid()}"
+    s = ShmObjectStore(name, create=True, size=8 * 1024 * 1024, capacity=512,
+                       allow_evict=True)
+    yield s
+    s.destroy()
+
+
+def test_eviction_under_pressure(evicting_store):
+    store = evicting_store
     # fill the 8 MiB store with 1 MiB objects; LRU evicts unreferenced ones
     ids = []
     for i in range(20):
@@ -91,7 +104,8 @@ def test_eviction_under_pressure(store):
     assert not store.contains(ids[0])
 
 
-def test_pinned_objects_survive_eviction(store):
+def test_pinned_objects_survive_eviction(evicting_store):
+    store = evicting_store
     pinned = ObjectID.from_random()
     store.put_bytes(pinned, bytes(1024 * 1024))
     store.get(pinned)  # pin it
