@@ -584,26 +584,60 @@ class ControlStore:
         """Schedule + create an actor (reference: gcs_actor_scheduler.cc:50)."""
         actor_hex = rec.spec.actor_id.hex()[:8]
         try:
-            node_id = self._pick_node_for(rec.spec, exclude or set())
-            while node_id is None:
-                await asyncio.sleep(0.2)
-                if rec.state == pb.ACTOR_DEAD:
-                    return
-                node_id = self._pick_node_for(rec.spec, exclude or set())
-            # Optimistically deduct from the gossiped view so a burst of
-            # concurrent creates doesn't all pick the same node and thundering-
-            # herd the daemon (reference: GCS scheduler deducts on placement);
-            # the next heartbeat restores ground truth.
-            avail = self.node_available.get(node_id)
-            if avail is not None:
-                self.node_available[node_id] = avail - rec.spec.resources
-            daemon = await self._daemon(node_id)
-            reply = await daemon.call(
-                "create_actor",
-                {"spec": rec.spec.to_wire()},
-                timeout=GLOBAL_CONFIG.get("actor_creation_timeout_s"),
-            )
-            if not reply.get("ok"):
+            deadline = time.monotonic() + GLOBAL_CONFIG.get("actor_creation_timeout_s")
+            # nodes that rejected this actor (stale gossip view); cleared when
+            # no candidate is left so freed-up capacity is retried
+            rejected: Set[bytes] = set()
+            attempt = 0
+            while True:
+                node_id = self._pick_node_for(
+                    rec.spec, (exclude or set()) | rejected, rotation=attempt)
+                while node_id is None:
+                    self._check_actor_pg_alive(rec)
+                    rejected.clear()
+                    await asyncio.sleep(0.2)
+                    if rec.state == pb.ACTOR_DEAD:
+                        return
+                    node_id = self._pick_node_for(
+                        rec.spec, exclude or set(), rotation=attempt)
+                # Optimistically deduct from the gossiped view so a burst of
+                # concurrent creates doesn't all pick the same node and
+                # thundering-herd the daemon (reference: GCS scheduler deducts
+                # on placement); the next heartbeat restores ground truth.
+                deducted = False
+                if rec.spec.strategy.kind != pb.STRATEGY_PLACEMENT_GROUP:
+                    avail = self.node_available.get(node_id)
+                    if avail is not None:
+                        self.node_available[node_id] = avail - rec.spec.resources
+                        deducted = True
+                daemon = await self._daemon(node_id)
+                reply = await daemon.call(
+                    "create_actor",
+                    {"spec": rec.spec.to_wire()},
+                    timeout=GLOBAL_CONFIG.get("actor_creation_timeout_s"),
+                )
+                if reply.get("ok"):
+                    break
+                if deducted and node_id in self.node_available:
+                    # the daemon holds no resources for a rejected create —
+                    # refund the optimistic deduction or repeated retries
+                    # drive the gossiped view negative and starve peers
+                    self.node_available[node_id] = (
+                        self.node_available[node_id] + rec.spec.resources
+                    )
+                if (
+                    "insufficient resources" in str(reply.get("error", ""))
+                    and time.monotonic() < deadline
+                    and rec.state != pb.ACTOR_DEAD
+                ):
+                    # the gossiped view raced the daemon's ground truth
+                    # (in-flight leases): re-pick elsewhere after the next
+                    # beat instead of declaring the actor dead (reference:
+                    # gcs actor scheduler requeues on lease rejection)
+                    rejected.add(node_id)
+                    attempt += 1
+                    await asyncio.sleep(0.3)
+                    continue
                 raise RuntimeError(reply.get("error", "creation failed"))
             rec.node_id = node_id
             rec.worker_id = reply["worker_id"]
@@ -621,10 +655,42 @@ class ControlStore:
             self._persist_actor(rec)
             self.pubsub.publish("actors", rec.to_wire())
 
-    def _pick_node_for(self, spec: TaskSpec, exclude: Set[bytes]) -> Optional[bytes]:
+    def _check_actor_pg_alive(self, rec: ActorRecord) -> None:
+        """An actor bound to a removed (or vanished) placement group can
+        never be placed — raise so _create_actor marks it DEAD instead of
+        polling forever (reference: gcs_actor_manager fails actors whose PG
+        is removed)."""
+        strategy = rec.spec.strategy
+        if strategy.kind != pb.STRATEGY_PLACEMENT_GROUP:
+            return
+        pg = self.placement_groups.get(bytes.fromhex(strategy.placement_group_id))
+        if pg is None or pg.state == pb.PG_REMOVED:
+            raise RuntimeError("placement group removed before actor placement")
+
+    def _pick_node_for(self, spec: TaskSpec, exclude: Set[bytes],
+                       rotation: int = 0) -> Optional[bytes]:
         """Pick a feasible node. Hybrid policy: pack onto the most-utilized
-        feasible node first (reference: hybrid_scheduling_policy.h:50)."""
+        feasible node first (reference: hybrid_scheduling_policy.h:50).
+        `rotation` rotates among equivalent choices on retries (PG any-bundle
+        placements), so a rejected node isn't re-picked forever."""
         strategy = spec.strategy
+        if strategy.kind == pb.STRATEGY_PLACEMENT_GROUP:
+            # PG actors go to the node holding the bundle; resources come
+            # from the bundle's reservation, not the gossiped availability
+            pg = self.placement_groups.get(
+                bytes.fromhex(strategy.placement_group_id))
+            if pg is None or pg.state != pb.PG_CREATED:
+                return None  # caller's loop retries until the PG commits
+            if strategy.bundle_index >= 0:
+                return pg.placements.get(strategy.bundle_index)
+            nodes = [n for n in pg.placements.values() if n not in exclude]
+            if not nodes:
+                # all bundle nodes rejected recently: fall back to rotating
+                # over every placement (bundles free up as actors exit)
+                nodes = list(pg.placements.values())
+            if not nodes:
+                return None
+            return nodes[rotation % len(nodes)]
         if strategy.kind == pb.STRATEGY_NODE_AFFINITY and strategy.node_id:
             nid = bytes.fromhex(strategy.node_id)
             info = self.nodes.get(nid)

@@ -910,6 +910,11 @@ class CoreWorker:
                     "ref": value.binary(),
                     "owner": value.owner_address,
                     "owner_worker_id": value._owner_worker_id,
+                    # pin the caller's ref until the task completes: if the
+                    # caller drops it right after .remote(), the owner would
+                    # free the object while the executor is still resolving
+                    # it (reference: task args are pinned by the submitter)
+                    "_pyref": value,  # stripped before wire
                 }
             else:
                 sobj = ser.serialize(value)
@@ -975,6 +980,128 @@ class CoreWorker:
         if spec.is_streaming:
             return ObjectRefGenerator(self, task_id.binary())
         return refs
+
+    def submit_task_nowait(
+        self,
+        function_obj,
+        function_key: str,
+        args: tuple,
+        kwargs: dict,
+        **opts,
+    ):
+        """Loop-thread-safe submission (called from inside async actors,
+        where run_sync would deadlock): allocate the task id and return refs
+        synchronously; export+serialize+submit continue in a spawned task.
+        Reference: Ray submission is async under the hood — .remote() never
+        blocks on the data plane."""
+        task_id = self.next_task_id()
+        num_returns = opts.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            kind=pb.TASK_KIND_NORMAL,
+            function_key=function_key,
+            args=[],
+            num_returns=num_returns,
+            resources=ResourceSet(opts.get("resources") or {"CPU": 1.0}),
+            strategy=opts.get("strategy") or SchedulingStrategy(),
+            max_retries=(
+                opts["max_retries"] if opts.get("max_retries") is not None
+                else GLOBAL_CONFIG.get("max_task_retries_default")
+            ),
+            owner_worker_id=self.worker_id.binary(),
+            owner_address=self.address,
+            name=opts.get("name", ""),
+            runtime_env=opts.get("runtime_env") or {},
+            stream_backpressure=opts.get("stream_backpressure", -1),
+        )
+        refs = [
+            ObjectRef(oid, self.address, self.worker_id.binary())
+            for oid in spec.return_ids()
+        ]
+        if spec.is_streaming:
+            self._streams[task_id.binary()] = StreamState(task_id.binary())
+
+        async def finish():
+            await self.export_function(function_key, function_obj)
+            wire_args = await self.serialize_args(args, kwargs)
+            pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
+            spec.args = wire_args
+            await self._submit_with_retries(spec, pyrefs)
+
+        atask = spawn(self._guard_submit(spec, finish()))
+        self._track_submission(spec, atask)
+        if spec.is_streaming:
+            return ObjectRefGenerator(self, task_id.binary())
+        return refs
+
+    def submit_actor_task_nowait(self, actor_id: bytes, method_name: str,
+                                 args: tuple, kwargs: dict,
+                                 num_returns: int = 1,
+                                 max_task_retries: int = 0,
+                                 stream_backpressure: int = -1):
+        """Loop-thread-safe actor submission: the sequence number is taken
+        synchronously (ordering is decided here), arg serialization and
+        delivery continue in a spawned task."""
+        st = self._actor_state(actor_id)
+        task_id = TaskID.for_actor_task(
+            self.job_id, ActorID(actor_id), self.current_task_id, self._next_seq(st)
+        )
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            kind=pb.TASK_KIND_ACTOR_TASK,
+            method_name=method_name,
+            args=[],
+            num_returns=num_returns,
+            owner_worker_id=self.worker_id.binary(),
+            owner_address=self.address,
+            actor_id=ActorID(actor_id),
+            seq_no=st.seq,
+            incarnation=st.incarnation,
+            name=method_name,
+            stream_backpressure=stream_backpressure,
+        )
+        refs = [
+            ObjectRef(oid, self.address, self.worker_id.binary())
+            for oid in spec.return_ids()
+        ]
+        if spec.is_streaming:
+            self._streams[task_id.binary()] = StreamState(task_id.binary())
+
+        async def finish():
+            wire_args = await self.serialize_args(args, kwargs)
+            pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
+            spec.args = wire_args
+            await self._submit_actor_with_retries(st, spec, max_task_retries, pyrefs)
+
+        atask = spawn(self._guard_submit(spec, finish()))
+        self._track_submission(spec, atask)
+        if spec.is_streaming:
+            return ObjectRefGenerator(self, task_id.binary())
+        return refs
+
+    async def _guard_submit(self, spec: TaskSpec, coro):
+        """Serialization/export failures in a deferred submission must fail
+        the returns, not vanish into the spawn error log."""
+        try:
+            await coro
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            if spec.kind == pb.TASK_KIND_ACTOR_TASK:
+                # the sequence number was taken at submission but the spec
+                # never reached the executor (e.g. unpicklable args): deliver
+                # a cancelled tombstone so the slot is consumed — ordered
+                # actors stall on sequence holes otherwise
+                try:
+                    spec.cancelled = True
+                    spec.args = []
+                    st = self._actor_state(spec.actor_id.binary())
+                    await self._submit_actor_with_retries(st, spec, 0, [])
+                except Exception:  # noqa: BLE001 — actor gone; no hole to fill
+                    pass
+            self._fail_task(spec, RayTpuError(f"submit failed: {e}"))
 
     def _track_submission(self, spec: TaskSpec, atask: asyncio.Task):
         tid = spec.task_id.binary()
@@ -1053,7 +1180,32 @@ class CoreWorker:
                 return
         # `keepalive` pins arg refs for the life of this coroutine.
 
+    async def _wait_args_ready(self, spec: TaskSpec):
+        """Block until every by-reference arg is computed (reference:
+        task_submission/dependency_resolver — the lease is requested only
+        after dependencies resolve). Without this, a full complement of
+        granted consumer tasks blocking on queued producer tasks deadlocks
+        the worker pool."""
+
+        async def one(a: dict):
+            if self.owns_oid(a["owner_worker_id"]):
+                await self.memory_store.wait_future(a["ref"])
+            else:
+                ref = ObjectRef(
+                    ObjectID(a["ref"]), a["owner"], a["owner_worker_id"],
+                    _register=False,
+                )
+                await self._call_owner(ref, "wait_object", {"object_id": a["ref"]})
+
+        waits = [one(a) for a in spec.args if "ref" in a]
+        if waits:
+            await asyncio.gather(*waits)
+
+    def owns_oid(self, owner_worker_id: bytes) -> bool:
+        return owner_worker_id == self.worker_id.binary()
+
     async def _submit_once(self, spec: TaskSpec):
+        await self._wait_args_ready(spec)
         lease = await self._acquire_lease(spec)
         worker_addr = lease["worker_address"]
         lease_id = lease["lease_id"]
@@ -1229,6 +1381,56 @@ class CoreWorker:
         with self._lock:
             self._actor_index += 1
             actor_id = ActorID.of(self.job_id, self.current_task_id, self._actor_index)
+        await self._register_actor_with_id(
+            actor_id, class_key, args, kwargs,
+            resources=resources, max_restarts=max_restarts,
+            max_task_retries=max_task_retries, max_concurrency=max_concurrency,
+            is_async=is_async, strategy=strategy, name=name,
+            namespace=namespace, detached=detached,
+        )
+        return actor_id
+
+    def create_actor_nowait(self, class_obj, class_key: str, args: tuple,
+                            kwargs: dict, **ctor_opts) -> ActorID:
+        """Loop-thread-safe actor creation (from inside async actors):
+        allocate the id synchronously, register in a spawned task. Callers
+        interact through the handle; method submissions wait for ALIVE."""
+        with self._lock:
+            self._actor_index += 1
+            actor_id = ActorID.of(self.job_id, self.current_task_id, self._actor_index)
+        st = self._actor_state(actor_id.binary())
+
+        async def finish():
+            try:
+                await self.export_function(class_key, class_obj)
+                await self._register_actor_with_id(
+                    actor_id, class_key, args, kwargs, **ctor_opts
+                )
+            except Exception as e:  # noqa: BLE001 — surface via actor state
+                st.state = pb.ACTOR_DEAD
+                st.death_cause = f"actor registration failed: {e}"
+                if st.event is not None:
+                    st.event.set()
+
+        spawn(finish())
+        return actor_id
+
+    async def _register_actor_with_id(
+        self,
+        actor_id: ActorID,
+        class_key: str,
+        args: tuple,
+        kwargs: dict,
+        resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: int = 1,
+        is_async: bool = False,
+        strategy: Optional[SchedulingStrategy] = None,
+        name: str = "",
+        namespace: str = "",
+        detached: bool = False,
+    ) -> None:
         wire_args = await self.serialize_args(args, kwargs)
         pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
         spec = TaskSpec(
@@ -1251,7 +1453,6 @@ class CoreWorker:
         )
         self._actor_state(actor_id.binary()).creation_keepalive = pyrefs
         await self.control.call("register_actor", {"spec": spec.to_wire()})
-        return actor_id
 
     async def wait_actor_alive(self, actor_id: bytes, timeout: float = 60.0):
         st = self._actor_state(actor_id)
@@ -1280,11 +1481,13 @@ class CoreWorker:
         stream_backpressure: int = -1,
     ):
         st = self._actor_state(actor_id)
+        # serialize BEFORE taking the sequence number: a failed serialization
+        # must not consume a slot (ordered actors stall on sequence holes)
+        wire_args = await self.serialize_args(args, kwargs)
+        pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
         task_id = TaskID.for_actor_task(
             self.job_id, ActorID(actor_id), self.current_task_id, self._next_seq(st)
         )
-        wire_args = await self.serialize_args(args, kwargs)
-        pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1329,6 +1532,9 @@ class CoreWorker:
                 # running the method.
                 spec.cancelled = True
             try:
+                # resolve dependencies before delivery: an actor slot blocked
+                # on a queued producer would stall the whole ordered queue
+                await self._wait_args_ready(spec)
                 await self.wait_actor_alive(st.actor_id)
                 if spec.incarnation != st.incarnation:
                     # the actor restarted since this spec was stamped: its

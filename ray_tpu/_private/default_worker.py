@@ -55,6 +55,18 @@ def amain():
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         loop.add_signal_handler(signal.SIGTERM, stop.set)
+
+        def dump_tasks():
+            # `kill -USR2 <pid>`: print every live coroutine's await stack to
+            # the worker log (hang forensics; faulthandler only sees threads)
+            import traceback
+
+            for t in asyncio.all_tasks(loop):
+                frames = t.get_stack(limit=8)
+                where = "".join(traceback.format_stack(frames[-1])) if frames else "  <no frame>\n"
+                logging.warning("TASK %s\n%s", t.get_name(), where)
+
+        loop.add_signal_handler(signal.SIGUSR2, dump_tasks)
         await stop.wait()
         await cw.close()
 
@@ -66,6 +78,11 @@ def main():
         level=os.environ.get("RT_LOG_LEVEL", "INFO"),
         format="%(asctime)s %(levelname)s worker %(message)s",
     )
+    # hang forensics: `kill -USR1 <worker pid>` dumps all thread stacks to
+    # the worker's stderr log (reference: ray worker SIGTERM stack dumps)
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     try:
         amain()
     except KeyboardInterrupt:

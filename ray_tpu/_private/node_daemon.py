@@ -51,7 +51,7 @@ class WorkerHandle:
     __slots__ = (
         "worker_id", "proc", "state", "address", "pid", "job_id",
         "client", "lease_id", "actor_id", "ready_event", "idle_since",
-        "actor_resources", "tpu_chips", "reserved",
+        "actor_resources", "actor_pg", "tpu_chips", "reserved",
     )
 
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen, job_id: bytes):
@@ -67,6 +67,8 @@ class WorkerHandle:
         self.ready_event = asyncio.Event()
         self.idle_since = time.monotonic()
         self.actor_resources: Optional[ResourceSet] = None
+        # (pg_id, bundle_index) when the actor consumes a PG bundle
+        self.actor_pg: Optional[Tuple[bytes, int]] = None
         # chip ids this worker's TPU_VISIBLE_CHIPS was baked with at spawn
         # (visibility is per-process: it cannot change after libtpu init)
         self.tpu_chips: Optional[Tuple[int, ...]] = None
@@ -650,7 +652,14 @@ class NodeDaemon:
 
     def _release_actor_resources(self, w: WorkerHandle):
         if w.actor_resources is not None:
-            self.available = self.available + w.actor_resources
+            if w.actor_pg is not None:
+                pg_id, idx = w.actor_pg
+                pg = self.pg_prepared.get(pg_id)
+                if pg is not None and idx in pg["free"]:
+                    pg["free"][idx] = pg["free"][idx] + w.actor_resources
+                w.actor_pg = None
+            else:
+                self.available = self.available + w.actor_resources
             w.actor_resources = None
             self._try_schedule()
 
@@ -661,9 +670,43 @@ class NodeDaemon:
 
     async def rpc_create_actor(self, conn_id: int, payload: dict) -> dict:
         spec = TaskSpec.from_wire(payload["spec"])
-        if not spec.resources.is_subset_of(self.available):
-            return {"ok": False, "error": "insufficient resources"}
-        self.available = self.available - spec.resources
+        # PG-scheduled actors consume their bundle's reservation, not the
+        # node's general pool (reference: bundle resource accounting in
+        # placement_group_resource_manager.h — same rule as PG leases)
+        actor_pg = None
+        if spec.strategy.kind == pb.STRATEGY_PLACEMENT_GROUP:
+            pg_id = bytes.fromhex(spec.strategy.placement_group_id)
+            pg = self.pg_prepared.get(pg_id)
+            if pg is None or pg["state"] != "committed":
+                return {"ok": False,
+                        "error": "placement group not committed on this node"}
+            free = pg["free"]
+            idx = spec.strategy.bundle_index
+            indices = [idx] if idx >= 0 else sorted(free.keys())
+            got = None
+            for i in indices:
+                if i in free and spec.resources.is_subset_of(free[i]):
+                    free[i] = free[i] - spec.resources
+                    got = i
+                    break
+            if got is None:
+                return {"ok": False,
+                        "error": "insufficient resources in placement group bundle"}
+            actor_pg = (pg_id, got)
+        else:
+            if not spec.resources.is_subset_of(self.available):
+                return {"ok": False, "error": "insufficient resources"}
+            self.available = self.available - spec.resources
+
+        def refund():
+            if actor_pg is not None:
+                rpg_id, ridx = actor_pg
+                rpg = self.pg_prepared.get(rpg_id)
+                if rpg is not None and ridx in rpg["free"]:
+                    rpg["free"][ridx] = rpg["free"][ridx] + spec.resources
+            else:
+                self.available = self.available + spec.resources
+
         n_tpu = int(spec.resources.get("TPU"))
         try:
             w = await self._spawn_worker(
@@ -671,7 +714,7 @@ class NodeDaemon:
                 tpu_chips=self._alloc_chips(n_tpu) if n_tpu > 0 else None,
             )
         except Exception as e:  # noqa: BLE001
-            self.available = self.available + spec.resources
+            refund()
             return {"ok": False, "error": f"worker spawn failed: {e}"}
         # dedicate this worker to the actor
         idle = self.idle_by_job.get(w.job_id, [])
@@ -679,6 +722,16 @@ class NodeDaemon:
             idle.remove(w.worker_id.binary())
         w.state = W_ACTOR
         w.actor_id = spec.actor_id.binary()
+        # Mark PG membership BEFORE the init push: a concurrent
+        # rpc_return_bundles must see (and kill) this in-flight actor, or the
+        # bundle's resources get credited back while the actor keeps running.
+        # actor_resources stays None until success so the reap path doesn't
+        # double-credit with refund() on an init crash.
+        w.actor_pg = actor_pg
+        if actor_pg is not None and self.pg_prepared.get(actor_pg[0]) is None:
+            # the PG was returned while the worker was spawning
+            self._kill_worker_proc(w, "placement group returned during spawn")
+            return {"ok": False, "error": "placement group returned"}
         client = RpcClient(w.address, name="daemon->worker")
         try:
             await client.connect()
@@ -688,14 +741,20 @@ class NodeDaemon:
             )
         except Exception as e:  # noqa: BLE001
             self._kill_worker_proc(w, "actor init push failed")
-            self.available = self.available + spec.resources
+            refund()
             return {"ok": False, "error": f"actor init failed: {e}"}
         finally:
             await client.close()
         if reply.get("error"):
             self._kill_worker_proc(w, "actor __init__ raised")
-            self.available = self.available + spec.resources
+            refund()
             return {"ok": False, "error": reply["error"].get("traceback", "init failed")}
+        if w.state == W_DEAD or (
+            actor_pg is not None and self.pg_prepared.get(actor_pg[0]) is None
+        ):
+            # killed (e.g. the PG was returned) between init and registration
+            self._kill_worker_proc(w, "killed during actor init")
+            return {"ok": False, "error": "worker killed during actor init"}
         w.actor_resources = spec.resources
         return {
             "ok": True,
@@ -746,6 +805,12 @@ class NodeDaemon:
                     w = self.workers.get(wid)
                     if w is not None:
                         self._kill_worker_proc(w, "placement group returned")
+            # actors living in returned bundles go down with them
+            for w in list(self.workers.values()):
+                if w.actor_pg is not None and w.actor_pg[0] == payload["pg_id"]:
+                    w.actor_pg = None
+                    w.actor_resources = None
+                    self._kill_worker_proc(w, "placement group returned")
             freed = ResourceSet()
             for res in pg["bundles"].values():
                 freed = freed + res

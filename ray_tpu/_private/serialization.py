@@ -81,7 +81,21 @@ def serialize(value: Any) -> SerializedObject:
 
     token = _CONTAINED_REFS.set([])
     try:
-        inband = pickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+        try:
+            inband = pickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+        except (pickle.PicklingError, AttributeError, TypeError):
+            # lambdas / closures / local classes (e.g. Dataset UDFs riding as
+            # task args): cloudpickle, same protocol-5 out-of-band buffers
+            # (reference: ray cloudpickles all task arguments)
+            import cloudpickle
+
+            buffers.clear()
+            refs = _CONTAINED_REFS.get()
+            if refs:
+                refs.clear()  # re-collected by the retry
+            inband = cloudpickle.dumps(
+                value, protocol=5, buffer_callback=buffer_callback
+            )
         contained = _CONTAINED_REFS.get()
     finally:
         _CONTAINED_REFS.reset(token)

@@ -201,9 +201,21 @@ def is_initialized() -> bool:
 
 def get(refs, timeout: Optional[float] = None):
     cw = get_core_worker()
+    if cw._loop_running_here():
+        raise RuntimeError(
+            "ray_tpu.get() cannot block inside an async actor — use "
+            "`await ref` (or gather multiple refs) instead"
+        )
+    # unwrap ref-like wrappers (e.g. serve's _TrackedRef) that carry the
+    # real ObjectRef in ._ref
+    if not isinstance(refs, ObjectRef) and hasattr(refs, "_ref"):
+        refs = refs._ref
     single = isinstance(refs, ObjectRef)
     if single:
         refs = [refs]
+    else:
+        refs = [r._ref if not isinstance(r, ObjectRef) and hasattr(r, "_ref")
+                else r for r in refs]
     if not all(isinstance(r, ObjectRef) for r in refs):
         raise TypeError("ray_tpu.get() accepts an ObjectRef or a list of ObjectRefs")
     bridge_timeout = None if timeout is None else timeout + 30
@@ -213,6 +225,11 @@ def get(refs, timeout: Optional[float] = None):
 
 def put(value) -> ObjectRef:
     cw = get_core_worker()
+    if cw._loop_running_here():
+        raise RuntimeError(
+            "ray_tpu.put() cannot block inside an async actor — use "
+            "`await cw.put_object(value)` via an executor thread instead"
+        )
     return cw.run_sync(cw.put_object(value))
 
 
@@ -223,6 +240,11 @@ def wait(
     timeout: Optional[float] = None,
 ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
     cw = get_core_worker()
+    if cw._loop_running_here():
+        raise RuntimeError(
+            "ray_tpu.wait() cannot block inside an async actor — await the "
+            "refs (e.g. asyncio.wait on them) instead"
+        )
     if num_returns > len(refs):
         raise ValueError("num_returns exceeds the number of refs")
     bridge_timeout = None if timeout is None else timeout + 30
@@ -292,18 +314,41 @@ def kill(actor, no_restart: bool = True):
     if not isinstance(actor, ActorHandle):
         raise TypeError("ray_tpu.kill() expects an ActorHandle")
     cw = get_core_worker()
+    if cw._loop_running_here():
+        # from inside an async actor: fire-and-forget (run_sync would
+        # deadlock the shared event loop)
+        cw.schedule(cw.kill_actor(actor._actor_id.binary(), no_restart))
+        return
     cw.run_sync(cw.kill_actor(actor._actor_id.binary(), no_restart), 30)
 
 
-def get_actor(name: str, namespace: str = "") -> "Any":
+def _handle_from_named_actor_reply(name: str, reply: dict) -> "Any":
+    from ray_tpu._private.ids import ActorID
     from ray_tpu.actor import ActorHandle
 
+    if reply["actor"] is None or reply["actor"]["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r}")
+    return ActorHandle(ActorID(reply["actor"]["actor_id"]), class_key="", method_meta=None)
+
+
+def get_actor(name: str, namespace: str = "") -> "Any":
     cw = get_core_worker()
+    if cw._loop_running_here():
+        raise RuntimeError(
+            "get_actor() called on the core event loop would deadlock — "
+            "use get_actor_async() from async actor code"
+        )
     reply = cw.run_sync(
         cw.control.call("get_named_actor", {"name": name, "namespace": namespace})
     )
-    if reply["actor"] is None or reply["actor"]["state"] == "DEAD":
-        raise ValueError(f"no live actor named {name!r}")
-    from ray_tpu._private.ids import ActorID
+    return _handle_from_named_actor_reply(name, reply)
 
-    return ActorHandle(ActorID(reply["actor"]["actor_id"]), class_key="", method_meta=None)
+
+async def get_actor_async(name: str, namespace: str = "") -> "Any":
+    """Loop-safe variant of get_actor for code running on the core event loop
+    (async actors)."""
+    cw = get_core_worker()
+    reply = await cw.control.call(
+        "get_named_actor", {"name": name, "namespace": namespace}
+    )
+    return _handle_from_named_actor_reply(name, reply)

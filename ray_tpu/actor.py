@@ -69,13 +69,23 @@ class ActorHandle:
 
         cw = get_core_worker()
         streaming = num_returns == "streaming"
-        result = cw.run_sync(
-            cw.submit_actor_task(
+        wire_returns = NUM_RETURNS_STREAMING if streaming else num_returns
+        if cw._loop_running_here():
+            # inside an async actor: non-blocking submission (run_sync would
+            # deadlock the shared event loop)
+            result = cw.submit_actor_task_nowait(
                 self._actor_id.binary(), method_name, args, kwargs,
-                num_returns=NUM_RETURNS_STREAMING if streaming else num_returns,
+                num_returns=wire_returns,
                 max_task_retries=self._max_task_retries,
             )
-        )
+        else:
+            result = cw.run_sync(
+                cw.submit_actor_task(
+                    self._actor_id.binary(), method_name, args, kwargs,
+                    num_returns=wire_returns,
+                    max_task_retries=self._max_task_retries,
+                )
+            )
         if streaming:
             return result
         return result[0] if num_returns == 1 else result
@@ -151,7 +161,22 @@ class ActorClass:
                 detached=opts.get("lifetime") == "detached",
             )
 
-        actor_id = cw.run_sync(create())
+        if cw._loop_running_here():
+            # inside an async actor: non-blocking creation
+            actor_id = cw.create_actor_nowait(
+                self._cls, self._class_key, args, kwargs,
+                resources=build_resources(opts),
+                max_restarts=opts.get("max_restarts", 0),
+                max_task_retries=opts.get("max_task_retries", 0),
+                max_concurrency=opts.get("max_concurrency", 1000 if is_async else 1),
+                is_async=is_async,
+                strategy=build_strategy(opts),
+                name=opts.get("name", ""),
+                namespace=opts.get("namespace", ""),
+                detached=opts.get("lifetime") == "detached",
+            )
+        else:
+            actor_id = cw.run_sync(create())
         # Unnamed, non-detached actors are GC'd with the creator's last handle.
         owned = not opts.get("name") and opts.get("lifetime") != "detached"
         return ActorHandle(

@@ -1,0 +1,33 @@
+"""ray_tpu.data — distributed, block-based data pipelines feeding TPU SPMD
+training (reference surface: python/ray/data/__init__.py).
+
+Blocks are columnar dict-of-numpy; transforms fuse into one remote task per
+block; `Dataset.split()` shards blocks across train workers and
+`iter_batches(device_put=True)` prefetches host→device.
+"""
+
+from ray_tpu.data.block import Block
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.datasource import (
+    from_items,
+    from_numpy,
+    range,
+    read_binary_files,
+    read_csv,
+    read_images,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "Block",
+    "Dataset",
+    "range",
+    "from_items",
+    "from_numpy",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+    "read_binary_files",
+    "read_images",
+]

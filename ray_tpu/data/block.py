@@ -1,0 +1,76 @@
+"""Block representation and batch slicing for ray_tpu.data.
+
+Reference: python/ray/data/block.py (Arrow/pandas/simple blocks). TPU-first
+redesign: the native block is **columnar dict-of-numpy** — the layout
+`iter_batches` can feed straight into `jax.device_put` without conversion —
+with a plain row-list fallback for non-tabular data.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+Block = Union[Dict[str, np.ndarray], List[Any]]
+
+
+def block_num_rows(block: Block) -> int:
+    if isinstance(block, dict):
+        if not block:
+            return 0
+        return len(next(iter(block.values())))
+    return len(block)
+
+
+def block_slice(block: Block, start: int, stop: int) -> Block:
+    if isinstance(block, dict):
+        return {k: v[start:stop] for k, v in block.items()}
+    return block[start:stop]
+
+
+def block_concat(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if block_num_rows(b) > 0]
+    if not blocks:
+        return []
+    if isinstance(blocks[0], dict):
+        keys = blocks[0].keys()
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    out: List[Any] = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+def block_rows(block: Block) -> Iterator[Any]:
+    """Iterate rows: dict blocks yield per-row dicts, list blocks yield items."""
+    if isinstance(block, dict):
+        n = block_num_rows(block)
+        keys = list(block.keys())
+        for i in range(n):
+            yield {k: block[k][i] for k in keys}
+    else:
+        yield from block
+
+
+def rows_to_block(rows: List[Any]) -> Block:
+    """Columnarize a row list when all rows are flat dicts of scalars/arrays
+    of matching shape; otherwise keep the row list."""
+    if not rows:
+        return []
+    if all(isinstance(r, dict) for r in rows):
+        keys = rows[0].keys()
+        if all(r.keys() == keys for r in rows):
+            try:
+                return {k: np.stack([np.asarray(r[k]) for r in rows]) for k in keys}
+            except (ValueError, TypeError):
+                pass  # ragged: fall through to row list
+    return list(rows)
+
+
+def normalize_batch(block: Block) -> Block:
+    """What a map_batches UDF receives: columnar dicts stay columnar; row
+    lists of uniform dicts are columnarized; other rows stay a list."""
+    if isinstance(block, dict):
+        return block
+    return rows_to_block(block)

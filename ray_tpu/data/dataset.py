@@ -1,0 +1,318 @@
+"""Dataset: lazy, distributed, block-based data pipelines.
+
+Reference surface: python/ray/data/dataset.py:203 (map/map_batches/filter/
+flat_map/split/iter_batches/take/count) executed by the streaming executor
+(python/ray/data/_internal/execution/streaming_executor.py:106).
+
+TPU-first redesign instead of a port:
+- a Dataset is (block producers, fused op chain). Materialization submits ONE
+  task per block that applies the whole chain — operator fusion is the
+  default (the reference fuses map chains inside its executor; here the
+  chain is literally one function), and blocks execute in parallel across
+  the cluster with no central executor loop.
+- blocks are columnar dict-of-numpy (block.py), the layout `iter_batches`
+  feeds straight to `jax.device_put` for host→device prefetch.
+- `split()` hands disjoint block sets to SPMD train workers (the
+  split-per-worker iterator of the reference's streaming_split).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.data.block import (
+    Block,
+    block_concat,
+    block_num_rows,
+    block_rows,
+    block_slice,
+    normalize_batch,
+    rows_to_block,
+)
+
+# one op: (kind, fn) where kind in {"map_batches", "map", "filter", "flat_map"}
+_Op = Tuple[str, Callable]
+
+
+def _apply_ops(block: Block, ops: List[_Op]) -> Block:
+    for kind, fn in ops:
+        if kind == "map_batches":
+            block = fn(normalize_batch(block))
+        elif kind == "map":
+            block = rows_to_block([fn(r) for r in block_rows(block)])
+        elif kind == "filter":
+            block = rows_to_block([r for r in block_rows(block) if fn(r)])
+        elif kind == "flat_map":
+            out: List[Any] = []
+            for r in block_rows(block):
+                out.extend(fn(r))
+            block = rows_to_block(out)
+        else:  # pragma: no cover — plan construction guards kinds
+            raise ValueError(f"unknown op {kind}")
+    return block
+
+
+def _run_chain(producer_or_block, ops: List[_Op]) -> Block:
+    """The per-block fused task body: produce (or receive) the source block,
+    then apply the whole op chain."""
+    block = producer_or_block() if callable(producer_or_block) else producer_or_block
+    return _apply_ops(block, ops)
+
+
+class Dataset:
+    """A lazy distributed collection of blocks.
+
+    `_producers` are zero-arg callables (or ObjectRefs of already-computed
+    blocks) each yielding one source block; `_ops` is the pending fused
+    chain. All transforms are lazy; `materialize()`/consumption triggers one
+    remote task per block.
+    """
+
+    def __init__(self, producers: List[Any], ops: Optional[List[_Op]] = None,
+                 *, _refs: Optional[List[Any]] = None):
+        self._producers = producers
+        self._ops: List[_Op] = list(ops or [])
+        self._refs = _refs  # cached materialized block refs
+
+    # -- transforms (lazy) ---------------------------------------------
+
+    def _chain(self, kind: str, fn: Callable) -> "Dataset":
+        base = self._refs if self._refs is not None else self._producers
+        ops = [] if self._refs is not None else self._ops
+        return Dataset(list(base), ops + [(kind, fn)])
+
+    def map_batches(self, fn: Callable[[Block], Block]) -> "Dataset":
+        """Apply fn to whole blocks in columnar {col: ndarray} form."""
+        return self._chain("map_batches", fn)
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._chain("map", fn)
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._chain("filter", fn)
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        return self._chain("flat_map", fn)
+
+    # -- execution ------------------------------------------------------
+
+    def materialize(self) -> "Dataset":
+        """Execute the plan: one fused remote task per block. Returns a
+        Dataset backed by block ObjectRefs (repeat consumption is free)."""
+        if self._refs is not None:
+            return self
+        import ray_tpu
+        from ray_tpu.remote_function import RemoteFunction
+
+        run = RemoteFunction(_run_chain)
+        ops = self._ops
+        refs = []
+        from ray_tpu._private.core_worker import ObjectRef
+
+        for p in self._producers:
+            if isinstance(p, ObjectRef) and not ops:
+                refs.append(p)
+            else:
+                refs.append(run.remote(p, ops))
+        return Dataset(refs, [], _refs=refs)
+
+    def _block_refs(self) -> List[Any]:
+        return self.materialize()._refs
+
+    # -- consumption ----------------------------------------------------
+
+    def num_blocks(self) -> int:
+        return len(self._producers)
+
+    def count(self) -> int:
+        import ray_tpu
+
+        refs = self._block_refs()
+        return sum(
+            block_num_rows(b) for b in ray_tpu.get(refs, timeout=600)
+        )
+
+    def take(self, limit: int = 20) -> List[Any]:
+        import ray_tpu
+
+        out: List[Any] = []
+        for ref in self._block_refs():
+            block = ray_tpu.get(ref, timeout=600)
+            for row in block_rows(block):
+                out.append(row)
+                if len(out) >= limit:
+                    return out
+        return out
+
+    def take_all(self) -> List[Any]:
+        return self.take(limit=2**62)
+
+    def iter_rows(self) -> Iterator[Any]:
+        import ray_tpu
+
+        for ref in self._block_refs():
+            yield from block_rows(ray_tpu.get(ref, timeout=600))
+
+    def iter_batches(
+        self,
+        batch_size: Optional[int] = 256,
+        *,
+        drop_last: bool = False,
+        device_put: bool = False,
+        prefetch_blocks: int = 2,
+    ) -> Iterator[Block]:
+        """Iterate fixed-size columnar batches across block boundaries.
+
+        device_put=True moves each numpy batch onto the default JAX device
+        before yielding — host→device transfer overlaps the consumer's step
+        (the reference's iter_torch_batches prefetch, TPU-flavored).
+        """
+        import ray_tpu
+
+        # All block tasks were submitted at materialize() and compute in
+        # parallel; an in-order get() therefore always has `prefetch_blocks`+
+        # of work racing ahead of the consumer. (prefetch_blocks is accepted
+        # for API parity; the window is effectively the whole plan.)
+        del prefetch_blocks
+        refs = self._block_refs()
+        carry: Optional[Block] = None
+
+        def to_out(b: Block) -> Block:
+            if device_put and isinstance(b, dict):
+                import jax
+
+                return {k: jax.device_put(v) for k, v in b.items()}
+            return b
+
+        for ref in refs:
+            block = ray_tpu.get(ref, timeout=600)
+            carry = block if carry is None else block_concat([carry, block])
+            if batch_size is None:
+                yield to_out(carry)
+                carry = None
+                continue
+            while block_num_rows(carry) >= batch_size:
+                yield to_out(block_slice(carry, 0, batch_size))
+                carry = block_slice(carry, batch_size, block_num_rows(carry))
+        if carry is not None and block_num_rows(carry) > 0 and not drop_last:
+            yield to_out(carry)
+
+    # -- reorganization -------------------------------------------------
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Split into n datasets over disjoint blocks (per-train-worker
+        shards; reference: Dataset.split / streaming_split). equal=True
+        repartitions first so every shard has the same row count (±1), which
+        SPMD training needs for lockstep batches."""
+        if equal:
+            refs = self.repartition(n)._refs
+            return [Dataset([r], [], _refs=[r]) for r in refs]
+        refs = self._block_refs()
+        shards: List[List[Any]] = [[] for _ in range(n)]
+        for i, ref in enumerate(refs):
+            shards[i % n].append(ref)
+        return [Dataset(s, [], _refs=s) for s in shards]
+
+    def _block_row_counts(self, refs: List[Any]) -> List[int]:
+        import ray_tpu
+        from ray_tpu.remote_function import RemoteFunction
+
+        count = RemoteFunction(block_num_rows)
+        return ray_tpu.get([count.remote(r) for r in refs], timeout=600)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Rebalance rows into `num_blocks` equal blocks (materializes).
+
+        Each output task receives only the input blocks overlapping its row
+        range — O(N) total movement, not all-blocks-to-every-task."""
+        import ray_tpu
+        from ray_tpu.remote_function import RemoteFunction
+
+        refs = self._block_refs()
+        counts = self._block_row_counts(refs)
+        starts = list(np.cumsum([0] + counts))  # global start offset per block
+        total = starts[-1]
+
+        def _slice_rows(lo: int, hi: int, block_starts, *blocks):
+            parts = []
+            for s, b in zip(block_starts, blocks):
+                n = block_num_rows(b)
+                a, z = max(lo, s), min(hi, s + n)
+                if z > a:
+                    parts.append(block_slice(b, a - s, z - s))
+            return block_concat(parts) if parts else rows_to_block([])
+
+        run = RemoteFunction(_slice_rows)
+        new_refs = []
+        for i in range(num_blocks):
+            lo, hi = (total * i) // num_blocks, (total * (i + 1)) // num_blocks
+            overlap = [
+                j for j in range(len(refs))
+                if starts[j] < hi and starts[j] + counts[j] > lo
+            ]
+            new_refs.append(run.remote(
+                lo, hi, [starts[j] for j in overlap], *[refs[j] for j in overlap]
+            ))
+        return Dataset(new_refs, [], _refs=new_refs)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global random shuffle (materializes). Two-stage push shuffle as in
+        the reference's shuffle ops: each input block scatters its rows into
+        k partitions (one task, k returns); each output concatenates and
+        permutes its k incoming parts — O(N) total movement."""
+        from ray_tpu.remote_function import RemoteFunction
+
+        refs = self._block_refs()
+        k = len(refs)
+        if k <= 1:
+            return Dataset(list(refs), [], _refs=list(refs))
+
+        def _scatter(sd, j: int, k: int, block):
+            rng = np.random.default_rng(None if sd is None else sd * 1_000_003 + j)
+            n = block_num_rows(block)
+            assign = rng.integers(0, k, size=n)
+            if isinstance(block, dict):
+                return tuple(
+                    {c: v[assign == i] for c, v in block.items()} for i in range(k)
+                )
+            items = list(block)
+            return tuple(
+                [items[t] for t in np.flatnonzero(assign == i)] for i in range(k)
+            )
+
+        def _merge(sd, i: int, *parts):
+            whole = block_concat(list(parts))
+            rng = np.random.default_rng(None if sd is None else sd * 7_000_003 + i)
+            n = block_num_rows(whole)
+            perm = rng.permutation(n)
+            if isinstance(whole, dict):
+                return {c: v[perm] for c, v in whole.items()}
+            return [whole[j] for j in perm]
+
+        scatter = RemoteFunction(_scatter).options(num_returns=k)
+        merge = RemoteFunction(_merge)
+        partitions = [scatter.remote(seed, j, k, refs[j]) for j in range(k)]
+        new_refs = [
+            merge.remote(seed, i, *[partitions[j][i] for j in range(k)])
+            for i in range(k)
+        ]
+        return Dataset(new_refs, [], _refs=new_refs)
+
+    # -- introspection --------------------------------------------------
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        import ray_tpu
+
+        refs = self._block_refs()
+        if not refs:
+            return None
+        block = ray_tpu.get(refs[0], timeout=600)
+        if isinstance(block, dict):
+            return {k: str(v.dtype) for k, v in block.items()}
+        return None
+
+    def __repr__(self):
+        ops = "->".join(k for k, _ in self._ops) or "source"
+        return f"Dataset(blocks={len(self._producers)}, plan={ops})"

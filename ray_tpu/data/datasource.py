@@ -1,0 +1,177 @@
+"""Datasource constructors for ray_tpu.data.
+
+Reference: python/ray/data/read_api.py (range, from_items, read_parquet,
+read_csv, read_json, read_binary_files, read_images). Each reader builds a
+Dataset whose producers are zero-arg callables executed remotely — file IO
+happens on cluster workers, one fused task per block.
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+import glob as glob_mod
+import os
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ray_tpu.data.dataset import Dataset
+
+
+def _chunk_bounds(n: int, k: int):
+    # NB: module-level `range()` below shadows the builtin (API parity with
+    # ray.data.range), hence builtins.range here
+    return [((n * i) // k, (n * (i + 1)) // k) for i in builtins.range(k)]
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001 — API parity
+    """Dataset of {"id": int64} rows 0..n-1 (reference: ray.data.range)."""
+    k = parallelism if parallelism > 0 else min(max(1, n // 1000), 200)
+    producers = [
+        functools.partial(_range_block, lo, hi) for lo, hi in _chunk_bounds(n, k)
+    ]
+    return Dataset(producers)
+
+
+def _range_block(lo: int, hi: int):
+    return {"id": np.arange(lo, hi, dtype=np.int64)}
+
+
+def from_items(items: Sequence[Any], *, parallelism: int = -1) -> Dataset:
+    """Dataset from a local list (reference: ray.data.from_items)."""
+    from ray_tpu.data.block import rows_to_block
+
+    items = list(items)
+    k = parallelism if parallelism > 0 else min(max(1, len(items) // 1000), 200)
+    k = max(1, min(k, len(items) or 1))
+    blocks = [
+        rows_to_block(items[lo:hi]) for lo, hi in _chunk_bounds(len(items), k)
+    ]
+    return Dataset([functools.partial(_identity, b) for b in blocks])
+
+
+def _identity(b):
+    return b
+
+
+def from_numpy(arr: np.ndarray, *, column: str = "data",
+               parallelism: int = -1) -> Dataset:
+    k = parallelism if parallelism > 0 else min(max(1, len(arr) // 100_000), 200)
+    return Dataset([
+        functools.partial(_identity, {column: arr[lo:hi]})
+        for lo, hi in _chunk_bounds(len(arr), k)
+    ])
+
+
+def _expand_paths(paths: Union[str, Sequence[str]], suffixes=None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    if suffixes:
+        out = [p for p in out if any(p.endswith(s) for s in suffixes)]
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def read_parquet(paths: Union[str, Sequence[str]], *, columns=None) -> Dataset:
+    """One block per parquet file, columnar numpy (reference: read_parquet)."""
+    files = _expand_paths(paths, suffixes=[".parquet"])
+    return Dataset([
+        functools.partial(_read_parquet_file, f, columns) for f in files
+    ])
+
+
+def _read_parquet_file(path: str, columns):
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, columns=columns)
+    return {
+        name: col.to_numpy(zero_copy_only=False)
+        for name, col in zip(table.column_names, table.columns)
+    }
+
+
+def read_csv(paths: Union[str, Sequence[str]], **pandas_kwargs) -> Dataset:
+    files = _expand_paths(paths, suffixes=[".csv"])
+    return Dataset([
+        functools.partial(_read_csv_file, f, pandas_kwargs) for f in files
+    ])
+
+
+def _read_csv_file(path: str, pandas_kwargs):
+    import pandas as pd
+
+    df = pd.read_csv(path, **pandas_kwargs)
+    return {c: df[c].to_numpy() for c in df.columns}
+
+
+def read_json(paths: Union[str, Sequence[str]], *, lines: bool = True) -> Dataset:
+    files = _expand_paths(paths, suffixes=[".json", ".jsonl"])
+    return Dataset([
+        functools.partial(_read_json_file, f, lines) for f in files
+    ])
+
+
+def _read_json_file(path: str, lines: bool):
+    import pandas as pd
+
+    df = pd.read_json(path, lines=lines)
+    return {c: df[c].to_numpy() for c in df.columns}
+
+
+def read_binary_files(paths: Union[str, Sequence[str]],
+                      *, include_paths: bool = False,
+                      parallelism: int = -1) -> Dataset:
+    files = _expand_paths(paths)
+    k = parallelism if parallelism > 0 else min(len(files), 64)
+    return Dataset([
+        functools.partial(_read_binary_chunk, files[lo:hi], include_paths)
+        for lo, hi in _chunk_bounds(len(files), k)
+    ])
+
+
+def _read_binary_chunk(files: List[str], include_paths: bool):
+    rows = []
+    for f in files:
+        with open(f, "rb") as fh:
+            data = fh.read()
+        rows.append({"path": f, "bytes": data} if include_paths else {"bytes": data})
+    return rows
+
+
+def read_images(paths: Union[str, Sequence[str]], *, size=None,
+                mode: str = "RGB", parallelism: int = -1) -> Dataset:
+    """Decode images into {"image": uint8 HWC} rows; `size=(h, w)` resizes so
+    blocks stack into one array (reference: ray.data.read_images)."""
+    files = _expand_paths(
+        paths, suffixes=[".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp"]
+    )
+    k = parallelism if parallelism > 0 else min(len(files), 64)
+    return Dataset([
+        functools.partial(_read_image_chunk, files[lo:hi], size, mode)
+        for lo, hi in _chunk_bounds(len(files), k)
+    ])
+
+
+def _read_image_chunk(files: List[str], size, mode: str):
+    from PIL import Image
+
+    arrays = []
+    for f in files:
+        img = Image.open(f).convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))
+        arrays.append(np.asarray(img))
+    if size is not None:
+        return {"image": np.stack(arrays)}
+    return [{"image": a} for a in arrays]

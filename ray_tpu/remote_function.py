@@ -103,23 +103,30 @@ class RemoteFunction:
         opts = self._options
         num_returns = opts.get("num_returns", 1)
         streaming = num_returns == "streaming"
+        call_opts = dict(
+            num_returns=NUM_RETURNS_STREAMING if streaming else num_returns,
+            resources=build_resources(opts),
+            strategy=build_strategy(opts),
+            max_retries=opts.get("max_retries"),
+            name=self._function_name,
+            runtime_env=opts.get("runtime_env"),
+            stream_backpressure=opts.get("_generator_backpressure_num_objects", -1),
+        )
 
-        async def submit():
-            await cw.export_function(self._function_key, self._fn)
-            return await cw.submit_task(
-                self._function_key,
-                args,
-                kwargs,
-                num_returns=NUM_RETURNS_STREAMING if streaming else num_returns,
-                resources=build_resources(opts),
-                strategy=build_strategy(opts),
-                max_retries=opts.get("max_retries"),
-                name=self._function_name,
-                runtime_env=opts.get("runtime_env"),
-                stream_backpressure=opts.get("_generator_backpressure_num_objects", -1),
+        if cw._loop_running_here():
+            # called from inside an async actor: run_sync would deadlock the
+            # event loop — use the non-blocking submission path
+            result = cw.submit_task_nowait(
+                self._fn, self._function_key, args, kwargs, **call_opts
             )
+        else:
+            async def submit():
+                await cw.export_function(self._function_key, self._fn)
+                return await cw.submit_task(
+                    self._function_key, args, kwargs, **call_opts
+                )
 
-        result = cw.run_sync(submit())
+            result = cw.run_sync(submit())
         if streaming or num_returns == 1:
             return result[0] if not streaming else result
         return result

@@ -1,0 +1,171 @@
+"""ray_tpu.serve — scalable model serving on the actor plane.
+
+Reference surface: python/ray/serve/api.py (deployment decorator, run,
+start, shutdown, get_deployment_handle). A detached controller actor
+reconciles replica gangs and autoscales on in-flight request counts; handles
+route power-of-two-choices; an aiohttp ingress exposes deployments over HTTP.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve._controller import (
+    CONTROLLER_NAME,
+    SERVE_NAMESPACE,
+    get_or_create_controller,
+)
+from ray_tpu.serve._handle import DeploymentHandle
+
+
+class Deployment:
+    """A configured-but-not-deployed callable (reference: serve.Deployment)."""
+
+    def __init__(self, target: Callable, name: str,
+                 num_replicas: int = 1,
+                 autoscaling_config: Optional[dict] = None,
+                 ray_actor_options: Optional[dict] = None,
+                 max_concurrent_queries: int = 100,
+                 init_args: tuple = (), init_kwargs: Optional[dict] = None):
+        self._target = target
+        self.name = name
+        self.num_replicas = num_replicas
+        self.autoscaling_config = autoscaling_config
+        self.ray_actor_options = dict(ray_actor_options or {})
+        self.max_concurrent_queries = max_concurrent_queries
+        self._init_args = init_args
+        self._init_kwargs = dict(init_kwargs or {})
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = dict(
+            num_replicas=self.num_replicas,
+            autoscaling_config=self.autoscaling_config,
+            ray_actor_options=self.ray_actor_options,
+            max_concurrent_queries=self.max_concurrent_queries,
+            init_args=self._init_args,
+            init_kwargs=self._init_kwargs,
+            name=self.name,
+        )
+        cfg.update(overrides)
+        name = cfg.pop("name")
+        return Deployment(self._target, name, **cfg)
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        """Bind constructor args (reference: deployment.bind for app graphs)."""
+        return Deployment(
+            self._target, self.name,
+            num_replicas=self.num_replicas,
+            autoscaling_config=self.autoscaling_config,
+            ray_actor_options=self.ray_actor_options,
+            max_concurrent_queries=self.max_concurrent_queries,
+            init_args=args, init_kwargs=kwargs,
+        )
+
+
+def deployment(_target=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               autoscaling_config: Optional[dict] = None,
+               ray_actor_options: Optional[dict] = None,
+               max_concurrent_queries: int = 100):
+    """`@serve.deployment` decorator (reference: serve.api.deployment)."""
+
+    def wrap(target):
+        return Deployment(
+            target, name or target.__name__,
+            num_replicas=num_replicas,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=ray_actor_options,
+            max_concurrent_queries=max_concurrent_queries,
+        )
+
+    if _target is not None:
+        return wrap(_target)
+    return wrap
+
+
+def run(dep: Deployment, *, wait_for_ready: bool = True,
+        timeout: float = 120.0) -> DeploymentHandle:
+    """Deploy (or redeploy) and return a routing handle (reference:
+    serve.run)."""
+    import cloudpickle
+
+    controller = get_or_create_controller()
+    ok = ray_tpu.get(
+        controller.deploy.remote(
+            dep.name,
+            cloudpickle.dumps(dep._target),
+            cloudpickle.dumps((dep._init_args, dep._init_kwargs)),
+            dep.num_replicas,
+            autoscaling=dep.autoscaling_config,
+            actor_options=dep.ray_actor_options,
+            max_concurrent=dep.max_concurrent_queries,
+        ),
+        timeout=timeout,
+    )
+    if not ok:
+        raise RuntimeError(f"deploying {dep.name} failed")
+    handle = DeploymentHandle(dep.name, controller)
+    if wait_for_ready:
+        handle._refresh(force=True)
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, get_or_create_controller())
+
+
+def status() -> Dict[str, Any]:
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.list_deployments.remote(), timeout=30)
+
+
+def start(http_host: str = "127.0.0.1", http_port: int = 8000) -> str:
+    """Start the HTTP ingress; returns its base URL (reference:
+    serve.start(http_options=...))."""
+    from ray_tpu.serve._http import PROXY_NAME, HttpProxy
+
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME, namespace=SERVE_NAMESPACE)
+    except ValueError:
+        proxy = HttpProxy.options(
+            name=PROXY_NAME, namespace=SERVE_NAMESPACE, lifetime="detached",
+            max_concurrency=256,
+        ).remote(host=http_host, port=http_port)
+    return ray_tpu.get(proxy.ready.remote(), timeout=60)
+
+
+def delete(name: str):
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def shutdown():
+    """Tear down all deployments, the controller, and the proxy."""
+    from ray_tpu.serve._http import PROXY_NAME
+
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME, namespace=SERVE_NAMESPACE)
+        ray_tpu.get(proxy.stop.remote(), timeout=30)
+        ray_tpu.kill(proxy)
+    except Exception:  # noqa: BLE001 — proxy never started
+        pass
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001 — controller never started
+        pass
+
+
+__all__ = [
+    "Deployment",
+    "DeploymentHandle",
+    "deployment",
+    "run",
+    "start",
+    "status",
+    "delete",
+    "shutdown",
+    "get_deployment_handle",
+]

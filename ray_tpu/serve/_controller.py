@@ -1,0 +1,273 @@
+"""Serve controller actor: owns deployment state and drives replicas.
+
+Reference: python/ray/serve/_private/controller.py:130 (ServeController) +
+deployment_state.py:2877 (replica lifecycle) + autoscaling_policy.py. One
+detached controller per cluster reconciles target vs running replicas and
+autoscales on the replicas' reported in-flight request counts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "serve-controller"
+SERVE_NAMESPACE = "_serve"
+
+
+@ray_tpu.remote
+class ServeController:
+    """Async actor reconciling deployments (reference: controller.py:130)."""
+
+    def __init__(self):
+        # name -> {"config": {...}, "replicas": [handles], "target": int}
+        self.deployments: Dict[str, dict] = {}
+        self._reconcile_task = None
+        self._running = True
+        # All replica-set mutations interleave on the actor's event loop
+        # (deploy / delete / reconcile are concurrent method calls); without
+        # mutual exclusion a reconcile resuming from an await can re-create
+        # replicas of a deployment a concurrent delete just tore down,
+        # leaking detached actors that pin node resources forever.
+        self._scale_lock = asyncio.Lock()
+
+    async def _ensure_loop(self):
+        t = self._reconcile_task
+        if t is not None and t.done():
+            # a crashed loop must not stay dead silently (its exception was
+            # never awaited) — log and restart
+            exc = t.exception() if not t.cancelled() else None
+            if exc is not None:
+                import logging
+
+                logging.getLogger(__name__).error(
+                    "serve reconcile loop crashed: %r — restarting", exc)
+            t = None
+        if t is None:
+            self._reconcile_task = asyncio.ensure_future(self._reconcile_loop())
+
+    # -- deployment API -------------------------------------------------
+
+    async def deploy(self, name: str, callable_blob: bytes,
+                     init_args_blob: bytes, num_replicas: int,
+                     autoscaling: Optional[dict] = None,
+                     actor_options: Optional[dict] = None,
+                     max_concurrent: int = 100) -> bool:
+        await self._ensure_loop()
+        async with self._scale_lock:
+            old = self.deployments.get(name)
+            if old is not None:
+                # config change: roll all existing replicas
+                old["target"] = 0
+                await self._scale_to_locked(name, 0)
+            self.deployments[name] = {
+                "config": {
+                    "callable_blob": callable_blob,
+                    "init_args_blob": init_args_blob,
+                    "autoscaling": autoscaling,
+                    "actor_options": dict(actor_options or {}),
+                    "max_concurrent": max_concurrent,
+                },
+                "replicas": [],
+                "next_id": old["next_id"] if old else 0,
+                "target": num_replicas,
+            }
+            await self._scale_to_locked(name, num_replicas)
+        return True
+
+    async def delete_deployment(self, name: str) -> bool:
+        async with self._scale_lock:
+            if name in self.deployments:
+                await self._scale_to_locked(name, 0)
+                del self.deployments[name]
+        return True
+
+    async def get_replicas(self, name: str) -> list:
+        d = self.deployments.get(name)
+        if d is None:
+            return []
+        # Filter replicas this worker already knows are dead (actor-state
+        # pubsub lands here between reconcile ticks) — don't hand a router a
+        # replica we know can't serve. The reconcile loop replaces them.
+        from ray_tpu._private import protocol as pb
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        live = []
+        for r in d["replicas"]:
+            st = cw._actor_states.get(r._actor_id.binary())
+            if st is not None and st.state == pb.ACTOR_DEAD:
+                continue
+            live.append(r)
+        return live
+
+    async def list_deployments(self) -> dict:
+        return {
+            name: {
+                "target": d["target"],
+                "running": len(d["replicas"]),
+                "autoscaling": d["config"]["autoscaling"],
+            }
+            for name, d in self.deployments.items()
+        }
+
+    async def debug_state(self) -> dict:
+        t = self._reconcile_task
+        return {
+            "deployments": {
+                name: {
+                    "target": d["target"],
+                    "replicas": [r._actor_id.hex()[:8] for r in d["replicas"]],
+                }
+                for name, d in self.deployments.items()
+            },
+            "lock_locked": self._scale_lock.locked(),
+            "reconcile": (
+                "none" if t is None
+                else "done:" + repr(t.exception() if not t.cancelled() else "cancelled")
+                if t.done() else "running"
+            ),
+        }
+
+    async def shutdown(self) -> bool:
+        self._running = False
+        for name in list(self.deployments):
+            await self.delete_deployment(name)
+        return True
+
+    # -- reconciliation -------------------------------------------------
+
+    async def _kill_replica(self, replica):
+        """Awaited kill: ray_tpu.kill from the controller's event loop is
+        fire-and-forget, and a controller torn down right after scheduling
+        the kill would leak the detached named replica forever."""
+        from ray_tpu._private.core_worker import get_core_worker
+
+        try:
+            await get_core_worker().kill_actor(
+                replica._actor_id.binary(), no_restart=True)
+        except Exception:  # noqa: BLE001 — already dead
+            pass
+
+    async def _scale_to_locked(self, name: str, target: int):
+        """Scale a deployment's replica set; caller must hold _scale_lock.
+        Re-checks deployment identity after every await — a redeploy swaps
+        the dict and this scale must not touch the new generation."""
+        from ray_tpu.serve._replica import ServeReplica
+
+        d = self.deployments.get(name)
+        if d is None:
+            return
+        cfg = d["config"]
+        while len(d["replicas"]) < target:
+            rid = d["next_id"]
+            d["next_id"] += 1
+            opts = dict(cfg["actor_options"])
+            replica = ServeReplica.options(
+                name=f"serve:{name}:{rid}", namespace=SERVE_NAMESPACE,
+                max_concurrency=max(8, cfg["max_concurrent"]),
+                lifetime="detached", **opts,
+            ).remote(
+                name, rid, cfg["callable_blob"], cfg["init_args_blob"],
+                max_concurrent=cfg["max_concurrent"],
+            )
+            # fail fast if the replica can't construct — and reap the actor,
+            # or a late start would leak a detached replica holding resources
+            try:
+                await replica.health.remote()
+            except Exception:
+                await self._kill_replica(replica)
+                raise
+            if self.deployments.get(name) is not d:
+                await self._kill_replica(replica)
+                return
+            d["replicas"].append(replica)
+        while len(d["replicas"]) > target:
+            await self._kill_replica(d["replicas"].pop())
+
+    async def _reconcile_loop(self):
+        """Autoscaling + health: every second, poll replica stats; scale
+        toward ceil(total_ongoing / target_ongoing_requests) within
+        [min_replicas, max_replicas] (reference: autoscaling_policy.py
+        request-based policy)."""
+        while self._running:
+            await asyncio.sleep(1.0)
+            for name, d in list(self.deployments.items()):
+                try:
+                    await self._reconcile_deployment(name, d)
+                except Exception:  # noqa: BLE001 — one deployment's failure
+                    # must not kill reconciliation for the rest
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "reconcile of %s failed", name)
+
+    async def _reconcile_deployment(self, name: str, d: dict):
+        async with self._scale_lock:
+            if self.deployments.get(name) is not d:
+                return  # deleted or redeployed while we waited for the lock
+            auto = d["config"]["autoscaling"]
+            # replace dead replicas
+            alive = []
+            for r in d["replicas"]:
+                try:
+                    await r.health.remote()
+                    alive.append(r)
+                except Exception:  # noqa: BLE001 — replica died
+                    pass
+            if self.deployments.get(name) is not d:
+                return
+            d["replicas"] = alive
+            if auto is None:
+                if len(d["replicas"]) < d["target"]:
+                    await self._scale_to_locked(name, d["target"])
+                return
+            ongoing = 0
+            for r in d["replicas"]:
+                try:
+                    st = await r.stats.remote()
+                    ongoing += max(st["ongoing"], st.get("peak_ongoing", 0))
+                except Exception:  # noqa: BLE001
+                    pass
+            if self.deployments.get(name) is not d:
+                return
+            target_per = max(1, auto.get("target_ongoing_requests", 2))
+            desired = math.ceil(ongoing / target_per) if ongoing else auto.get("min_replicas", 1)
+            desired = min(max(desired, auto.get("min_replicas", 1)),
+                          auto.get("max_replicas", 8))
+            if desired != d["target"]:
+                d["target"] = desired
+            await self._scale_to_locked(name, d["target"])
+
+
+def _create_controller():
+    return ServeController.options(
+        name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE, lifetime="detached",
+        max_concurrency=64,
+    ).remote()
+
+
+def get_or_create_controller():
+    """Named detached controller, one per cluster (reference:
+    serve.start creating the controller under SERVE_CONTROLLER_NAME)."""
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+    except ValueError:
+        pass
+    return _create_controller()
+
+
+async def get_or_create_controller_async():
+    """Loop-safe variant for async actors (the HTTP proxy) — a blocking
+    get_actor on the core event loop would deadlock."""
+    from ray_tpu._private.worker import get_actor_async
+
+    try:
+        return await get_actor_async(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+    except ValueError:
+        pass
+    return _create_controller()

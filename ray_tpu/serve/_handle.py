@@ -1,0 +1,240 @@
+"""DeploymentHandle: client-side router over a deployment's replicas.
+
+Reference: python/ray/serve/handle.py (DeploymentHandle) +
+_private/router.py:556 (ReplicaScheduler). Routing is power-of-two-choices
+over locally tracked in-flight counts; the replica set refreshes from the
+controller periodically and on failure.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+_REFRESH_S = 2.0
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller=None):
+        self.deployment_name = deployment_name
+        # controller may be None for a deserialized handle: resolution is
+        # deferred to first use because unpickling can happen on the core
+        # event loop (task args), where a blocking get_actor would deadlock
+        self._controller = controller
+        self._replicas: List[Any] = []
+        # replica actor-id -> issued-not-consumed; keyed by id (not index) so
+        # counts survive replica-set changes and periodic refreshes — wiping
+        # them would erase the power-of-two-choices load signal every 2 s
+        self._inflight: Dict[bytes, int] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    def _resolve_controller(self):
+        if self._controller is None:
+            from ray_tpu.serve._controller import get_or_create_controller
+
+            self._controller = get_or_create_controller()
+        return self._controller
+
+    async def _resolve_controller_async(self):
+        if self._controller is None:
+            from ray_tpu.serve._controller import get_or_create_controller_async
+
+            self._controller = await get_or_create_controller_async()
+        return self._controller
+
+    def _stale(self, force: bool) -> bool:
+        return force or not self._replicas or (
+            time.monotonic() - self._last_refresh >= _REFRESH_S
+        )
+
+    def _install(self, replicas: List[Any]):
+        with self._lock:
+            self._replicas = replicas
+            keep = {r._actor_id.binary() for r in replicas}
+            self._inflight = {
+                rid: n for rid, n in self._inflight.items() if rid in keep
+            }
+            self._last_refresh = time.monotonic()
+
+    async def _refresh_async(self, force: bool = False):
+        """Refresh path for callers on the core event loop (HTTP proxy,
+        async actors) where a blocking get would deadlock."""
+        if not self._stale(force):
+            return
+        controller = await self._resolve_controller_async()
+        self._install(
+            await controller.get_replicas.remote(self.deployment_name)
+        )
+
+    def _refresh(self, force: bool = False):
+        if not self._stale(force):
+            return
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        if cw._loop_running_here():
+            # non-blocking: serve from the current cache, refresh in the
+            # background (first use on a loop must go through _refresh_async)
+            if self._replicas:
+                cw.schedule(self._refresh_async(force=True))
+                return
+            raise RuntimeError(
+                "DeploymentHandle used on the event loop before its replica "
+                "cache was primed — await handle._refresh_async() first"
+            )
+        controller = self._resolve_controller()
+        self._install(ray_tpu.get(
+            controller.get_replicas.remote(self.deployment_name),
+            timeout=30,
+        ))
+
+    def _pick(self) -> tuple:
+        """Power-of-two-choices on local in-flight counts (router.py:556)."""
+        self._refresh()
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no replicas")
+            if n == 1:
+                i = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                load_a = self._inflight.get(self._replicas[a]._actor_id.binary(), 0)
+                load_b = self._inflight.get(self._replicas[b]._actor_id.binary(), 0)
+                i = a if load_a <= load_b else b
+            rid = self._replicas[i]._actor_id.binary()
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            return rid, self._replicas[i]
+
+    def _done(self, rid: bytes):
+        with self._lock:
+            if self._inflight.get(rid, 0) > 0:
+                self._inflight[rid] -= 1
+
+    def remote(self, *args, **kwargs):
+        """Route one request; returns an ObjectRef of the result."""
+        idx, replica = self._pick()
+        try:
+            ref = replica.handle_request.remote(*args, **kwargs)
+            return _TrackedRef(ref, self, idx, call=(None, args, kwargs))
+        except Exception:
+            self._refresh(force=True)
+            raise
+
+    def method(self, method_name: str):
+        """Handle for a non-__call__ method (reference: handle.method_name)."""
+        return _MethodCaller(self, method_name)
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self.deployment_name,))
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method_name: str):
+        self._handle = handle
+        self._method = method_name
+
+    def remote(self, *args, **kwargs):
+        idx, replica = self._handle._pick()
+        try:
+            ref = replica.call_method.remote(self._method, *args, **kwargs)
+            return _TrackedRef(ref, self._handle, idx,
+                               call=(self._method, args, kwargs))
+        except Exception:
+            self._handle._refresh(force=True)
+            raise
+
+
+def _rebuild_handle(name: str) -> DeploymentHandle:
+    # controller resolution is lazy: unpickling may run on the core event
+    # loop (task-arg deserialization), where get_actor would deadlock
+    return DeploymentHandle(name)
+
+
+class _TrackedRef:
+    """Wraps the result ref so the router's in-flight count drops when the
+    result is consumed (or the wrapper is GC'd)."""
+
+    __slots__ = ("_ref", "_handle", "_idx", "_consumed", "_call")
+
+    def __init__(self, ref, handle: DeploymentHandle, idx: int,
+                 call: Optional[tuple] = None):
+        self._ref = ref
+        self._handle = handle
+        self._idx = idx
+        self._consumed = False
+        self._call = call  # (method|None, args, kwargs) for failover resubmit
+
+    def result(self, timeout: Optional[float] = 60.0):
+        from ray_tpu._private.errors import ActorDiedError, ActorUnavailableError
+
+        # The replica set can contain a replica that died after the
+        # controller's last health pass — fail over to another replica, as
+        # the reference router reassigns requests on unavailable replicas.
+        attempts = 4
+        while True:
+            try:
+                value = ray_tpu.get(self._ref, timeout=timeout)
+            except (ActorDiedError, ActorUnavailableError) as failure:
+                self._consume()
+                attempts -= 1
+                if self._call is None or attempts <= 0:
+                    raise
+                method, args, kwargs = self._call
+                caller = (self._handle if method is None
+                          else self._handle.method(method))
+                while True:
+                    # give the controller's reconcile loop (1 s cadence) time
+                    # to replace the dead replica before re-routing
+                    time.sleep(0.5 * (4 - attempts))
+                    self._handle._refresh(force=True)
+                    try:
+                        retry = caller.remote(*args, **kwargs)
+                        break
+                    except RuntimeError:
+                        # every replica is dead at this instant; wait for the
+                        # reconcile to bring one up, within the attempt budget
+                        attempts -= 1
+                        if attempts <= 0:
+                            raise failure from None
+                retry._consumed = True  # this wrapper takes the in-flight slot
+                self._ref = retry._ref
+                self._idx = retry._idx
+                self._consumed = False
+            except BaseException:
+                self._consume()
+                raise
+            else:
+                self._consume()
+                return value
+
+    def _consume(self):
+        if not self._consumed:
+            self._consumed = True
+            self._handle._done(self._idx)
+
+    # duck-type as an ObjectRef for ray_tpu.get()
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_ref"), name)
+
+    def __await__(self):
+        def gen():
+            try:
+                value = yield from self._ref.__await__()
+                return value
+            finally:
+                self._consume()
+
+        return gen()
+
+    def __del__(self):
+        try:
+            self._consume()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass

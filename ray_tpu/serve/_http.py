@@ -1,0 +1,95 @@
+"""HTTP ingress for serve deployments.
+
+Reference: python/ray/serve/_private/proxy.py (HTTP proxy actor routing
+`/app` paths to deployment handles). aiohttp server inside a detached actor;
+POST /<deployment> with a JSON (or raw bytes) body routes to the
+deployment's __call__ and returns the JSON-encoded result.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+import ray_tpu
+
+PROXY_NAME = "serve-http-proxy"
+SERVE_NAMESPACE = "_serve"
+
+
+@ray_tpu.remote
+class HttpProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        # NB: actor constructors run on an executor thread — the server is
+        # started lazily from ready() where the event loop is available
+        self.host = host
+        self.port = port
+        self._runner = None
+        self._handles = {}
+        self._site = None
+        self._started = None
+
+    async def _start(self):
+        from aiohttp import web
+
+        from ray_tpu.serve._controller import get_or_create_controller_async
+
+        self._controller = await get_or_create_controller_async()
+        app = web.Application()
+        app.router.add_route("*", "/{deployment}", self._dispatch)
+        app.router.add_get("/-/routes", self._routes)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+        return True
+
+    async def ready(self) -> str:
+        if self._started is None:
+            self._started = asyncio.ensure_future(self._start())
+        await self._started
+        return f"http://{self.host}:{self.port}"
+
+    async def _routes(self, request):
+        from aiohttp import web
+
+        deployments = await self._controller.list_deployments.remote()
+        return web.json_response(deployments)
+
+    async def _dispatch(self, request):
+        from aiohttp import web
+
+        from ray_tpu.serve._handle import DeploymentHandle
+
+        name = request.match_info["deployment"]
+        handle = self._handles.get(name)
+        if handle is None:
+            handle = DeploymentHandle(name, self._controller)
+            await handle._refresh_async(force=True)
+            if not handle._replicas:
+                return web.json_response(
+                    {"error": f"no deployment {name!r}"}, status=404)
+            self._handles[name] = handle
+        else:
+            await handle._refresh_async()
+        body = await request.read()
+        if request.content_type == "application/json" and body:
+            payload = json.loads(body)
+        elif body:
+            payload = body
+        else:
+            payload = None
+        try:
+            result = await handle.remote(payload)
+        except Exception as e:  # noqa: BLE001 — surface as 500
+            return web.json_response({"error": str(e)}, status=500)
+        try:
+            return web.json_response({"result": result})
+        except TypeError:
+            return web.Response(body=bytes(result))
+
+    async def stop(self) -> bool:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        return True

@@ -1,0 +1,201 @@
+"""ray_tpu.data tests: block ops, lazy fused execution, readers, batch
+iteration, splits — mirroring the reference's data tests (reference:
+python/ray/data/tests/test_basic.py / test_map.py / test_split.py).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.block import (
+    block_concat,
+    block_num_rows,
+    block_slice,
+    rows_to_block,
+)
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+# -- block utilities (no cluster needed) ------------------------------------
+
+
+def test_block_roundtrip():
+    b = rows_to_block([{"x": 1, "y": 2.0}, {"x": 3, "y": 4.0}])
+    assert isinstance(b, dict)
+    assert block_num_rows(b) == 2
+    assert b["x"].tolist() == [1, 3]
+    sl = block_slice(b, 1, 2)
+    assert sl["y"].tolist() == [4.0]
+    cat = block_concat([b, b])
+    assert block_num_rows(cat) == 4
+
+
+def test_block_ragged_rows_stay_rows():
+    b = rows_to_block([{"x": 1}, {"y": 2}])
+    assert isinstance(b, list) and len(b) == 2
+
+
+# -- core pipeline ----------------------------------------------------------
+
+
+def test_range_count_take(ray_init):
+    ds = rd.range(1000, parallelism=8)
+    assert ds.num_blocks() == 8
+    assert ds.count() == 1000
+    rows = ds.take(3)
+    assert [r["id"] for r in rows] == [0, 1, 2]
+
+
+def test_map_batches_fused_chain(ray_init):
+    ds = (
+        rd.range(100, parallelism=4)
+        .map_batches(lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+        .filter(lambda r: r["id"] % 2 == 0)
+        .map(lambda r: {"v": int(r["sq"]) + 1})
+    )
+    rows = ds.take_all()
+    assert len(rows) == 50
+    assert rows[1]["v"] == 2 * 2 + 1
+
+
+def test_flat_map(ray_init):
+    ds = rd.from_items([1, 2, 3]).flat_map(lambda x: [x, x * 10])
+    assert sorted(ds.take_all()) == [1, 2, 3, 10, 20, 30]
+
+
+def test_iter_batches_across_blocks(ray_init):
+    ds = rd.range(1000, parallelism=7)
+    batches = list(ds.iter_batches(batch_size=128))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 1000
+    assert all(s == 128 for s in sizes[:-1])
+    flat = np.concatenate([b["id"] for b in batches])
+    assert flat.tolist() == list(range(1000))
+
+
+def test_iter_batches_drop_last(ray_init):
+    ds = rd.range(100, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=32, drop_last=True))
+    assert all(len(b["id"]) == 32 for b in batches)
+    assert len(batches) == 3
+
+
+def test_split_for_workers(ray_init):
+    ds = rd.range(100, parallelism=8).materialize()
+    shards = ds.split(3)
+    counts = [s.count() for s in shards]
+    assert sum(counts) == 100
+    assert all(c > 0 for c in counts)
+
+
+def test_split_equal(ray_init):
+    ds = rd.range(100, parallelism=7)
+    shards = ds.split(4, equal=True)
+    assert [s.count() for s in shards] == [25, 25, 25, 25]
+
+
+def test_repartition_and_shuffle(ray_init):
+    ds = rd.range(90, parallelism=9).repartition(3)
+    assert ds.num_blocks() == 3
+    assert ds.count() == 90
+    sh = rd.range(50, parallelism=5).random_shuffle(seed=7)
+    ids = [r["id"] for r in sh.take_all()]
+    assert sorted(ids) == list(range(50))
+    assert ids != list(range(50))  # actually shuffled
+
+
+def test_materialize_caches(ray_init):
+    ds = rd.range(64, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] * 2}).materialize()
+    assert ds.count() == 64
+    assert ds.count() == 64  # second pass reuses block refs
+    assert ds.schema() == {"id": "int64"}
+
+
+# -- readers ----------------------------------------------------------------
+
+
+def test_read_parquet_roundtrip(ray_init, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for i in range(3):
+        pq.write_table(
+            pa.table({"a": list(range(i * 10, i * 10 + 10)),
+                      "b": [float(x) for x in range(10)]}),
+            str(tmp_path / f"part{i}.parquet"),
+        )
+    ds = rd.read_parquet(str(tmp_path))
+    assert ds.num_blocks() == 3
+    assert ds.count() == 30
+    total = sum(b["a"].sum() for b in ds.iter_batches(batch_size=None))
+    assert total == sum(range(30))
+
+
+def test_read_csv(ray_init, tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("x,y\n1,a\n2,b\n3,c\n")
+    ds = rd.read_csv(str(p))
+    rows = ds.take_all()
+    assert [r["x"] for r in rows] == [1, 2, 3]
+
+
+def test_read_binary_and_images(ray_init, tmp_path):
+    from PIL import Image
+
+    (tmp_path / "f.bin").write_bytes(b"\x01\x02")
+    ds = rd.read_binary_files(str(tmp_path / "f.bin"), include_paths=True)
+    rows = ds.take_all()
+    assert rows[0]["bytes"] == b"\x01\x02"
+
+    for i in range(4):
+        Image.new("RGB", (10 + i, 8), color=(i, 0, 0)).save(
+            tmp_path / f"img{i}.png")
+    ids = rd.read_images(str(tmp_path) + "/*.png", size=(8, 8))
+    batches = list(ids.iter_batches(batch_size=None))
+    n = sum(b["image"].shape[0] for b in batches)
+    assert n == 4
+    assert batches[0]["image"].shape[1:] == (8, 8, 3)
+
+
+# -- integration with Train -------------------------------------------------
+
+
+def test_dataset_feeds_training(ray_init, tmp_path):
+    """Input-pipeline-fed training run (VERDICT #7 done-criterion): workers
+    consume disjoint shards via iter_batches."""
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+
+    ds = rd.range(256, parallelism=8).map_batches(
+        lambda b: {"x": b["id"].astype(np.float32)})
+    shards = ds.split(2, equal=True)
+    shard_refs = [[r.binary() for r in s._refs] for s in shards]  # noqa: F841
+
+    def train_fn():
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        shard = shards[ctx.get_world_rank()]
+        seen = 0
+        for batch in shard.iter_batches(batch_size=32):
+            seen += len(batch["x"])
+        train.report({"rows": seen, "rank": ctx.get_world_rank()})
+
+    result = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="data-feed", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    rows = [m["rows"] for m in result.metrics_history]
+    assert sum(rows) == 256
+    assert rows == [128, 128]

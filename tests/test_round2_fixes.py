@@ -119,3 +119,29 @@ def test_actor_large_ctor_arg_keepalive(ray_init):
     gc.collect()
     assert ray_tpu.get(h.total_.remote(), timeout=60) == expect
     ray_tpu.kill(h)
+
+
+def test_actor_seq_hole_on_bad_args(ray_init):
+    """An actor call whose args can't be serialized must fail cleanly AND
+    not leave a sequence hole that stalls later calls (code-review finding:
+    the guard path now delivers a cancelled tombstone for the taken slot)."""
+    import threading
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    bad = threading.Lock()  # unpicklable
+    with pytest.raises(TypeError):
+        c.incr.remote(bad)
+    # the next ordered call must proceed promptly (no ordering-gap timeout,
+    # because the failed submission never consumed a sequence slot)
+    assert ray_tpu.get(c.incr.remote(), timeout=15) == 2
+    ray_tpu.kill(c)

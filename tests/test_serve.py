@@ -1,0 +1,227 @@
+"""Serve layer tests: deployments, routing, autoscaling, HTTP ingress —
+mirroring the reference's serve tests (reference: python/ray/serve/tests/
+test_standalone.py / test_autoscaling_policy.py / test_proxy.py).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_deployments(ray_init):
+    yield
+    for name in list(serve.status()):
+        serve.delete(name)
+
+
+def test_deploy_and_call(ray_init):
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __init__(self, prefix="echo"):
+            self.prefix = prefix
+
+        def __call__(self, x):
+            return f"{self.prefix}:{x}"
+
+    handle = serve.run(Echo.bind(prefix="hi"))
+    assert handle.remote("a").result(timeout=60) == "hi:a"
+    results = [handle.remote(i).result(timeout=60) for i in range(10)]
+    assert results == [f"hi:{i}" for i in range(10)]
+    st = serve.status()
+    assert st["Echo"]["running"] == 2
+
+
+def test_function_deployment(ray_init):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind())
+    assert handle.remote(21).result(timeout=60) == 42
+
+
+def test_method_call_and_redeploy(ray_init):
+    @serve.deployment(num_replicas=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def __call__(self, _x=None):
+            return "root"
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    handle = serve.run(Counter.bind())
+    assert handle.method("incr").remote().result(timeout=60) == 1
+    assert handle.method("incr").remote().result(timeout=60) == 2
+    # redeploy resets state (rolling replace)
+    handle = serve.run(Counter.bind())
+    time.sleep(0.5)
+    assert handle.method("incr").remote().result(timeout=60) == 1
+
+
+def test_routing_spreads_load(ray_init):
+    import os as _os
+
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __call__(self, _x=None):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind())
+    pids = {handle.remote().result(timeout=60) for _ in range(30)}
+    assert len(pids) >= 2  # power-of-two-choices touches multiple replicas
+
+
+def test_autoscaling_up_under_load(ray_init):
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_ongoing_requests": 1,
+        },
+    )
+    class Slow:
+        def __call__(self, _x=None):
+            import time as t
+
+            t.sleep(1.2)
+            return "done"
+
+    handle = serve.run(Slow.bind())
+    assert serve.status()["Slow"]["running"] == 1
+    # flood: 9 concurrent slow requests push ongoing >> target
+    refs = [handle.remote(i) for i in range(9)]
+    deadline = time.time() + 30
+    scaled = 0
+    while time.time() < deadline:
+        scaled = serve.status()["Slow"]["running"]
+        if scaled >= 2:
+            break
+        time.sleep(0.5)
+    assert scaled >= 2, "autoscaler never scaled up under load"
+    for r in refs:
+        assert r.result(timeout=120) == "done"
+
+
+def test_http_ingress_roundtrip(ray_init):
+    import httpx
+
+    @serve.deployment(num_replicas=2)
+    class Adder:
+        def __call__(self, payload):
+            return {"sum": payload["a"] + payload["b"]}
+
+    serve.run(Adder.bind())
+    base = serve.start(http_port=18472)
+    deadline = time.time() + 30
+    while True:
+        try:
+            r = httpx.post(f"{base}/Adder", json={"a": 2, "b": 3}, timeout=30)
+            break
+        except httpx.TransportError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+    assert r.status_code == 200
+    assert r.json()["result"]["sum"] == 5
+    # unknown deployment -> 404
+    r2 = httpx.post(f"{base}/Nope", json={}, timeout=30)
+    assert r2.status_code == 404
+    # routes listing
+    r3 = httpx.get(f"{base}/-/routes", timeout=30)
+    assert "Adder" in r3.json()
+
+
+def test_shutdown_then_redeploy(ray_init):
+    """serve.shutdown must reap every detached replica before returning —
+    a fresh controller then reuses replica names without collisions."""
+
+    @serve.deployment(num_replicas=2)
+    def ping(_x=None):
+        return "pong"
+
+    handle = serve.run(ping.bind())
+    assert handle.remote().result(timeout=60) == "pong"
+    serve.shutdown()
+    # fresh controller, same deployment name: replica names must be free
+    handle = serve.run(ping.bind())
+    assert handle.remote().result(timeout=60) == "pong"
+
+
+def test_handle_as_task_arg(ray_init):
+    """A DeploymentHandle must survive pickling into a remote task and
+    route from there (reference: serve handles are passed between actors).
+    Regression: unpickling used to resolve the controller eagerly, which
+    deadlocks on the core event loop."""
+
+    @serve.deployment(num_replicas=1)
+    def triple(x):
+        return x * 3
+
+    handle = serve.run(triple.bind())
+
+    @ray_tpu.remote
+    def call_through(h, v):
+        return h.remote(v).result(timeout=60)
+
+    assert ray_tpu.get(call_through.remote(handle, 4), timeout=60) == 12
+
+
+def test_tracked_ref_works_with_get(ray_init):
+    """ray_tpu.get() accepts the handle's tracked ref wrapper."""
+
+    @serve.deployment(num_replicas=1)
+    def identity(x):
+        return x
+
+    handle = serve.run(identity.bind())
+    ref = handle.remote("v")
+    assert ray_tpu.get(ref, timeout=60) == "v"
+    assert ray_tpu.get([handle.remote(1), handle.remote(2)], timeout=60) == [1, 2]
+
+
+def test_replica_failure_recovery(ray_init):
+    @serve.deployment(num_replicas=2)
+    class Fragile:
+        def __call__(self, x=None):
+            return "ok"
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    handle = serve.run(Fragile.bind())
+    assert handle.remote().result(timeout=60) == "ok"
+    try:
+        handle.method("die").remote().result(timeout=30)
+    except Exception:
+        pass
+    # controller health loop replaces the dead replica
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if serve.status()["Fragile"]["running"] == 2:
+            break
+        time.sleep(0.5)
+    assert serve.status()["Fragile"]["running"] == 2
+    handle._refresh(force=True)
+    assert handle.remote().result(timeout=60) == "ok"
