@@ -120,10 +120,12 @@ _flag("max_task_retries_default", 3, "Default retries for idempotent tasks.")
 _flag("actor_max_restarts_default", 0, "Default actor restarts.")
 _flag("memory_store_max_bytes", 256 * 1024 * 1024, "Per-process in-memory store cap.")
 _flag("task_event_buffer_max", 10000, "Profile/task events buffered per worker before drop.")
+_flag("telemetry_flush_period_s", 1.0, "Task-event + metrics flush cadence to the control store.")
 _flag("control_store_port", 0, "Port for the control store (0 = auto).")
 _flag("scheduler_spread_threshold", 0.5, "Hybrid policy: pack below this utilization, then spread (reference: hybrid_scheduling_policy.h:50).")
 _flag("log_to_driver", True, "Forward worker stdout/stderr to the driver.")
 _flag("actor_creation_timeout_s", 120.0, "Control store waits this long for a daemon to lease+create an actor.")
+_flag("lease_request_timeout_s", 30.0, "Per-attempt deadline on a worker-lease RPC; timed-out requests are retried idempotently by request key (a lease may legitimately stay queued across many attempts).")
 _flag("placement_group_timeout_s", 60.0, "Placement group scheduling deadline before marked unschedulable.")
 _flag("actor_ordering_gap_timeout_s", 60.0, "Ordered actor task fails (never reorders) after waiting this long for a missing predecessor sequence number.")
 _flag("object_spill_enabled", True, "Spill cold sealed objects to disk under store memory pressure (reference: raylet local_object_manager spilling).")

@@ -20,6 +20,7 @@ reference (node_manager.proto:515-525, gcs_placement_group_manager.h).
 from __future__ import annotations
 
 import asyncio
+import collections
 from ray_tpu._private.aio import spawn
 import json
 import logging
@@ -29,6 +30,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import protocol as pb
 from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.errors import RpcError
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.protocol import NodeInfo, ResourceSet, TaskSpec
 from ray_tpu.runtime.rpc import RpcClient, RpcServer
@@ -180,6 +182,10 @@ class ControlStore:
         self.actors: Dict[bytes, ActorRecord] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (namespace, name) -> actor_id
         self.placement_groups: Dict[bytes, PlacementGroupRecord] = {}
+        # observability: bounded task-event history + per-worker metric
+        # snapshots (reference: GcsTaskManager, metrics agent)
+        self.task_events: "collections.deque[dict]" = collections.deque()
+        self.metrics_by_worker: Dict[bytes, dict] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._stopped = False
         self._wal = None
@@ -611,11 +617,43 @@ class ControlStore:
                         self.node_available[node_id] = avail - rec.spec.resources
                         deducted = True
                 daemon = await self._daemon(node_id)
-                reply = await daemon.call(
-                    "create_actor",
-                    {"spec": rec.spec.to_wire()},
-                    timeout=GLOBAL_CONFIG.get("actor_creation_timeout_s"),
-                )
+                reply = None
+                while True:
+                    try:
+                        # per-attempt deadline well under the overall budget:
+                        # a dropped call is retried against the SAME node
+                        # (daemon create is idempotent by actor id and the
+                        # original may still be in flight there) instead of
+                        # burning the whole deadline or racing a second node.
+                        # Long __init__s are fine: timed-out retries coalesce
+                        # onto the in-flight creation until the deadline.
+                        attempt_timeout = min(
+                            5.0, GLOBAL_CONFIG.get("actor_creation_timeout_s"))
+                        reply = await daemon.call(
+                            "create_actor",
+                            {"spec": rec.spec.to_wire()},
+                            timeout=attempt_timeout,
+                        )
+                        break
+                    except (RpcError, asyncio.TimeoutError) as e:
+                        node = self.nodes.get(node_id)
+                        node_dead = node is None or node.state != pb.NODE_ALIVE
+                        if (time.monotonic() >= deadline
+                                or rec.state == pb.ACTOR_DEAD):
+                            raise RuntimeError(
+                                f"create_actor RPC failed: {e}") from None
+                        if node_dead:
+                            break  # re-pick a different node below
+                        await asyncio.sleep(0.3)
+                if reply is None:
+                    # target node died mid-create: refund and re-pick
+                    if deducted and node_id in self.node_available:
+                        self.node_available[node_id] = (
+                            self.node_available[node_id] + rec.spec.resources
+                        )
+                    rejected.add(node_id)
+                    attempt += 1
+                    continue
                 if reply.get("ok"):
                     break
                 if deducted and node_id in self.node_available:
@@ -920,6 +958,46 @@ class ControlStore:
     async def rpc_get_placement_group(self, conn_id: int, payload: dict) -> dict:
         rec = self.placement_groups.get(payload["pg_id"])
         return {"pg": rec.to_wire() if rec else None}
+
+    async def rpc_list_placement_groups(self, conn_id: int, payload) -> dict:
+        return {"pgs": [r.to_wire() for r in self.placement_groups.values()]}
+
+    # ------------------------------------------------------------------
+    # task events + metrics ingestion (reference: gcs_task_manager.h task
+    # event history; stats/metric.h registry exported via the agent)
+    # ------------------------------------------------------------------
+
+    async def rpc_report_task_events(self, conn_id: int, payload: dict) -> dict:
+        cap = GLOBAL_CONFIG.get("task_event_buffer_max")
+        for ev in payload.get("events", []):
+            self.task_events.append(ev)
+        while len(self.task_events) > cap:
+            self.task_events.popleft()
+        return {"ok": True}
+
+    async def rpc_list_task_events(self, conn_id: int, payload) -> dict:
+        limit = (payload or {}).get("limit", 0)
+        events = list(self.task_events)
+        if limit:
+            events = events[-limit:]
+        return {"events": events}
+
+    async def rpc_report_metrics(self, conn_id: int, payload: dict) -> dict:
+        # latest snapshot per reporting worker; aggregation happens at read
+        self.metrics_by_worker[payload["worker_id"]] = {
+            "ts": time.time(),
+            "metrics": payload.get("metrics", []),
+        }
+        # prune workers that stopped reporting (died/reaped) — without this
+        # the table grows per worker ever seen and exports stale gauges
+        stale = time.time() - 60.0
+        for wid in [w for w, s in self.metrics_by_worker.items()
+                    if s["ts"] < stale]:
+            del self.metrics_by_worker[wid]
+        return {"ok": True}
+
+    async def rpc_get_metrics(self, conn_id: int, payload) -> dict:
+        return {"workers": self.metrics_by_worker}
 
     async def rpc_remove_placement_group(self, conn_id: int, payload: dict) -> dict:
         rec = self.placement_groups.get(payload["pg_id"])

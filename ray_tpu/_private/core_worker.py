@@ -425,6 +425,10 @@ class CoreWorker:
         self._function_cache: Dict[str, Any] = {}
         self._exported: set = set()
         self._inline_max = GLOBAL_CONFIG.get("inline_object_max_bytes")
+        from ray_tpu._private.task_events import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer()
+        self._telemetry_task: Optional[asyncio.Task] = None
         self._closed = False
 
     # ------------------------------------------------------------------
@@ -442,9 +446,41 @@ class CoreWorker:
         self.control.on_reconnect(
             lambda: self.control.call("subscribe", {"channel": "actors"})
         )
+        self._telemetry_task = spawn(self._telemetry_loop())
+
+    async def _telemetry_loop(self):
+        """Flush buffered task events + metric snapshots to the control
+        store (reference: task_event_buffer.h periodic GCS flush; metrics
+        agent push)."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        period = GLOBAL_CONFIG.get("telemetry_flush_period_s")
+        while not self._closed:
+            await asyncio.sleep(period)
+            events = self.task_events.drain()
+            try:
+                if events:
+                    await self.control.call(
+                        "report_task_events", {"events": events}, timeout=10)
+                    events = []
+                snap = metrics_mod.snapshot_all()
+                if snap:
+                    await self.control.call(
+                        "report_metrics",
+                        {"worker_id": self.worker_id.binary(), "metrics": snap},
+                        timeout=10,
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — telemetry must never kill the worker
+                if events:
+                    # control store blip: keep the batch for the next flush
+                    self.task_events.requeue(events)
 
     async def close(self):
         self._closed = True
+        if self._telemetry_task is not None:
+            self._telemetry_task.cancel()
         await self.server.stop()
         await self.control.close()
         await self.daemon.close()
@@ -1252,14 +1288,19 @@ class CoreWorker:
         address = self.daemon_address
         hops = 0
         last_warn = 0.0
+        # stable per-logical-request key: retries after a dropped/timed-out
+        # call attach to the daemon's original (possibly still queued)
+        # request instead of double-granting
+        request_key = os.urandom(16)
         while True:
             client = await self._owner_client(address)
-            inner = spawn(client.call("request_lease", {
+            inner = spawn(self._lease_call_with_deadline(client, {
                 "resources": spec.resources.to_wire(),
                 "strategy": spec.strategy.to_wire(),
                 "job_id": self.job_id.binary(),
                 "hops": hops,
-            }, timeout=None))
+                "request_key": request_key,
+            }))
             try:
                 reply = await asyncio.shield(inner)
             except asyncio.CancelledError:
@@ -1298,6 +1339,28 @@ class CoreWorker:
                 address = self.daemon_address
                 continue
             raise RayTpuError(f"lease request failed: {reply}")
+
+    async def _lease_call_with_deadline(self, client, payload: dict) -> dict:
+        """request_lease with a per-attempt deadline, retried forever: the
+        lease may legitimately stay queued on a busy daemon (the reference
+        holds RequestWorkerLease open indefinitely), while a dropped call is
+        recovered after one deadline because the request_key makes retries
+        idempotent (daemon coalesces them onto the original request)."""
+        deadline_s = GLOBAL_CONFIG.get("lease_request_timeout_s")
+        while True:
+            try:
+                return await client.call("request_lease", payload,
+                                         timeout=deadline_s)
+            except asyncio.TimeoutError:
+                await asyncio.sleep(0.05)
+            except RpcError as e:
+                # timeouts mean the lease is (still) queued — keep waiting.
+                # Connection-level failures mean the daemon is gone and must
+                # propagate so _submit_with_retries re-routes/fails the task.
+                if isinstance(e.__cause__, asyncio.TimeoutError):
+                    await asyncio.sleep(0.05)
+                    continue
+                raise
 
     def _return_orphan_lease(self, daemon_address: str, t: asyncio.Task):
         if t.cancelled() or t.exception() is not None:
@@ -1454,8 +1517,14 @@ class CoreWorker:
         self._actor_state(actor_id.binary()).creation_keepalive = pyrefs
         await self.control.call("register_actor", {"spec": spec.to_wire()})
 
-    async def wait_actor_alive(self, actor_id: bytes, timeout: float = 60.0):
+    async def wait_actor_alive(self, actor_id: bytes,
+                               timeout: Optional[float] = None):
         st = self._actor_state(actor_id)
+        if timeout is None:
+            # track the control store's creation budget (plus margin for its
+            # retries) — a caller giving up before the scheduler does turns
+            # recoverable delays into spurious ActorUnavailableErrors
+            timeout = GLOBAL_CONFIG.get("actor_creation_timeout_s") + 30.0
         deadline = time.monotonic() + timeout
         while st.state != pb.ACTOR_ALIVE:
             if st.state == pb.ACTOR_DEAD:

@@ -140,6 +140,11 @@ class NodeDaemon:
         self.leases: Dict[bytes, Tuple[bytes, ResourceSet, Optional[bytes]]] = {}
         #   lease_id -> (worker_id, resources, pg_id, bundle_index)
         self.pending: List[PendingLease] = []
+        # idempotency for retried RPCs (dropped/timed-out calls re-sent by
+        # clients must not double-grant/double-create)
+        self._lease_requests: Dict[bytes, asyncio.Task] = {}
+        self._lease_key_by_id: Dict[bytes, bytes] = {}
+        self._creating_actors: Dict[bytes, asyncio.Task] = {}
         # cluster view: node_id hex -> available ResourceSet
         self.cluster_view: Dict[str, ResourceSet] = {}
         self.peer_nodes: Dict[str, NodeInfo] = {}
@@ -244,7 +249,9 @@ class NodeDaemon:
                         "node_id": self.node_id.binary(),
                         "available": self.available.to_wire(),
                     },
-                    timeout=period * 5,
+                    # short deadline: a dropped beat must not silence this
+                    # node long enough to trip health_check_timeout_s
+                    timeout=period * 2,
                 )
                 if reply.get("unknown"):
                     # the control store restarted without (or before) our
@@ -375,6 +382,10 @@ class NodeDaemon:
         idle = self.idle_by_job.get(w.job_id, [])
         if w.worker_id.binary() in idle:
             idle.remove(w.worker_id.binary())
+        if w.actor_id is not None:
+            # drop the idempotent-create cache entry, or the daemon leaks one
+            # completed task per actor ever created on this node
+            self._creating_actors.pop(w.actor_id, None)
         if w.tpu_chips:
             self._return_chips(w.tpu_chips)
             w.tpu_chips = None
@@ -442,6 +453,32 @@ class NodeDaemon:
     # ------------------------------------------------------------------
 
     async def rpc_request_lease(self, conn_id: int, payload: dict) -> dict:
+        # Idempotent by caller-supplied request_key: a client retrying after
+        # a timed-out/dropped call must attach to the original request, not
+        # queue (and eventually be granted) a second lease (reference:
+        # RequestWorkerLease is retried by the retryable grpc client; chaos
+        # tests drop it on purpose).
+        key = payload.get("request_key")
+        if key is None:
+            return await self._request_lease_inner(payload)
+        task = self._lease_requests.get(key)
+        if task is None:
+            task = spawn(self._request_lease_inner(payload))
+            self._lease_requests[key] = task
+
+            def _settle(t, key=key):
+                reply = None if t.cancelled() or t.exception() else t.result()
+                if reply is not None and reply.get("granted"):
+                    # cache until the lease is released, so late retries see
+                    # the same grant instead of double-granting
+                    self._lease_key_by_id[reply["lease_id"]] = key
+                else:
+                    self._lease_requests.pop(key, None)
+
+            task.add_done_callback(_settle)
+        return await asyncio.shield(task)
+
+    async def _request_lease_inner(self, payload: dict) -> dict:
         spec_res = ResourceSet.from_wire(payload["resources"])
         strategy = pb.SchedulingStrategy.from_wire(payload.get("strategy"))
         job_id = payload["job_id"]
@@ -612,6 +649,9 @@ class NodeDaemon:
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return
+        key = self._lease_key_by_id.pop(lease_id, None)
+        if key is not None:
+            self._lease_requests.pop(key, None)
         worker_id, res, pg_id, bundle_index = lease
         if pg_id is not None:
             pg = self.pg_prepared.get(pg_id)
@@ -644,6 +684,8 @@ class NodeDaemon:
             return {"ok": False}
         actor_id = w.actor_id
         w.actor_id = None  # killed on purpose: no death report
+        if actor_id is not None:
+            self._creating_actors.pop(actor_id, None)
         self._kill_worker_proc(w, payload.get("reason", "kill_worker"))
         if w.lease_id is not None:
             self._release_lease(w.lease_id)
@@ -669,7 +711,29 @@ class NodeDaemon:
     # ------------------------------------------------------------------
 
     async def rpc_create_actor(self, conn_id: int, payload: dict) -> dict:
+        """Idempotent by actor id: the control store retries a timed-out
+        create, and the retry must attach to (or observe) the original
+        attempt rather than spawn a second worker for the same actor."""
         spec = TaskSpec.from_wire(payload["spec"])
+        aid = spec.actor_id.binary()
+        task = self._creating_actors.get(aid)
+        if task is not None and task.done() and not task.cancelled() \
+                and task.exception() is None:
+            reply = task.result()
+            if reply.get("ok"):
+                w = self.workers.get(reply["worker_id"])
+                if w is not None and w.state == W_ACTOR and w.proc.poll() is None:
+                    return reply  # original create succeeded; worker alive
+            task = None  # failed or worker gone: this is a fresh incarnation
+        elif task is not None and (task.cancelled() or (
+                task.done() and task.exception() is not None)):
+            task = None
+        if task is None:
+            task = spawn(self._create_actor_inner(spec))
+            self._creating_actors[aid] = task
+        return await asyncio.shield(task)
+
+    async def _create_actor_inner(self, spec: TaskSpec) -> dict:
         # PG-scheduled actors consume their bundle's reservation, not the
         # node's general pool (reference: bundle resource accounting in
         # placement_group_resource_manager.h — same rule as PG leases)
@@ -768,6 +832,10 @@ class NodeDaemon:
 
     async def rpc_prepare_bundles(self, conn_id: int, payload: dict) -> dict:
         pg_id = payload["pg_id"]
+        if pg_id in self.pg_prepared:
+            # retried prepare (dropped response): already reserved — a second
+            # deduction would leak the bundle's resources permanently
+            return {"ok": True}
         bundles = [pb.Bundle.from_wire(b) for b in payload["bundles"]]
         need = ResourceSet()
         for b in bundles:

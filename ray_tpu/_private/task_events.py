@@ -1,0 +1,84 @@
+"""Per-process task-event buffer, flushed to the control store.
+
+Reference: src/ray/core_worker/profile_event.h:33 + task_event_buffer.h
+(workers buffer ProfileEvents, flush to GcsTaskManager,
+src/ray/gcs/gcs_task_manager.h) — `ray_tpu.timeline()` renders the history
+as Chrome-trace JSON the way `ray timeline` does
+(python/ray/_private/state.py:1017).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+
+class TaskEventBuffer:
+    def __init__(self):
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+
+    def record(self, *, task_id: bytes, name: str, kind: str, event: str,
+               worker_id: bytes, node_id: str, ts: Optional[float] = None,
+               duration_s: Optional[float] = None,
+               extra: Optional[Dict] = None):
+        ev = {
+            "task_id": task_id,
+            "name": name,
+            "kind": kind,            # NORMAL / ACTOR_CREATION / ACTOR_TASK
+            "event": event,          # RUNNING / FINISHED / FAILED
+            "worker_id": worker_id,
+            "node_id": node_id,
+            "ts": ts if ts is not None else time.time(),
+        }
+        if duration_s is not None:
+            ev["duration_s"] = duration_s
+        if extra:
+            ev.update(extra)
+        cap = GLOBAL_CONFIG.get("task_event_buffer_max")
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > cap:
+                del self._events[: len(self._events) - cap]
+
+    def drain(self) -> List[dict]:
+        with self._lock:
+            out, self._events = self._events, []
+            return out
+
+    def requeue(self, events: List[dict]):
+        """Put a drained-but-unflushed batch back (flush RPC failed) so a
+        control-store blip doesn't lose the interval's events."""
+        cap = GLOBAL_CONFIG.get("task_event_buffer_max")
+        with self._lock:
+            self._events = (events + self._events)[-cap:]
+
+
+_KIND_NAMES = {0: "normal", 1: "actor_creation", 2: "actor_task"}
+
+
+def to_chrome_trace(events: List[dict]) -> List[dict]:
+    """Chrome trace 'X' (complete) events from FINISHED/FAILED records.
+    pid = node, tid = worker — matching `ray timeline`'s layout."""
+    trace = []
+    for ev in events:
+        if ev["event"] not in ("FINISHED", "FAILED"):
+            continue
+        dur = ev.get("duration_s", 0.0)
+        trace.append({
+            "name": ev["name"],
+            "cat": _KIND_NAMES.get(ev["kind"], str(ev["kind"])),
+            "ph": "X",
+            "ts": (ev["ts"] - dur) * 1e6,
+            "dur": dur * 1e6,
+            "pid": f"node:{ev['node_id'][:12]}",
+            "tid": f"worker:{ev['worker_id'].hex()[:12]}",
+            "args": {
+                "task_id": ev["task_id"].hex(),
+                "status": ev["event"],
+            },
+        })
+    return trace

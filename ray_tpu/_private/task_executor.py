@@ -27,6 +27,7 @@ import inspect
 import logging
 import os
 import threading
+import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Tuple
@@ -126,6 +127,7 @@ class TaskExecutor:
             return await asyncio.shield(inflight)
         fut = asyncio.get_running_loop().create_future()
         self._in_flight[tid] = fut
+        t0 = time.time()
         try:
             if spec.kind == pb.TASK_KIND_NORMAL:
                 reply = await self._execute_normal(spec)
@@ -142,6 +144,17 @@ class TaskExecutor:
         finally:
             self._in_flight.pop(tid, None)
             self._cancelled.discard(tid)
+        # task-event history for the timeline / state API (reference:
+        # profile_event.h execution spans flushed to GcsTaskManager)
+        self.cw.task_events.record(
+            task_id=tid,
+            name=spec.name or spec.method_name or spec.function_key,
+            kind=spec.kind,
+            event="FAILED" if reply.get("error") else "FINISHED",
+            worker_id=self.cw.worker_id.binary(),
+            node_id=self.cw.node_id_hex or "",
+            duration_s=time.time() - t0,
+        )
         if spec.kind == pb.TASK_KIND_ACTOR_TASK:
             self._reply_cache[tid] = reply
             while len(self._reply_cache) > 1024:

@@ -1,0 +1,203 @@
+"""ray_tpu.tune — hyperparameter search over trial actors.
+
+Reference surface: python/ray/tune/tuner.py:43 (Tuner.fit), tune_config.py
+(TuneConfig), execution/tune_controller.py:72 (trial lifecycle loop),
+schedulers/async_hyperband.py (ASHA), search/basic_variant.py (grid/random
+variants), result_grid.py (ResultGrid/get_best_result).
+
+Original architecture: the controller is a driver-side polling loop (the
+reference's TuneController also runs in the driver process); each trial is
+an actor running the trainable on its executor thread, reporting through a
+drained buffer; schedulers see every result and stop trials cooperatively.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune._scheduler import CONTINUE, STOP, ASHAScheduler, FIFOScheduler
+from ray_tpu.tune._search import (
+    choice,
+    generate_variants,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune._trial import TrialActor, report
+
+
+@dataclass
+class TuneConfig:
+    """Reference: python/ray/tune/tune_config.py:15."""
+
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[Any] = None
+    seed: Optional[int] = None
+
+
+@dataclass
+class Result:
+    """One finished trial (reference: ray.tune ResultGrid rows)."""
+
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    status: str = "PENDING"
+    error: str = ""
+    checkpoints: List[dict] = field(default_factory=list)
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: Optional[str], mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    @property
+    def num_errors(self) -> int:
+        return sum(1 for r in self._results if r.status == "ERRORED")
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        sign = 1.0 if mode == "min" else -1.0
+        scored = [
+            r for r in self._results
+            if r.status in ("TERMINATED", "STOPPED") and metric in r.metrics
+        ]
+        if not scored:
+            raise RuntimeError("no completed trial reported the metric")
+        return min(scored, key=lambda r: sign * r.metrics[metric])
+
+    def get_dataframe(self):
+        rows = [{"trial_id": r.trial_id, **r.config, **r.metrics}
+                for r in self._results]
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:  # pragma: no cover
+            return rows
+
+
+class Tuner:
+    """Reference: python/ray/tune/tuner.py:43."""
+
+    def __init__(self, trainable: Callable[[dict], None], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 trial_resources: Optional[Dict[str, float]] = None):
+        self._trainable = trainable
+        self._param_space = dict(param_space or {})
+        self._cfg = tune_config or TuneConfig()
+        self._trial_resources = trial_resources
+
+    def fit(self, poll_interval: float = 0.1, timeout: float = 600.0) -> ResultGrid:
+        import cloudpickle
+
+        cfg = self._cfg
+        scheduler = cfg.scheduler or FIFOScheduler()
+        if isinstance(scheduler, ASHAScheduler) and scheduler.metric is None:
+            scheduler.metric = cfg.metric
+            scheduler.mode = cfg.mode
+        variants = list(generate_variants(
+            self._param_space, cfg.num_samples, seed=cfg.seed))
+        results = [
+            Result(trial_id=f"trial_{i:05d}", config=v)
+            for i, v in enumerate(variants)
+        ]
+        fn_blob = cloudpickle.dumps(self._trainable)
+        limit = cfg.max_concurrent_trials or len(results)
+        pending = list(range(len(results)))
+        running: Dict[int, Any] = {}  # result idx -> actor handle
+        deadline = time.monotonic() + timeout
+
+        def launch():
+            while pending and len(running) < limit:
+                i = pending.pop(0)
+                opts = {}
+                if self._trial_resources:
+                    opts["resources"] = dict(self._trial_resources)
+                actor = TrialActor.options(**opts).remote(
+                    fn_blob, results[i].config)
+                running[i] = actor
+                results[i].status = "RUNNING"
+
+        launch()
+        while running:
+            if time.monotonic() > deadline:
+                for i, actor in running.items():
+                    ray_tpu.kill(actor)
+                    results[i].status = "ERRORED"
+                    results[i].error = "tune run timeout"
+                break
+            time.sleep(poll_interval)
+            for i, actor in list(running.items()):
+                r = results[i]
+                try:
+                    polled = ray_tpu.get(actor.poll.remote(), timeout=60)
+                except Exception as e:  # noqa: BLE001 — actor died
+                    r.status = "ERRORED"
+                    r.error = f"trial actor died: {e}"
+                    del running[i]
+                    launch()
+                    continue
+                stop_now = False
+                for metrics in polled["results"]:
+                    r.history.append(metrics)
+                    r.metrics = metrics
+                    if scheduler.on_result(r.trial_id, metrics) == STOP:
+                        stop_now = True
+                if stop_now and polled["status"] == "RUNNING":
+                    try:
+                        ray_tpu.get(actor.stop.remote(), timeout=30)
+                    except Exception:  # noqa: BLE001
+                        pass
+                if polled["status"] != "RUNNING" and not polled["results"]:
+                    r.status = polled["status"]
+                    r.error = polled["error"]
+                    try:
+                        r.checkpoints = ray_tpu.get(
+                            actor.get_checkpoints.remote(), timeout=30)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    ray_tpu.kill(actor)
+                    del running[i]
+                    launch()
+        return ResultGrid(results, cfg.metric, cfg.mode)
+
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "Result",
+    "ResultGrid",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "uniform",
+]

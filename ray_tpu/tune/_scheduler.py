@@ -1,0 +1,74 @@
+"""Trial schedulers: decide per-result whether a trial continues or stops.
+
+Reference surface: python/ray/tune/schedulers/async_hyperband.py (ASHA) and
+trial_scheduler.py (CONTINUE/STOP decisions). Original implementation of the
+asynchronous-successive-halving rule: rungs at grace_period * rf^k; a trial
+reaching a rung continues only if its metric is in the top 1/rf of results
+recorded at that rung so far.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """No early stopping (reference: trial_scheduler.py FIFOScheduler)."""
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving (reference: async_hyperband.py:65)."""
+
+    def __init__(self, metric: str = None, mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4,
+                 time_attr: str = "training_iteration"):
+        assert max_t >= grace_period > 0
+        assert reduction_factor > 1
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self.milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(int(t))
+            t *= reduction_factor
+        # milestone -> recorded metric values of trials that reached it
+        self._rungs: Dict[int, List[float]] = {m: [] for m in self.milestones}
+        self._reached: Dict[str, set] = {}
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP  # ran to completion
+        sign = 1.0 if self.mode == "min" else -1.0
+        reached = self._reached.setdefault(trial_id, set())
+        for m in self.milestones:
+            if t >= m and m not in reached:
+                reached.add(m)
+                rung = self._rungs[m]
+                rung.append(sign * value)
+                rung.sort()
+                if len(rung) < self.rf:
+                    # fewer than rf results recorded: admit everything — the
+                    # first arrivals must not be stopped blind
+                    continue
+                # continue only in the top 1/rf recorded at this rung
+                k = max(1, int(len(rung) / self.rf))
+                cutoff = rung[k - 1]
+                if sign * value > cutoff:
+                    return STOP
+        return CONTINUE

@@ -1,0 +1,201 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference surface: python/ray/util/metrics.py (Counter :147, Gauge :204,
+Histogram :263 — tag_keys, default_tags, inc/set/observe) backed by the C++
+registry (src/ray/stats/metric.h:104). Here every process keeps a local
+registry; the core worker's telemetry loop ships snapshots to the control
+store, and `prometheus_text()` renders the cluster-wide aggregate in
+Prometheus exposition format (the reference exports through the per-node
+agent to Prometheus).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY: Dict[str, "Metric"] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _tags_key(tags: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(tags.items()))
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name required")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _REG_LOCK:
+            _REGISTRY[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        if tags:
+            out.update(tags)
+        extra = set(out) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"tags {extra} not declared in tag_keys")
+        return out
+
+    def _snapshot(self) -> List[dict]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonic counter (reference: util/metrics.py:147)."""
+
+    metric_type = "counter"
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _snapshot(self):
+        with self._lock:
+            return [
+                {"name": self.name, "type": "counter", "tags": dict(k),
+                 "value": v, "help": self.description}
+                for k, v in self._values.items()
+            ]
+
+
+class Gauge(Metric):
+    """Point-in-time value (reference: util/metrics.py:204)."""
+
+    metric_type = "gauge"
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def _snapshot(self):
+        with self._lock:
+            return [
+                {"name": self.name, "type": "gauge", "tags": dict(k),
+                 "value": v, "help": self.description}
+                for k, v in self._values.items()
+            ]
+
+
+class Histogram(Metric):
+    """Bucketed distribution (reference: util/metrics.py:263)."""
+
+    metric_type = "histogram"
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = (),
+                 tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        if not boundaries or list(boundaries) != sorted(boundaries):
+            raise ValueError("boundaries must be a sorted non-empty sequence")
+        self.boundaries = list(boundaries)
+        self._counts: Dict[tuple, List[int]] = {}
+        self._sums: Dict[tuple, float] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def _snapshot(self):
+        with self._lock:
+            out = []
+            for k, counts in self._counts.items():
+                out.append({
+                    "name": self.name, "type": "histogram", "tags": dict(k),
+                    "boundaries": self.boundaries, "counts": list(counts),
+                    "sum": self._sums.get(k, 0.0), "help": self.description,
+                })
+            return out
+
+
+def snapshot_all() -> List[dict]:
+    """Every metric series in this process (the telemetry loop ships this)."""
+    with _REG_LOCK:
+        metrics = list(_REGISTRY.values())
+    out: List[dict] = []
+    for m in metrics:
+        out.extend(m._snapshot())
+    return out
+
+
+def _fmt_tags(tags: Dict[str, str]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """Cluster-wide metrics in Prometheus exposition format, aggregated from
+    every reporting worker's latest snapshot (counters/histograms summed,
+    gauges per-worker-last merged by last writer)."""
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    reply = cw.run_sync(cw.control.call("get_metrics", {}))
+    merged: Dict[tuple, dict] = {}
+    for w in reply["workers"].values():
+        for s in w["metrics"]:
+            key = (s["name"], _tags_key(s["tags"]), s["type"])
+            cur = merged.get(key)
+            if cur is None:
+                merged[key] = dict(s)
+            elif s["type"] in ("counter",):
+                merged[key]["value"] += s["value"]
+            elif s["type"] == "gauge":
+                merged[key]["value"] = s["value"]
+            elif s["type"] == "histogram":
+                merged[key]["counts"] = [
+                    a + b for a, b in zip(merged[key]["counts"], s["counts"])
+                ]
+                merged[key]["sum"] += s["sum"]
+    lines = []
+    seen_help = set()
+    for (name, _tk, mtype), s in sorted(merged.items()):
+        if name not in seen_help:
+            seen_help.add(name)
+            lines.append(f"# HELP {name} {s.get('help', '')}")
+            lines.append(f"# TYPE {name} {mtype}")
+        if mtype == "histogram":
+            cum = 0
+            for bound, c in zip(s["boundaries"] + [float("inf")], s["counts"]):
+                cum += c
+                le = "+Inf" if bound == float("inf") else repr(bound)
+                tags = dict(s["tags"], le=le)
+                lines.append(f"{name}_bucket{_fmt_tags(tags)} {cum}")
+            lines.append(f"{name}_sum{_fmt_tags(s['tags'])} {s['sum']}")
+            lines.append(f"{name}_count{_fmt_tags(s['tags'])} {cum}")
+        else:
+            lines.append(f"{name}{_fmt_tags(s['tags'])} {s['value']}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,157 @@
+"""State API: introspect live cluster state.
+
+Reference surface: python/ray/util/state/api.py (list_actors, list_nodes,
+list_tasks, list_placement_groups, list_jobs, list_workers, summarize_*) and
+state_cli.py (`ray list ...`). Queries go straight to the control store's
+tables (the reference's StateAPIManager also reads GCS state).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _control_call(method: str, payload: Optional[dict] = None) -> dict:
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    return cw.run_sync(cw.control.call(method, payload or {}), 30)
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    from ray_tpu._private.protocol import NodeInfo
+
+    reply = _control_call("get_all_nodes")
+    out = []
+    for n in reply["nodes"]:
+        info = NodeInfo.from_wire(n)
+        out.append({
+            "node_id": info.node_id.hex(),
+            "address": info.address,
+            "state": info.state,
+            "resources": info.resources.to_dict(),
+            "labels": info.labels,
+        })
+    return out
+
+
+def list_actors(detail: bool = False) -> List[Dict[str, Any]]:
+    reply = _control_call("list_actors")
+    out = []
+    for a in reply["actors"]:
+        row = {
+            "actor_id": a["actor_id"].hex(),
+            "state": a["state"],
+            "name": a.get("name", ""),
+            "node_id": (a.get("node_id") or b"").hex(),
+        }
+        if detail:
+            row.update({
+                "worker_address": a.get("worker_address", ""),
+                "num_restarts": a.get("num_restarts", 0),
+                "death_cause": a.get("death_cause", ""),
+            })
+        out.append(row)
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    reply = _control_call("list_placement_groups")
+    out = []
+    for pg in reply["pgs"]:
+        out.append({
+            "placement_group_id": pg["pg_id"].hex(),
+            "state": pg["state"],
+            "name": pg.get("name", ""),
+            "bundles": len(pg.get("bundles", [])),
+        })
+    return out
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    reply = _control_call("get_all_jobs")
+    return [
+        {
+            "job_id": j["job_id"].hex(),
+            "finished": j.get("finished", False),
+            "driver_address": j.get("driver_address", ""),
+            "start_time": j.get("start_time"),
+        }
+        for j in reply["jobs"]
+    ]
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Latest execution record per task from the task-event history."""
+    reply = _control_call("list_task_events", {"limit": limit * 4})
+    latest: Dict[bytes, dict] = {}
+    for ev in reply["events"]:
+        latest[ev["task_id"]] = ev
+    out = [
+        {
+            "task_id": ev["task_id"].hex(),
+            "name": ev["name"],
+            "kind": ev["kind"],
+            "state": ev["event"],
+            "node_id": ev["node_id"],
+            "worker_id": ev["worker_id"].hex(),
+            "duration_s": ev.get("duration_s"),
+        }
+        for ev in latest.values()
+    ]
+    return out[-limit:]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for t in list_tasks():
+        counts[t["state"]] = counts.get(t["state"], 0) + 1
+    return counts
+
+
+def summarize_objects() -> List[Dict[str, Any]]:
+    """Per-node shm store occupancy (reference: `ray summary objects`)."""
+    from ray_tpu._private.core_worker import get_core_worker
+    from ray_tpu._private.protocol import NodeInfo
+
+    cw = get_core_worker()
+    nodes = _control_call("get_all_nodes")["nodes"]
+    out = []
+    for n in nodes:
+        info = NodeInfo.from_wire(n)
+        if info.state != "ALIVE":
+            continue
+        try:
+            stats = cw.run_sync(cw.daemon.call("store_stats", {}), 10)
+        except Exception:  # noqa: BLE001 — node unreachable
+            stats = {}
+        out.append({"node_id": info.node_id.hex(), **stats})
+    return out
+
+
+def timeline(filename: Optional[str] = None) -> Any:
+    """Chrome-trace JSON of task execution spans (reference: `ray timeline`,
+    python/ray/_private/state.py:1017). Open in chrome://tracing or
+    ui.perfetto.dev."""
+    from ray_tpu._private.task_events import to_chrome_trace
+
+    reply = _control_call("list_task_events", {"limit": 0})
+    trace = to_chrome_trace(reply["events"])
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+        return filename
+    return trace
+
+
+__all__ = [
+    "list_actors",
+    "list_jobs",
+    "list_nodes",
+    "list_placement_groups",
+    "list_tasks",
+    "summarize_objects",
+    "summarize_tasks",
+    "timeline",
+]

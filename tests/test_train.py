@@ -125,6 +125,16 @@ def test_worker_failure_restart_and_resume(ray_init, tmp_path):
         for step in range(start, config["steps"]):
             if (step == 2 and ctx.get_world_rank() == 0
                     and not os.path.exists(config["marker"])):
+                # die only once a checkpoint has FINALIZED (all ranks'
+                # shards promoted) — otherwise under load the restart
+                # legitimately starts from scratch and the resume assertion
+                # below would race the checkpoint pipeline
+                deadline = time.time() + 60
+                while time.time() < deadline and not any(
+                    n.startswith("checkpoint_")
+                    for n in os.listdir(config["run_dir"])
+                ):
+                    time.sleep(0.1)
                 open(config["marker"], "w").close()
                 os._exit(1)  # hard kill: actor dies, no cleanup
             train.report(
@@ -134,7 +144,8 @@ def test_worker_failure_restart_and_resume(ray_init, tmp_path):
 
     result = DataParallelTrainer(
         train_fn,
-        train_loop_config={"steps": 5, "marker": marker},
+        train_loop_config={"steps": 5, "marker": marker,
+                           "run_dir": str(tmp_path / "phoenix")},
         scaling_config=ScalingConfig(num_workers=2),
         run_config=_run_cfg(
             tmp_path, "phoenix",

@@ -1,0 +1,153 @@
+"""Tune layer tests (reference test strategy: python/ray/tune/tests/
+test_tune_e2e-style driver runs + scheduler unit tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune._scheduler import CONTINUE, STOP, ASHAScheduler
+from ray_tpu.tune._search import generate_variants
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_variant_generation():
+    space = {
+        "a": tune.grid_search([1, 2, 3]),
+        "b": tune.grid_search(["x", "y"]),
+        "c": tune.uniform(0.0, 1.0),
+        "d": 42,
+    }
+    variants = list(generate_variants(space, num_samples=2, seed=0))
+    assert len(variants) == 12  # 3 * 2 grid, twice
+    assert all(v["d"] == 42 for v in variants)
+    assert all(0.0 <= v["c"] <= 1.0 for v in variants)
+    assert {(v["a"], v["b"]) for v in variants} == {
+        (a, b) for a in (1, 2, 3) for b in ("x", "y")
+    }
+
+
+def test_asha_stops_bad_trials():
+    sched = ASHAScheduler(metric="loss", mode="min", max_t=16,
+                          grace_period=2, reduction_factor=2)
+    assert sched.milestones == [2, 4, 8]
+    # good trial cruises through rungs
+    assert sched.on_result("good", {"training_iteration": 2, "loss": 0.1}) == CONTINUE
+    # bad trial at the same rung with a worse metric gets cut
+    assert sched.on_result("bad", {"training_iteration": 2, "loss": 9.0}) == STOP
+    # completion at max_t stops
+    assert sched.on_result("good", {"training_iteration": 16, "loss": 0.05}) == STOP
+
+
+def test_grid_search_fit(ray_init):
+    def trainable(config):
+        tune.report({"score": config["x"] ** 2})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([-3, -1, 2, 5])},
+        tune_config=tune.TuneConfig(metric="score", mode="min"),
+    )
+    results = grid.fit(timeout=120)
+    assert len(results) == 4
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.config["x"] == -1
+    assert best.metrics["score"] == 1
+
+
+def test_random_search_and_max_concurrency(ray_init):
+    def trainable(config):
+        for i in range(3):
+            tune.report({"loss": config["lr"] * (3 - i)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=6,
+            max_concurrent_trials=2, seed=7,
+        ),
+    )
+    results = tuner.fit(timeout=180)
+    assert len(results) == 6
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    # best = smallest sampled lr (loss is monotonic in lr)
+    assert best.metrics["loss"] == min(
+        r.metrics["loss"] for r in results if r.metrics
+    )
+    # every trial ran to completion: 3 reports each
+    assert all(len(r.history) == 3 for r in results)
+
+
+def test_asha_early_stops_in_fit(ray_init):
+    def trainable(config):
+        import time as t
+
+        for i in range(1, 9):
+            # bad configs plateau high; good configs descend. The sleep
+            # keeps iterations slower than the controller's poll cadence so
+            # cooperative stops can land mid-trial.
+            t.sleep(0.15)
+            loss = config["base"] / i if config["good"] else config["base"]
+            tune.report({"loss": loss, "training_iteration": i})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={
+            "base": tune.grid_search([1.0, 10.0, 100.0, 1000.0]),
+            "good": tune.grid_search([True, False]),
+        },
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min",
+            scheduler=tune.ASHAScheduler(
+                max_t=8, grace_period=2, reduction_factor=2),
+            max_concurrent_trials=4,
+        ),
+    )
+    results = tuner.fit(timeout=180)
+    assert len(results) == 8
+    stopped = [r for r in results if r.status == "STOPPED"]
+    assert stopped, "ASHA never early-stopped anything"
+    best = results.get_best_result()
+    assert best.config == {"base": 1.0, "good": True}
+
+
+def test_trial_error_is_isolated(ray_init):
+    def trainable(config):
+        if config["x"] == 1:
+            raise ValueError("boom")
+        tune.report({"score": config["x"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    )
+    results = tuner.fit(timeout=120)
+    assert results.num_errors == 1
+    errored = [r for r in results if r.status == "ERRORED"][0]
+    assert "boom" in errored.error
+    assert results.get_best_result().config["x"] == 2
+
+
+def test_checkpoints_recorded(ray_init):
+    def trainable(config):
+        for i in range(2):
+            tune.report({"loss": 1.0 / (i + 1)}, checkpoint={"step": i})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+    )
+    results = tuner.fit(timeout=120)
+    assert len(results) == 1
+    ckpts = results[0].checkpoints
+    assert [c["data"]["step"] for c in ckpts] == [0, 1]

@@ -1,0 +1,96 @@
+"""Ecosystem utilities: ActorPool, distributed Queue, metrics helpers
+(reference: python/ray/tests/test_actor_pool.py, test_queue.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        return x * 2
+
+
+def test_actor_pool_map_ordered(ray_init):
+    pool = ActorPool([_Doubler.remote() for _ in range(3)])
+    results = list(pool.map(lambda a, v: a.double.remote(v), range(10)))
+    assert results == [i * 2 for i in range(10)]
+
+
+def test_actor_pool_map_unordered(ray_init):
+    pool = ActorPool([_Doubler.remote() for _ in range(3)])
+    results = list(
+        pool.map_unordered(lambda a, v: a.double.remote(v), range(10)))
+    assert sorted(results) == [i * 2 for i in range(10)]
+
+
+def test_actor_pool_submit_get(ray_init):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 2)
+    assert not pool.has_free()
+    assert pool.get_next(timeout=60) == 2
+    assert pool.get_next(timeout=60) == 4
+    assert pool.has_free()
+    assert not pool.has_next()
+
+
+def test_queue_basic(ray_init):
+    q = Queue()
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get(timeout=30) == "a"
+    assert q.get(timeout=30) == "b"
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_maxsize_and_batches(ray_init):
+    q = Queue(maxsize=3)
+    q.put_nowait_batch([1, 2, 3])
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(4)
+    assert q.get_nowait_batch(2) == [1, 2]
+    assert q.get_nowait_batch(5) == [3]
+    q.shutdown()
+
+
+def test_queue_across_tasks(ray_init):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=60) for _ in range(n)]
+
+    pref = producer.remote(q, 5)
+    cref = consumer.remote(q, 5)
+    assert ray_tpu.get(pref, timeout=60) == 5
+    assert sorted(ray_tpu.get(cref, timeout=60)) == [0, 1, 2, 3, 4]
+    q.shutdown()
+
+
+def test_queue_blocking_timeout(ray_init):
+    q = Queue()
+    with pytest.raises(Empty):
+        q.get(timeout=0.5)
+    q.shutdown()
