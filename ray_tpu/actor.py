@@ -40,6 +40,13 @@ class ActorMethod:
     def options(self, num_returns: int = 1) -> "ActorMethod":
         return ActorMethod(self._handle, self._method_name, num_returns)
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of executing (reference: actor.py bind —
+        the ray.dag authoring surface)."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor method {self._method_name} cannot be called directly; use .remote()"

@@ -96,6 +96,13 @@ class RemoteFunction:
         clone._exported = self._exported
         return clone
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of executing (reference:
+        remote_function bind — the ray.dag authoring surface)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         from ray_tpu._private.protocol import NUM_RETURNS_STREAMING
 

@@ -1,0 +1,9 @@
+"""ray_tpu.rllib — reinforcement learning on the actor plane with JAX
+learners (reference surface: rllib/algorithms/*, core/learner/*,
+env/env_runner_group.py)."""
+
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import PPOLearner, compute_gae
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = ["EnvRunner", "PPO", "PPOConfig", "PPOLearner", "compute_gae"]

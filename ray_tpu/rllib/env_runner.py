@@ -1,0 +1,116 @@
+"""EnvRunner actor: collects rollouts with the current policy.
+
+Reference surface: rllib/env/single_agent_env_runner.py:68 (sample(), env
+lifecycle, weight sync) + env_runner_group.py:70 (the actor gang). Policy
+inference here is plain jax on the runner's host devices; weights arrive as
+numpy pytrees from the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class EnvRunner:
+    """One rollout worker (reference: SingleAgentEnvRunner)."""
+
+    def __init__(self, env_name: str, *, seed: int = 0,
+                 env_config: Optional[dict] = None,
+                 gamma: float = 0.99, gae_lambda: float = 0.95):
+        import gymnasium as gym
+
+        self.env = gym.make(env_name, **(env_config or {}))
+        self.obs, _ = self.env.reset(seed=seed)
+        self.rng = np.random.default_rng(seed)
+        self.gamma = gamma
+        self.lam = gae_lambda
+        self.weights = None
+        self._episode_return = 0.0
+        self._completed_returns: List[float] = []
+
+    def set_weights(self, weights: Any) -> bool:
+        self.weights = weights
+        return True
+
+    def _policy(self, obs: np.ndarray):
+        from ray_tpu.rllib.learner import policy_logits, value_fn
+
+        import jax.nn
+
+        logits = np.asarray(policy_logits(self.weights, obs[None]))[0]
+        logits = logits - logits.max()
+        p = np.exp(logits)
+        p /= p.sum()
+        action = int(self.rng.choice(len(p), p=p))
+        logp = float(np.log(p[action] + 1e-12))
+        value = float(np.asarray(value_fn(self.weights, obs[None]))[0])
+        return action, logp, value
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps transitions; returns a batch with GAE
+        advantages/returns computed at the boundary (reference:
+        postprocessing in the env runner's connector pipeline)."""
+        from ray_tpu.rllib.learner import compute_gae, value_fn
+
+        assert self.weights is not None, "set_weights before sample"
+        obs_buf = np.zeros((num_steps, *np.shape(self.obs)), dtype=np.float32)
+        act_buf = np.zeros(num_steps, dtype=np.int32)
+        logp_buf = np.zeros(num_steps, dtype=np.float32)
+        rew_buf = np.zeros(num_steps, dtype=np.float32)
+        term_buf = np.zeros(num_steps, dtype=np.float32)
+        cut_buf = np.zeros(num_steps, dtype=np.float32)
+        val_buf = np.zeros(num_steps, dtype=np.float32)
+        next_val_buf = np.zeros(num_steps, dtype=np.float32)
+
+        def _value(obs) -> float:
+            return float(np.asarray(
+                value_fn(self.weights, np.asarray(obs, np.float32)[None]))[0])
+
+        for t in range(num_steps):
+            action, logp, value = self._policy(np.asarray(self.obs, np.float32))
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = logp
+            rew_buf[t] = reward
+            val_buf[t] = value
+            done = terminated or truncated
+            term_buf[t] = float(terminated)
+            cut_buf[t] = float(done)
+            if done:
+                # bootstrap from the TRUE successor: on truncation that is
+                # the pre-reset final observation, never the next episode's
+                # start (interior steps are backfilled from val_buf below)
+                next_val_buf[t] = 0.0 if terminated else _value(nxt)
+            self._episode_return += float(reward)
+            if done:
+                self._completed_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                self.obs, _ = self.env.reset()
+            else:
+                self.obs = nxt
+        interior = cut_buf[:-1] == 0.0
+        next_val_buf[:-1][interior] = val_buf[1:][interior]
+        if cut_buf[-1] == 0.0:
+            next_val_buf[-1] = _value(self.obs)
+        adv, ret = compute_gae(
+            rew_buf, val_buf, next_val_buf, term_buf, cut_buf,
+            self.gamma, self.lam)
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "advantages": adv, "returns": ret,
+        }
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._completed_returns)
+        if clear:
+            self._completed_returns.clear()
+        return out
+
+    def ping(self) -> bool:
+        return True

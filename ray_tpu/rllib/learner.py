@@ -1,0 +1,175 @@
+"""JAX PPO learner: the TPU-native counterpart of RLlib's TorchLearner.
+
+Reference surface: rllib/core/learner/learner.py:112 (Learner.update),
+rllib/algorithms/ppo/torch/ppo_torch_learner.py (clipped surrogate loss +
+value loss + entropy bonus), rllib/evaluation/postprocessing GAE.
+
+TPU-first design: the policy/value network is a pure-jax MLP pytree; the
+whole PPO epoch (minibatch loop included) runs inside one jit via
+lax.scan over shuffled minibatches — no Python in the hot loop, MXU-friendly
+batched matmuls, ready to pjit over a data axis for multi-chip learners.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def init_mlp(key, sizes: List[int]) -> List[Dict[str, jnp.ndarray]]:
+    """Orthogonal-init MLP params (the PPO-standard init)."""
+    params = []
+    for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        w = jax.nn.initializers.orthogonal(
+            scale=0.01 if i == len(sizes) - 2 else jnp.sqrt(2.0)
+        )(sub, (n_in, n_out))
+        params.append({"w": w, "b": jnp.zeros(n_out)})
+    return params
+
+
+def mlp_apply(params, x):
+    for layer in params[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x @ params[-1]["w"] + params[-1]["b"]
+
+
+def policy_logits(params, obs):
+    return mlp_apply(params["pi"], obs)
+
+
+def value_fn(params, obs):
+    return mlp_apply(params["vf"], obs)[..., 0]
+
+
+def compute_gae(rewards: np.ndarray, values: np.ndarray,
+                next_values: np.ndarray, terminated: np.ndarray,
+                cuts: np.ndarray, gamma: float, lam: float
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Generalized advantage estimation over one rollout (reference:
+    rllib/evaluation/postprocessing.py compute_advantages).
+
+    `next_values[t]` is V(s_{t+1}) for the TRUE successor state — at a
+    truncation boundary that is the pre-reset final observation, so
+    truncated episodes bootstrap correctly instead of leaking the next
+    episode's value. `terminated[t]` zeroes the bootstrap only on real
+    termination; `cuts[t]` (terminated OR truncated) stops the GAE chain
+    from crossing any episode boundary."""
+    T = len(rewards)
+    adv = np.zeros(T, dtype=np.float32)
+    last = 0.0
+    for t in reversed(range(T)):
+        delta = (rewards[t]
+                 + gamma * next_values[t] * (1.0 - terminated[t])
+                 - values[t])
+        last = delta + gamma * lam * (1.0 - cuts[t]) * last
+        adv[t] = last
+    returns = adv + values
+    return adv, returns
+
+
+class PPOLearner:
+    """Holds params/optimizer; update() runs the jitted PPO epoch."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *,
+                 hidden: Tuple[int, ...] = (64, 64),
+                 lr: float = 3e-4, clip: float = 0.2,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.0,
+                 num_epochs: int = 4, minibatch_size: int = 128,
+                 seed: int = 0):
+        key = jax.random.PRNGKey(seed)
+        kp, kv = jax.random.split(key)
+        self.params = {
+            "pi": init_mlp(kp, [obs_dim, *hidden, num_actions]),
+            "vf": init_mlp(kv, [obs_dim, *hidden, 1]),
+        }
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+        self.clip = clip
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        self.num_epochs = num_epochs
+        self.minibatch_size = minibatch_size
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._update_jit = jax.jit(functools.partial(
+            _ppo_update, tx=self.tx, clip=clip, vf_coeff=vf_coeff,
+            entropy_coeff=entropy_coeff, num_epochs=num_epochs,
+            minibatch_size=minibatch_size,
+        ))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        n = len(batch["obs"])
+        m = (n // self.minibatch_size) * self.minibatch_size
+        if m == 0:
+            m = n  # one undersized minibatch
+        self._rng, sub = jax.random.split(self._rng)
+        self.params, self.opt_state, metrics = self._update_jit(
+            self.params, self.opt_state, sub,
+            {k: jnp.asarray(v[:m]) for k, v in batch.items()},
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights: Any):
+        self.params = jax.device_put(weights)
+
+
+def _loss(params, mb, clip, vf_coeff, entropy_coeff):
+    logits = policy_logits(params, mb["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, mb["actions"][:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    ratio = jnp.exp(logp - mb["logp"])
+    adv = mb["advantages"]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    pg1 = ratio * adv
+    pg2 = jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+    pg_loss = -jnp.minimum(pg1, pg2).mean()
+    v = value_fn(params, mb["obs"])
+    vf_loss = 0.5 * ((v - mb["returns"]) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    return total, (pg_loss, vf_loss, entropy)
+
+
+def _ppo_update(params, opt_state, rng, batch, *, tx, clip, vf_coeff,
+                entropy_coeff, num_epochs, minibatch_size):
+    n = batch["obs"].shape[0]
+    num_mb = max(1, n // minibatch_size)
+
+    def epoch(carry, key):
+        params, opt_state = carry
+        perm = jax.random.permutation(key, n)
+        shuffled = {k: v[perm] for k, v in batch.items()}
+        mbs = {
+            k: v[: num_mb * (n // num_mb)].reshape(
+                (num_mb, n // num_mb) + v.shape[1:])
+            for k, v in shuffled.items()
+        }
+
+        def mb_step(carry, mb):
+            params, opt_state = carry
+            (loss, aux), grads = jax.value_and_grad(_loss, has_aux=True)(
+                params, mb, clip, vf_coeff, entropy_coeff)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), (loss, *aux)
+
+        (params, opt_state), stats = jax.lax.scan(mb_step, (params, opt_state), mbs)
+        return (params, opt_state), stats
+
+    keys = jax.random.split(rng, num_epochs)
+    (params, opt_state), stats = jax.lax.scan(epoch, (params, opt_state), keys)
+    loss, pg, vf, ent = (s.mean() for s in stats)
+    return params, opt_state, {
+        "total_loss": loss, "policy_loss": pg,
+        "vf_loss": vf, "entropy": ent,
+    }

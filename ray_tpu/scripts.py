@@ -1,0 +1,215 @@
+"""Cluster CLI: `python -m ray_tpu.scripts <command>`.
+
+Reference surface: python/ray/scripts/scripts.py (`ray start` :800,
+`ray stop` :1341, `ray status`, `ray job submit/status/logs/list/stop`).
+Head state (address + pids) persists in a state file so `stop`/`status`
+work from a fresh shell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+STATE_FILE = os.path.join(tempfile.gettempdir(), "ray_tpu_sessions",
+                          "cluster_state.json")
+
+
+def _save_state(state: dict):
+    os.makedirs(os.path.dirname(STATE_FILE), exist_ok=True)
+    with open(STATE_FILE, "w") as f:
+        json.dump(state, f)
+
+
+def _load_state() -> dict:
+    try:
+        with open(STATE_FILE) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def cmd_start(args) -> int:
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if args.system_config:
+        GLOBAL_CONFIG.apply_system_config(json.loads(args.system_config))
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.num_tpus is not None:
+        resources["TPU"] = float(args.num_tpus)
+
+    session_dir = node_mod.new_session_dir()
+    pids = []
+    if args.head:
+        cs_proc, control_address = node_mod.start_control_store(
+            session_dir, port=args.port)
+        pids.append(cs_proc.pid)
+    else:
+        if not args.address:
+            print("--address required for a non-head node", file=sys.stderr)
+            return 2
+        control_address = args.address
+    nd_proc, nd_info = node_mod.start_node_daemon(
+        control_address, session_dir,
+        resources=resources or None,
+        labels=json.loads(args.labels) if args.labels else None,
+    )
+    pids.append(nd_proc.pid)
+    state = _load_state()
+    nodes = state.get("nodes", [])
+    nodes.append({"pids": pids, "session_dir": session_dir,
+                  "address": control_address, "head": args.head})
+    _save_state({"address": control_address, "nodes": nodes})
+    print(f"ray_tpu {'head' if args.head else 'node'} started")
+    print(f"  address:     {control_address}")
+    print(f"  session dir: {session_dir}")
+    print(f"  connect:     ray_tpu.init(address={control_address!r})")
+    if args.block:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            return cmd_stop(args)
+    return 0
+
+
+def cmd_stop(_args) -> int:
+    state = _load_state()
+    stopped = 0
+    for node in state.get("nodes", []):
+        for pid in node.get("pids", []):
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+                stopped += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+    try:
+        os.unlink(STATE_FILE)
+    except OSError:
+        pass
+    print(f"stopped {stopped} processes")
+    return 0
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RT_ADDRESS", "")
+    if not addr:
+        addr = _load_state().get("address", "")
+    if not addr:
+        print("no running cluster found (pass --address)", file=sys.stderr)
+        raise SystemExit(2)
+    return addr
+
+
+def cmd_status(args) -> int:
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+    try:
+        nodes = ray_tpu.nodes()
+        total = ray_tpu.cluster_resources()
+        avail = ray_tpu.available_resources()
+        print(f"{len(nodes)} node(s):")
+        for n in nodes:
+            print(f"  {n['node_id'][:12]}  {n['state']:6s}  {n['address']}"
+                  f"  {n['resources']}")
+        print(f"resources: {avail} available / {total} total")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def cmd_job(args) -> int:
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    try:
+        if args.job_cmd == "submit":
+            import shlex
+
+            runtime_env = {}
+            if args.working_dir:
+                runtime_env["working_dir"] = args.working_dir
+            if args.env_vars:
+                runtime_env["env_vars"] = json.loads(args.env_vars)
+            argv = list(args.entrypoint)
+            if argv and argv[0] == "--":
+                argv = argv[1:]
+            sid = client.submit_job(
+                entrypoint=shlex.join(argv), runtime_env=runtime_env)
+            print(f"submitted job {sid}")
+            if not args.no_wait:
+                for chunk in client.tail_job_logs(sid):
+                    sys.stdout.write(chunk)
+                    sys.stdout.flush()
+                status = client.get_job_status(sid)
+                print(f"\njob {sid} finished: {status}")
+                return 0 if status == "SUCCEEDED" else 1
+        elif args.job_cmd == "status":
+            print(client.get_job_status(args.id))
+        elif args.job_cmd == "logs":
+            print(client.get_job_logs(args.id))
+        elif args.job_cmd == "stop":
+            client.stop_job(args.id)
+            print(f"stopped {args.id}")
+        elif args.job_cmd == "list":
+            for j in client.list_jobs():
+                print(f"{j['submission_id']}  {j['status']:10s} "
+                      f"{j['entrypoint']}")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ray_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--resources", default="")
+    sp.add_argument("--labels", default="")
+    sp.add_argument("--system-config", default="")
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop all locally started nodes")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="show cluster nodes + resources")
+    sp.add_argument("--address", default="")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("job")
+    sp.add_argument("--address", default="")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--working-dir", default="")
+    js.add_argument("--env-vars", default="")
+    js.add_argument("--no-wait", action="store_true")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("id")
+    jsub.add_parser("list")
+    sp.set_defaults(fn=cmd_job)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

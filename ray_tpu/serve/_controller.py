@@ -258,7 +258,12 @@ def get_or_create_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
     except ValueError:
         pass
-    return _create_controller()
+    try:
+        return _create_controller()
+    except Exception as e:  # noqa: BLE001 — name-collision race only
+        if "already taken" not in str(e):
+            raise
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
 
 
 async def get_or_create_controller_async():

@@ -1,0 +1,138 @@
+"""Job submission + CLI tests (reference: python/ray/dashboard/modules/job/
+tests/test_job_manager.py patterns, miniaturized)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import (
+    FAILED,
+    STOPPED,
+    SUCCEEDED,
+    JobSubmissionClient,
+)
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(ray_init):
+    return JobSubmissionClient()
+
+
+def _wait_terminal(client, sid, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = client.get_job_status(sid)
+        if st in (SUCCEEDED, FAILED, STOPPED):
+            return st
+        time.sleep(0.3)
+    raise TimeoutError(f"job {sid} still {st}")
+
+
+def test_submit_and_succeed(client):
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    assert _wait_terminal(client, sid) == SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    jobs = {j["submission_id"]: j for j in client.list_jobs()}
+    assert jobs[sid]["status"] == SUCCEEDED
+
+
+def test_job_failure_reported(client):
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; sys.exit(3)\"")
+    assert _wait_terminal(client, sid) == FAILED
+    assert "exit code 3" in client.get_job_info(sid)["message"]
+
+
+def test_env_vars_and_working_dir(client, tmp_path):
+    (tmp_path / "main.py").write_text(
+        "import os\nprint('VAL=' + os.environ['JOB_TEST_VAR'])\n"
+        "print(open('data.txt').read())\n"
+    )
+    (tmp_path / "data.txt").write_text("shipped-file")
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} main.py",
+        runtime_env={
+            "working_dir": str(tmp_path),
+            "env_vars": {"JOB_TEST_VAR": "42"},
+        },
+    )
+    assert _wait_terminal(client, sid) == SUCCEEDED
+    logs = client.get_job_logs(sid)
+    assert "VAL=42" in logs
+    assert "shipped-file" in logs
+
+
+def test_stop_running_job(client):
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(600)\"")
+    time.sleep(1)
+    assert client.get_job_status(sid) == "RUNNING"
+    client.stop_job(sid)
+    assert _wait_terminal(client, sid) == STOPPED
+
+
+def test_job_driver_joins_cluster(client, ray_init):
+    """A submitted driver can ray_tpu.init(address=RT_ADDRESS) and use the
+    SAME cluster (reference: job drivers join via RAY_ADDRESS)."""
+    script = (
+        "import os, ray_tpu\n"
+        "ray_tpu.init(address=os.environ['RT_ADDRESS'])\n"
+        "@ray_tpu.remote\n"
+        "def f():\n"
+        "    return 'from-inner-task'\n"
+        "print(ray_tpu.get(f.remote(), timeout=60))\n"
+        "ray_tpu.shutdown()\n"
+    )
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c \"{script}\"")
+    st = _wait_terminal(client, sid, timeout=120)
+    logs = client.get_job_logs(sid)
+    assert st == SUCCEEDED, logs
+    assert "from-inner-task" in logs
+
+
+def test_cli_start_status_job_stop(tmp_path):
+    """Full CLI lifecycle in subprocesses: start --head, status, job submit,
+    stop (reference: `ray start/stop` smoke tests)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    state_file = None
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "start", "--head",
+             "--num-cpus", "4"],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert out.returncode == 0, out.stderr
+        address = [ln for ln in out.stdout.splitlines()
+                   if "address:" in ln][0].split()[-1]
+        st = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "status",
+             "--address", address],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert st.returncode == 0, st.stderr
+        assert "1 node(s)" in st.stdout
+        job = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "job",
+             "--address", address, "submit", "--",
+             sys.executable, "-c", "print('cli-job-ok')"],
+            capture_output=True, text=True, timeout=180, env=env,
+        )
+        assert job.returncode == 0, job.stdout + job.stderr
+        assert "cli-job-ok" in job.stdout
+    finally:
+        subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "stop"],
+            capture_output=True, text=True, timeout=60, env=env,
+        )

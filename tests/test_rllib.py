@@ -1,0 +1,111 @@
+"""RLlib slice tests: GAE math, learner update mechanics, end-to-end PPO on
+CartPole (reference test strategy: rllib per-algorithm tests +
+test_ppo_learning goldens, miniaturized for CI)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPO, PPOConfig, PPOLearner, compute_gae
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_gae_math():
+    rewards = np.array([1.0, 1.0, 1.0], dtype=np.float32)
+    values = np.array([0.5, 0.5, 0.5], dtype=np.float32)
+    next_values = np.array([0.5, 0.5, 9.9], dtype=np.float32)
+    terminated = np.array([0.0, 0.0, 1.0], dtype=np.float32)
+    cuts = terminated.copy()
+    adv, ret = compute_gae(rewards, values, next_values, terminated, cuts,
+                           gamma=1.0, lam=1.0)
+    # terminal step zeroes the bootstrap: ret[2] = 1.0
+    assert ret[2] == pytest.approx(1.0)
+    # undiscounted returns accumulate backwards: [3, 2, 1]
+    assert ret.tolist() == pytest.approx([3.0, 2.0, 1.0])
+    assert adv.tolist() == pytest.approx([2.5, 1.5, 0.5])
+
+
+def test_gae_truncation_bootstraps():
+    """A truncated (not terminated) episode bootstraps from the pre-reset
+    state's value and the GAE chain never crosses the boundary."""
+    rewards = np.array([1.0, 1.0], dtype=np.float32)
+    values = np.array([0.0, 0.0], dtype=np.float32)
+    # step 0 truncates with V(final obs)=5; step 1 is a fresh episode
+    next_values = np.array([5.0, 0.0], dtype=np.float32)
+    terminated = np.array([0.0, 0.0], dtype=np.float32)
+    cuts = np.array([1.0, 0.0], dtype=np.float32)
+    adv, ret = compute_gae(rewards, values, next_values, terminated, cuts,
+                           gamma=1.0, lam=1.0)
+    # truncated step keeps its bootstrap (1 + 5) and ignores step 1 entirely
+    assert adv[0] == pytest.approx(6.0)
+    assert adv[1] == pytest.approx(1.0)
+
+
+def test_learner_update_reduces_loss():
+    rng = np.random.default_rng(0)
+    n = 256
+    learner = PPOLearner(4, 2, lr=1e-2, num_epochs=2, minibatch_size=64)
+    batch = {
+        "obs": rng.normal(size=(n, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, n).astype(np.int32),
+        "logp": np.full(n, -0.693, dtype=np.float32),
+        "advantages": rng.normal(size=n).astype(np.float32),
+        "returns": rng.normal(size=n).astype(np.float32),
+    }
+    m1 = learner.update(batch)
+    for _ in range(5):
+        m2 = learner.update(batch)
+    assert np.isfinite(m2["total_loss"])
+    # value loss on a FIXED regression target must fall with training
+    assert m2["vf_loss"] < m1["vf_loss"]
+
+
+def test_weights_roundtrip():
+    learner = PPOLearner(4, 2)
+    w = learner.get_weights()
+    learner2 = PPOLearner(4, 2, seed=123)
+    learner2.set_weights(w)
+    obs = np.ones((3, 4), dtype=np.float32)
+    from ray_tpu.rllib.learner import policy_logits
+
+    np.testing.assert_allclose(
+        np.asarray(policy_logits(learner.params, obs)),
+        np.asarray(policy_logits(learner2.params, obs)),
+        rtol=1e-6,
+    )
+
+
+def test_ppo_cartpole_improves(ray_init):
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, rollout_fragment_length=256)
+        .training(lr=3e-3, num_epochs=4, minibatch_size=128,
+                  entropy_coeff=0.01)
+        .build()
+    )
+    results = [algo.train() for _ in range(8)]
+    assert results[-1]["training_iteration"] == 8
+    assert results[-1]["num_env_steps_sampled"] == 512
+    early = [r["episode_return_mean"] for r in results[:2]
+             if np.isfinite(r["episode_return_mean"])]
+    late = [r["episode_return_mean"] for r in results[-3:]
+            if np.isfinite(r["episode_return_mean"])]
+    assert late, "no completed episodes late in training"
+    # CartPole random policy averages ~20; PPO should clearly improve
+    assert np.mean(late) > np.mean(early) or np.mean(late) > 50, (
+        f"no learning: early={early} late={late}"
+    )
+    # checkpoint round-trip preserves behavior
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".pkl") as f:
+        algo.save_checkpoint(f.name)
+        algo.restore_checkpoint(f.name)
+    algo.stop()
