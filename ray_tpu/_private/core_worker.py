@@ -983,6 +983,9 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
         stream_backpressure: int = -1,
     ):
+        from ray_tpu._private.runtime_env_mgr import prepare_runtime_env
+
+        runtime_env = await prepare_runtime_env(runtime_env, self)
         task_id = self.next_task_id()
         wire_args = await self.serialize_args(args, kwargs)
         pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
@@ -1059,6 +1062,10 @@ class CoreWorker:
             self._streams[task_id.binary()] = StreamState(task_id.binary())
 
         async def finish():
+            from ray_tpu._private.runtime_env_mgr import prepare_runtime_env
+
+            spec.runtime_env = await prepare_runtime_env(
+                spec.runtime_env, self) or {}
             await self.export_function(function_key, function_obj)
             wire_args = await self.serialize_args(args, kwargs)
             pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
@@ -1440,6 +1447,7 @@ class CoreWorker:
         name: str = "",
         namespace: str = "",
         detached: bool = False,
+        runtime_env: Optional[dict] = None,
     ) -> ActorID:
         with self._lock:
             self._actor_index += 1
@@ -1449,7 +1457,7 @@ class CoreWorker:
             resources=resources, max_restarts=max_restarts,
             max_task_retries=max_task_retries, max_concurrency=max_concurrency,
             is_async=is_async, strategy=strategy, name=name,
-            namespace=namespace, detached=detached,
+            namespace=namespace, detached=detached, runtime_env=runtime_env,
         )
         return actor_id
 
@@ -1493,7 +1501,11 @@ class CoreWorker:
         name: str = "",
         namespace: str = "",
         detached: bool = False,
+        runtime_env: Optional[dict] = None,
     ) -> None:
+        from ray_tpu._private.runtime_env_mgr import prepare_runtime_env
+
+        runtime_env = await prepare_runtime_env(runtime_env, self)
         wire_args = await self.serialize_args(args, kwargs)
         pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
         spec = TaskSpec(
@@ -1511,7 +1523,8 @@ class CoreWorker:
             max_task_retries=max_task_retries,
             max_concurrency=max_concurrency,
             is_async_actor=is_async,
-            runtime_env={"namespace": namespace, "detached": detached},
+            runtime_env={**(runtime_env or {}), "namespace": namespace,
+                         "detached": detached},
             name=name,
         )
         self._actor_state(actor_id.binary()).creation_keepalive = pyrefs

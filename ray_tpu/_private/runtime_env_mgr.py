@@ -1,0 +1,165 @@
+"""Runtime environments: per-task/actor working_dir, py_modules, env_vars.
+
+Reference surface: python/ray/runtime_env/ + _private/runtime_env/
+(ARCHITECTURE.md — env built once per URI, cached, applied before user
+code; working_dir/py_modules are content-addressed zips). Here the packages
+travel through the control store's KV (the reference's GCS-backed package
+store for small URIs), and the per-node cache lives in the session dir.
+
+Deviation noted: the reference starts a FRESH worker per runtime-env hash
+(worker pool keyed by env). Here env_vars/py_modules apply per task on
+pooled workers; `working_dir` performs a process-wide chdir, so it is
+applied for actors (dedicated workers) and for tasks each time one runs —
+two tasks with different working_dirs sharing a pooled worker see the
+latest chdir between (not during) executions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional
+
+KV_NS = "runtime_env"
+
+
+def _zip_dir_bytes(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _dirs, files in sorted(os.walk(path)):
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                # fixed timestamp: the URI must be a pure function of
+                # CONTENT, or every mtime touch defeats package dedup
+                info = zipfile.ZipInfo(os.path.relpath(full, path),
+                                       date_time=(1980, 1, 1, 0, 0, 0))
+                info.compress_type = zipfile.ZIP_DEFLATED
+                with open(full, "rb") as fh:
+                    zf.writestr(info, fh.read())
+    return buf.getvalue()
+
+
+def _dir_signature(path: str) -> tuple:
+    """Cheap change detector for the driver-side upload cache."""
+    sig = []
+    for root, _dirs, files in sorted(os.walk(path)):
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            st = os.stat(full)
+            sig.append((os.path.relpath(full, path), st.st_size,
+                        st.st_mtime_ns))
+    return tuple(sig)
+
+
+# driver-side memo: (abspath, dir signature) -> uploaded uri — without it
+# every task submission re-zips and re-ships the whole directory
+_UPLOAD_CACHE: Dict[str, tuple] = {}
+
+# executor-side record of which py_module version is live per module name
+_APPLIED_MODULES: Dict[str, str] = {}
+
+
+async def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
+                              cw) -> Optional[Dict[str, Any]]:
+    """Driver side: upload local dirs as content-addressed zips; return the
+    wire form ({..._uri} instead of local paths)."""
+    if not runtime_env:
+        return runtime_env
+    out = dict(runtime_env)
+
+    async def upload(path: str) -> str:
+        path = os.path.abspath(path)
+        sig = _dir_signature(path)
+        cached = _UPLOAD_CACHE.get(path)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        blob = _zip_dir_bytes(path)
+        uri = "pkg_" + hashlib.blake2b(blob, digest_size=16).hexdigest()
+        await cw.control.call("kv_put", {
+            "ns": KV_NS, "key": uri.encode(), "value": blob,
+            "overwrite": False,
+        })
+        _UPLOAD_CACHE[path] = (sig, uri)
+        return uri
+
+    wd = out.pop("working_dir", None)
+    if wd:
+        if not os.path.isdir(wd):
+            raise ValueError(f"working_dir {wd!r} is not a directory")
+        out["working_dir_uri"] = await upload(wd)
+    mods = out.pop("py_modules", None)
+    if mods:
+        uris: List[str] = []
+        for m in mods:
+            if not os.path.isdir(m):
+                raise ValueError(f"py_modules entry {m!r} is not a directory")
+            uris.append(await upload(m) + ":" + os.path.basename(m.rstrip("/")))
+        out["py_module_uris"] = uris
+    return out
+
+
+async def _fetch_extract(uri: str, cw, cache_root: str) -> str:
+    dest = os.path.join(cache_root, uri)
+    if os.path.isdir(dest):
+        return dest
+    reply = await cw.control.call("kv_get", {"ns": KV_NS, "key": uri.encode()})
+    blob = reply.get("value")
+    if blob is None:
+        raise RuntimeError(f"runtime env package {uri} missing from KV")
+    # per-process tmp dir: multiple pooled workers on a node can race the
+    # same uncached URI, and a shared tmp path lets one process publish a
+    # half-extracted tree out from under another
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    zipfile.ZipFile(io.BytesIO(blob)).extractall(tmp)
+    try:
+        os.replace(tmp, dest)  # atomic publish; loser's replace fails
+    except OSError:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+async def setup_runtime_env(runtime_env: Optional[Dict[str, Any]], cw):
+    """Executor side: apply env before user code runs (reference: the
+    runtime-env agent builds the env, the worker execs inside it)."""
+    if not runtime_env:
+        return
+    env_vars = runtime_env.get("env_vars") or {}
+    if env_vars:
+        os.environ.update(env_vars)
+    cache_root = os.path.join(
+        os.environ.get("RT_SESSION_DIR", "/tmp"), "runtime_env_cache")
+    os.makedirs(cache_root, exist_ok=True)
+    for entry in runtime_env.get("py_module_uris") or []:
+        uri, _, modname = entry.partition(":")
+        pkg_dir = await _fetch_extract(uri, cw, cache_root)
+        # the zip holds the module's CONTENTS; expose it under its name
+        named = os.path.join(cache_root, f"{uri}_as")
+        target = os.path.join(named, modname)
+        if not os.path.isdir(target):
+            os.makedirs(named, exist_ok=True)
+            try:
+                os.symlink(pkg_dir, target)
+            except FileExistsError:
+                pass
+        if named not in sys.path:
+            sys.path.insert(0, named)
+        # pooled worker previously imported an OLDER version of this module:
+        # sys.modules would shadow the new path, silently serving stale code
+        prev_uri = _APPLIED_MODULES.get(modname)
+        if prev_uri is not None and prev_uri != uri:
+            for loaded in [m for m in sys.modules
+                           if m == modname or m.startswith(modname + ".")]:
+                del sys.modules[loaded]
+        _APPLIED_MODULES[modname] = uri
+    wd_uri = runtime_env.get("working_dir_uri")
+    if wd_uri:
+        wd = await _fetch_extract(wd_uri, cw, cache_root)
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)

@@ -211,16 +211,16 @@ class TaskExecutor:
         try:
             if tid in self._cancelled:
                 raise TaskCancelledError(f"task {spec.name} was cancelled")
+            # runtime env (env_vars e.g. MEGASCALE_*, working_dir,
+            # py_modules) applies BEFORE the function/args deserialize —
+            # unpickling may reference modules the env ships (reference: the
+            # runtime-env agent builds the env, the worker execs inside it)
+            from ray_tpu._private.runtime_env_mgr import setup_runtime_env
+
+            await setup_runtime_env(spec.runtime_env, self.cw)
             fn = await self.cw.fetch_function(spec.function_key)
             args, kwargs = await self._resolve_args(spec.args)
             self.cw.current_task_id = spec.task_id
-            # runtime env vars (e.g. MEGASCALE_* for gang workers) apply to
-            # the worker process before user code runs (reference: runtime_env
-            # env_vars; the reference applies them at worker start, here at
-            # task start since workers are pooled per job)
-            env_vars = (spec.runtime_env or {}).get("env_vars") or {}
-            if env_vars:
-                os.environ.update(env_vars)
             result = await self._invoke(tid, fn, args, kwargs)
             if spec.is_streaming:
                 return await self._stream_out(spec, result)
@@ -247,6 +247,9 @@ class TaskExecutor:
 
     async def _execute_actor_creation(self, spec: pb.TaskSpec) -> dict:
         try:
+            from ray_tpu._private.runtime_env_mgr import setup_runtime_env
+
+            await setup_runtime_env(spec.runtime_env, self.cw)
             cls = await self.cw.fetch_function(spec.function_key)
             args, kwargs = await self._resolve_args(spec.args)
             self.actor_spec = spec

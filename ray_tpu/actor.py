@@ -21,6 +21,7 @@ _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
     "max_concurrency", "name", "namespace", "lifetime", "scheduling_strategy",
     "label_selector", "placement_group", "placement_group_bundle_index",
+    "runtime_env",
 }
 
 
@@ -166,6 +167,7 @@ class ActorClass:
                 name=opts.get("name", ""),
                 namespace=opts.get("namespace", ""),
                 detached=opts.get("lifetime") == "detached",
+                runtime_env=opts.get("runtime_env"),
             )
 
         if cw._loop_running_here():
@@ -181,6 +183,7 @@ class ActorClass:
                 name=opts.get("name", ""),
                 namespace=opts.get("namespace", ""),
                 detached=opts.get("lifetime") == "detached",
+                runtime_env=opts.get("runtime_env"),
             )
         else:
             actor_id = cw.run_sync(create())

@@ -1,0 +1,167 @@
+"""ray_tpu.llm — LLM serving and batch inference on the TPU-native stack.
+
+Reference surface: python/ray/llm/_internal/serve/ (LLMServer
+core/server/llm_server.py:127, OpenAI-compatible ingress
+core/ingress/builder.py:213 build_openai_app) and batch processors
+(llm/_internal/batch/processor/). Where the reference wraps vLLM's CUDA
+engine, the engine HERE is the in-framework JAX Llama model with a
+KV-cache decode loop (_generate.py) — serving replicas are ordinary serve
+deployments, so routing/autoscaling/gang placement come from ray_tpu.serve.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.llm._generate import generate, init_cache
+
+BOS, EOS = 256, 257
+
+
+class ByteTokenizer:
+    """Dependency-free byte-level tokenizer (ids 0-255 = bytes, 256=BOS,
+    257=EOS). Stands in for sentencepiece the way the reference's tests use
+    mock engines (reference: llm/tests mock_vllm_engine.py)."""
+
+    vocab_size = 258
+
+    def encode(self, text: str) -> List[int]:
+        return [BOS] + list(text.encode("utf-8"))
+
+    def decode(self, ids: List[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", "replace")
+
+
+@dataclass
+class LLMConfig:
+    """Reference: llm LLMConfig (model_loading_config + engine_kwargs)."""
+
+    model_id: str = "llama-tiny-random"
+    model: str = "tiny"            # LlamaConfig preset name
+    model_overrides: Dict[str, Any] = field(default_factory=dict)
+    checkpoint_path: Optional[str] = None  # pickled params pytree
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    num_replicas: int = 1
+    seed: int = 0
+
+    def build_model(self):
+        import jax
+
+        from ray_tpu.models.llama import LlamaConfig, init_params
+
+        preset = getattr(LlamaConfig, self.model)
+        cfg = preset(**self.model_overrides)
+        assert cfg.vocab_size >= ByteTokenizer.vocab_size, (
+            "model vocab must cover the byte tokenizer's 258 ids")
+        if self.checkpoint_path:
+            import pickle
+
+            with open(self.checkpoint_path, "rb") as f:
+                params = jax.device_put(pickle.load(f))
+        else:
+            params = init_params(cfg, jax.random.PRNGKey(self.seed))
+        return cfg, params
+
+
+class LLMServer:
+    """One serving replica (reference: llm_server.py:127). Deployed through
+    ray_tpu.serve; __call__ speaks an OpenAI-completions-shaped dict."""
+
+    def __init__(self, config: LLMConfig):
+        self.config = config
+        self.tokenizer = ByteTokenizer()
+        self.cfg, self.params = config.build_model()
+
+    def __call__(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        prompts = payload.get("prompt", "")
+        single = isinstance(prompts, str)
+        if single:
+            prompts = [prompts]
+        max_new = int(payload.get("max_tokens", self.config.max_new_tokens))
+        temperature = float(
+            payload.get("temperature", self.config.temperature))
+        t0 = time.monotonic()
+        token_prompts = [self.tokenizer.encode(p) for p in prompts]
+        outs = generate(
+            self.cfg, self.params, token_prompts,
+            max_new_tokens=max_new, temperature=temperature,
+            seed=self.config.seed, eos_id=EOS,
+        )
+        elapsed = time.monotonic() - t0
+        choices = [
+            {"index": i, "text": self.tokenizer.decode(toks),
+             "finish_reason": "stop" if len(toks) < max_new else "length"}
+            for i, toks in enumerate(outs)
+        ]
+        total_tokens = sum(len(t) for t in outs)
+        return {
+            "id": f"cmpl-{int(t0 * 1000)}",
+            "object": "text_completion",
+            "model": self.config.model_id,
+            "choices": choices,
+            "usage": {
+                "completion_tokens": total_tokens,
+                "tokens_per_s": round(total_tokens / max(elapsed, 1e-9), 2),
+            },
+        }
+
+
+def build_openai_app(config: LLMConfig, *, deployment_name: str = "v1"):
+    """Deploy the completions endpoint; returns the serve handle
+    (reference: build_openai_app core/ingress/builder.py:213 — the HTTP
+    route is POST /<deployment_name>, our proxy's path convention)."""
+    from ray_tpu import serve
+
+    deployment = serve.Deployment(
+        LLMServer, deployment_name,
+        num_replicas=config.num_replicas,
+        init_args=(config,),
+    )
+    return serve.run(deployment)
+
+
+def batch_completions(config: LLMConfig, ds, *, prompt_column: str = "prompt",
+                      output_column: str = "completion",
+                      batch_size: int = 8):
+    """Batch inference over a ray_tpu.data Dataset (reference: llm batch
+    processor vllm_engine_stage.py). One model instance per map task."""
+
+    def infer_batch(block):
+        server = _server_singleton(config)
+        prompts = [str(p) for p in block[prompt_column].tolist()]
+        result = server({"prompt": prompts})
+        import numpy as np
+
+        out = dict(block)
+        out[output_column] = np.array(
+            [c["text"] for c in result["choices"]], dtype=object)
+        return out
+
+    return ds.map_batches(infer_batch)
+
+
+_SINGLETON: Dict[tuple, LLMServer] = {}
+
+
+def _server_singleton(config: LLMConfig) -> LLMServer:
+    # keyed on everything that changes the loaded model — model_id alone
+    # would silently serve the wrong weights when two configs share it
+    key = (config.model_id, config.model, config.checkpoint_path,
+           config.seed, tuple(sorted(config.model_overrides.items())))
+    if key not in _SINGLETON:
+        _SINGLETON[key] = LLMServer(config)
+    return _SINGLETON[key]
+
+
+__all__ = [
+    "BOS",
+    "EOS",
+    "ByteTokenizer",
+    "LLMConfig",
+    "LLMServer",
+    "batch_completions",
+    "build_openai_app",
+]

@@ -1,0 +1,105 @@
+"""Runtime environment tests: env_vars, working_dir, py_modules for tasks
+and actors (reference: python/ray/tests/test_runtime_env_working_dir.py
+patterns, miniaturized)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_task_env_vars(ray_init):
+    @ray_tpu.remote
+    def read_env():
+        return os.environ.get("RT_ENV_TEST", "missing")
+
+    val = ray_tpu.get(
+        read_env.options(
+            runtime_env={"env_vars": {"RT_ENV_TEST": "on"}}).remote(),
+        timeout=60,
+    )
+    assert val == "on"
+
+
+def test_task_working_dir(ray_init, tmp_path):
+    (tmp_path / "payload.txt").write_text("working-dir-payload")
+    (tmp_path / "helper.py").write_text("MAGIC = 'helper-magic'\n")
+
+    @ray_tpu.remote
+    def use_working_dir():
+        import helper  # importable: working_dir is on sys.path
+
+        return open("payload.txt").read(), helper.MAGIC
+
+    data, magic = ray_tpu.get(
+        use_working_dir.options(
+            runtime_env={"working_dir": str(tmp_path)}).remote(),
+        timeout=60,
+    )
+    assert data == "working-dir-payload"
+    assert magic == "helper-magic"
+
+
+def test_task_py_modules(ray_init, tmp_path):
+    mod = tmp_path / "shipped_mod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("VALUE = 1234\n")
+    (mod / "sub.py").write_text("def f():\n    return 'sub-ok'\n")
+
+    @ray_tpu.remote
+    def use_module():
+        import shipped_mod
+        from shipped_mod.sub import f
+
+        return shipped_mod.VALUE, f()
+
+    value, sub = ray_tpu.get(
+        use_module.options(
+            runtime_env={"py_modules": [str(mod)]}).remote(),
+        timeout=60,
+    )
+    assert value == 1234
+    assert sub == "sub-ok"
+
+
+def test_actor_runtime_env(ray_init, tmp_path):
+    (tmp_path / "actor_data.txt").write_text("actor-sees-this")
+
+    @ray_tpu.remote
+    class EnvActor:
+        def __init__(self):
+            self.data = open("actor_data.txt").read()
+
+        def get(self):
+            return self.data, os.environ.get("ACTOR_ENV_FLAG")
+
+    a = EnvActor.options(runtime_env={
+        "working_dir": str(tmp_path),
+        "env_vars": {"ACTOR_ENV_FLAG": "yes"},
+    }).remote()
+    data, flag = ray_tpu.get(a.get.remote(), timeout=60)
+    assert data == "actor-sees-this"
+    assert flag == "yes"
+    ray_tpu.kill(a)
+
+
+def test_package_cache_dedup(ray_init, tmp_path):
+    """Identical working_dirs share one content-addressed package."""
+    (tmp_path / "f.txt").write_text("same-content")
+
+    @ray_tpu.remote
+    def read():
+        return open("f.txt").read()
+
+    env = {"working_dir": str(tmp_path)}
+    r1 = ray_tpu.get(read.options(runtime_env=env).remote(), timeout=60)
+    r2 = ray_tpu.get(read.options(runtime_env=env).remote(), timeout=60)
+    assert r1 == r2 == "same-content"
