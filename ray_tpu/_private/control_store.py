@@ -186,6 +186,8 @@ class ControlStore:
         # snapshots (reference: GcsTaskManager, metrics agent)
         self.task_events: "collections.deque[dict]" = collections.deque()
         self.metrics_by_worker: Dict[bytes, dict] = {}
+        # per-node scheduling load from heartbeats (autoscaler demand)
+        self.node_load: Dict[bytes, dict] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._stopped = False
         self._wal = None
@@ -374,6 +376,7 @@ class ControlStore:
             return
         info.state = pb.NODE_DEAD
         self.node_available.pop(node_id, None)
+        self.node_load.pop(node_id, None)
         client = self._daemon_clients.pop(node_id, None)
         if client:
             await client.close()
@@ -438,6 +441,13 @@ class ControlStore:
         self.node_last_beat[node_id] = time.monotonic()
         if "available" in payload:
             self.node_available[node_id] = ResourceSet.from_wire(payload["available"])
+        # demand signal for the autoscaler (reference: raylets report load in
+        # resource-view sync; GcsAutoscalerStateManager aggregates it)
+        self.node_load[node_id] = {
+            "pending": payload.get("pending", 0),
+            "pending_resources": payload.get("pending_resources", []),
+            "ts": time.monotonic(),
+        }
         # Reply carries the cluster resource view — the gossip function of
         # ray_syncer (src/ray/ray_syncer/ray_syncer.h:91) piggybacked on the
         # health-check beat.
@@ -455,6 +465,36 @@ class ControlStore:
                 for n in self.node_available
                 if n in self.nodes
             ],
+        }
+
+    async def rpc_get_cluster_load(self, conn_id: int, payload) -> dict:
+        """Aggregate demand + per-node idleness for the autoscaler
+        (reference: AutoscalerStateService GetClusterResourceState,
+        autoscaler.proto:413)."""
+        nodes = []
+        pending_total = 0
+        pending_resources: List[dict] = []
+        for nid, info in self.nodes.items():
+            if info.state not in (pb.NODE_ALIVE, pb.NODE_DRAINING):
+                continue
+            load = self.node_load.get(nid, {})
+            avail = self.node_available.get(nid)
+            pending_total += load.get("pending", 0)
+            pending_resources.extend(load.get("pending_resources", []))
+            nodes.append({
+                "node_id": info.node_id.hex(),
+                "state": info.state,
+                "total": info.resources.to_wire(),
+                "available": avail.to_wire() if avail else {},
+                "pending": load.get("pending", 0),
+                "idle": (avail is not None
+                         and avail.to_wire() == info.resources.to_wire()
+                         and load.get("pending", 0) == 0),
+            })
+        return {
+            "pending_total": pending_total,
+            "pending_resources": pending_resources,
+            "nodes": nodes,
         }
 
     async def rpc_get_resource_view(self, conn_id: int, payload) -> dict:

@@ -243,11 +243,21 @@ class NodeDaemon:
         period = GLOBAL_CONFIG.get("health_check_period_s")
         while not self._stopped:
             try:
+                pending_leases = [
+                    p for p in self.pending if not p.future.done()
+                ]
                 reply = await self.control.call(
                     "heartbeat",
                     {
                         "node_id": self.node_id.binary(),
                         "available": self.available.to_wire(),
+                        # scheduling load → autoscaler demand (reference:
+                        # raylet resource-view sync carries load)
+                        "pending": len(pending_leases),
+                        "pending_resources": [
+                            p.spec_resources.to_wire()
+                            for p in pending_leases[:32]
+                        ],
                     },
                     # short deadline: a dropped beat must not silence this
                     # node long enough to trip health_check_timeout_s
@@ -576,14 +586,49 @@ class NodeDaemon:
         if not self.pending:
             return
         still: List[PendingLease] = []
+        # optimistic view of PEER capacity for spillback of queued leases:
+        # deducted as we spill so a burst doesn't all target one peer
+        peer_view = {
+            nid: avail for nid, avail in self.cluster_view.items()
+            if nid != self.node_id.hex()
+        }
+        hop_cap = GLOBAL_CONFIG.get("lease_spillback_max_hops")
         for p in self.pending:
             if p.future.done():
                 continue
             if p.spec_resources.is_subset_of(self.available):
                 self.available = self.available - p.spec_resources
                 spawn(self._grant(p, pg_id=None, bundle_index=-1))
-            else:
-                still.append(p)
+                continue
+            # locally stuck: a peer (possibly one that just joined — the
+            # autoscaler's whole point) may have room now. Re-evaluating
+            # queued leases on every schedule tick is what moves demand onto
+            # scaled-up nodes (reference: cluster lease manager spillback).
+            # Node-affinity leases stay: they queued HERE on purpose.
+            if (p.hops < hop_cap
+                    and p.strategy.kind in (pb.STRATEGY_DEFAULT,
+                                            pb.STRATEGY_SPREAD)):
+                target = None
+                for nid, avail in peer_view.items():
+                    info = self.peer_nodes.get(nid)
+                    if info is None or info.state != pb.NODE_ALIVE:
+                        continue
+                    if p.strategy.label_selector and not all(
+                        info.labels.get(k) == v
+                        for k, v in p.strategy.label_selector.items()
+                    ):
+                        continue
+                    if p.spec_resources.is_subset_of(avail):
+                        target = nid
+                        break
+                if target is not None:
+                    peer_view[target] = peer_view[target] - p.spec_resources
+                    p.future.set_result({
+                        "spillback": self.peer_nodes[target].address,
+                        "node_id": target,
+                    })
+                    continue
+            still.append(p)
         self.pending = still
 
     async def _grant(self, p: PendingLease, pg_id: Optional[bytes],

@@ -1,0 +1,239 @@
+"""Autoscaler: reconcile cluster size against scheduling demand.
+
+Reference surface: python/ray/autoscaler/v2/autoscaler.py:51 (Autoscaler),
+v2/scheduler.py:895 (ResourceDemandScheduler bin-packing pending demand
+into node types), v2/instance_manager (provider reconciliation), and the
+fake_multi_node provider used as the test vehicle
+(python/ray/autoscaler/_private/fake_multi_node/node_provider.py).
+
+Shape: a driver-side reconciler polls the control store's cluster-load
+aggregate (pending lease demand from daemon heartbeats), bin-packs unmet
+demand into the provider's node type, launches up to max_workers nodes,
+and drains + terminates nodes idle past idle_timeout_s.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class NodeProvider:
+    """Provider ABC (reference: autoscaler/node_provider.py)."""
+
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        raise NotImplementedError
+
+    def terminate_node(self, handle: Any) -> None:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Spawns node-daemon subprocesses on this machine — the counterpart of
+    the reference's fake_multi_node provider (laptop-scale e2e autoscaling
+    tests without a cloud)."""
+
+    def __init__(self, control_address: str, session_dir: str):
+        self.control_address = control_address
+        self.session_dir = session_dir
+
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        from ray_tpu._private import node as node_mod
+
+        proc, info = node_mod.start_node_daemon(
+            self.control_address, self.session_dir, resources=dict(resources))
+        return {"proc": proc, "node_id": info["node_id"],
+                "address": info["address"]}
+
+    def terminate_node(self, handle: Any) -> None:
+        from ray_tpu._private import node as node_mod
+
+        node_mod.kill_process(handle["proc"])
+
+
+@dataclass
+class AutoscalingConfig:
+    """Reference: autoscaler config (max_workers, idle timeout,
+    upscaling_speed)."""
+
+    min_workers: int = 0
+    max_workers: int = 2
+    worker_resources: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 2.0})
+    idle_timeout_s: float = 10.0
+    poll_period_s: float = 1.0
+
+
+class Autoscaler:
+    """Reconciler loop (reference: v2/autoscaler.py:51 update())."""
+
+    def __init__(self, provider: NodeProvider, config: AutoscalingConfig):
+        self.provider = provider
+        self.config = config
+        self.workers: List[dict] = []  # provider handles for launched nodes
+        self._idle_since: Dict[str, float] = {}
+        self._draining: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one reconciliation step (unit-testable) ------------------------
+
+    def _unmet_worker_need(self, load: dict) -> int:
+        """Bin-pack pending lease shapes against existing free capacity plus
+        already-launching workers; return how many NEW worker nodes the
+        remainder needs (reference: v2/scheduler.py:895 demand scheduler)."""
+        from ray_tpu._private.protocol import ResourceSet
+
+        demand = [
+            ResourceSet.from_wire(w) for w in load["pending_resources"]
+        ]
+        if not demand and load["pending_total"] > 0:
+            # shapes got capped out of the heartbeat: assume one worker's
+            # worth of generic demand
+            demand = [ResourceSet(self.config.worker_resources)]
+        free = [
+            ResourceSet.from_wire(n["available"])
+            for n in load["nodes"] if n.get("state") == "ALIVE"
+        ]
+        # launched-but-not-yet-registered nodes count as free bins — without
+        # this, every poll during node startup launches more nodes
+        known = {n["node_id"] for n in load["nodes"]}
+        bin_cap = ResourceSet(self.config.worker_resources)
+        for w in self.workers:
+            if w["node_id"] not in known:
+                free.append(bin_cap)
+        unmet = []
+        for r in demand:
+            for i, f in enumerate(free):
+                if r.is_subset_of(f):
+                    free[i] = f - r
+                    break
+            else:
+                unmet.append(r)
+        needed = 0
+        current = None
+        for r in unmet:
+            if not r.is_subset_of(bin_cap):
+                continue  # no worker type can ever host this shape
+            if current is None or not r.is_subset_of(current):
+                needed += 1
+                current = bin_cap
+            current = current - r
+        return needed
+
+    def reconcile_once(self) -> Dict[str, int]:
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        load = cw.run_sync(cw.control.call("get_cluster_load", {}), 30)
+        launched = terminated = 0
+
+        # prune workers whose daemons died out-of-band
+        alive_ids = {n["node_id"] for n in load["nodes"]}
+        self.workers = [
+            w for w in self.workers
+            if w["proc"].poll() is None or w["node_id"] in alive_ids
+        ]
+
+        # scale up: only for demand existing+starting capacity can't absorb
+        demand = load["pending_total"]
+        need = self._unmet_worker_need(load)
+        to_add = min(need, self.config.max_workers - len(self.workers))
+        for _ in range(max(0, to_add)):
+            handle = self.provider.create_node(self.config.worker_resources)
+            self.workers.append(handle)
+            launched += 1
+            logger.info("autoscaler launched node %s",
+                        handle["node_id"][:12])
+
+        # scale down in two phases (reference: DrainRaylet then terminate):
+        # idle past the timeout -> DRAIN (store stops routing to it);
+        # still idle on a later poll -> unregister + terminate. The drain
+        # closes the race where work lands between a stale idle heartbeat
+        # and the SIGTERM.
+        now = time.monotonic()
+        by_id = {n["node_id"]: n for n in load["nodes"]}
+        for node_id in list(self._idle_since):
+            n = by_id.get(node_id)
+            if n is None or (not n["idle"] and n.get("state") == "ALIVE"):
+                del self._idle_since[node_id]
+                self._draining.pop(node_id, None)
+        for n in load["nodes"]:
+            if n["idle"]:
+                self._idle_since.setdefault(n["node_id"], now)
+        if len(self.workers) > self.config.min_workers and demand == 0:
+            for w in list(self.workers):
+                nid = w["node_id"]
+                n = by_id.get(nid)
+                since = self._idle_since.get(nid)
+                if n is None or since is None:
+                    continue
+                if nid in self._draining:
+                    if n["idle"]:
+                        try:
+                            cw.run_sync(cw.control.call(
+                                "unregister_node",
+                                {"node_id": bytes.fromhex(nid)}), 10)
+                        except Exception:  # noqa: BLE001 — dead already
+                            pass
+                        self.provider.terminate_node(w)
+                        self.workers.remove(w)
+                        self._idle_since.pop(nid, None)
+                        self._draining.pop(nid, None)
+                        terminated += 1
+                        logger.info("autoscaler terminated drained node %s",
+                                    nid[:12])
+                        if len(self.workers) <= self.config.min_workers:
+                            break
+                elif now - since >= self.config.idle_timeout_s:
+                    try:
+                        cw.run_sync(cw.control.call(
+                            "drain_node",
+                            {"node_id": bytes.fromhex(nid)}), 10)
+                        self._draining[nid] = now
+                        logger.info("autoscaler draining idle node %s",
+                                    nid[:12])
+                    except Exception:  # noqa: BLE001
+                        pass
+        return {"launched": launched, "terminated": terminated,
+                "workers": len(self.workers), "demand": demand}
+
+    # -- background loop -------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001 — keep reconciling
+                logger.exception("autoscaler reconcile failed")
+            self._stop.wait(self.config.poll_period_s)
+
+    def stop(self, terminate_workers: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if terminate_workers:
+            for w in self.workers:
+                try:
+                    self.provider.terminate_node(w)
+                except Exception:  # noqa: BLE001
+                    pass
+            self.workers.clear()
+
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalingConfig",
+    "LocalNodeProvider",
+    "NodeProvider",
+]

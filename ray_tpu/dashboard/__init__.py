@@ -1,0 +1,239 @@
+"""Dashboard: HTTP JSON API + overview page for cluster state.
+
+Reference surface: python/ray/dashboard/ (DashboardHead head.py:49 serving
+/api/... routes + the React frontend; per-node agents feed it). Here one
+detached async actor serves the API straight from the control store's
+tables — nodes, actors, jobs, tasks, placement groups, Prometheus metrics
+— plus a single-file HTML overview.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict
+
+import ray_tpu
+
+DASHBOARD_NAME = "dashboard"
+DASH_NAMESPACE = "_dashboard"
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #111; color: #eee; }
+ h1 { color: #7fdbca; } h2 { color: #82aaff; margin-top: 1.5em; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #444; padding: 4px 10px; text-align: left; }
+ th { background: #222; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="content">loading…</div>
+<script>
+async function refresh() {
+  const [nodes, actors, jobs, tasks] = await Promise.all(
+    ["nodes", "actors", "jobs", "task_summary"].map(
+      p => fetch("/api/" + p).then(r => r.json())));
+  const esc = (s) => String(s).replace(/[&<>"']/g,
+    ch => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
+  const table = (rows) => {
+    if (!rows.length) return "<i>(none)</i>";
+    const cols = Object.keys(rows[0]);
+    return "<table><tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") +
+      "</tr>" + rows.map(r => "<tr>" + cols.map(
+        c => `<td>${esc(JSON.stringify(r[c]))}</td>`).join("") +
+      "</tr>").join("") + "</table>";
+  };
+  document.getElementById("content").innerHTML =
+    "<h2>Nodes</h2>" + table(nodes) +
+    "<h2>Actors</h2>" + table(actors) +
+    "<h2>Jobs</h2>" + table(jobs) +
+    "<h2>Tasks</h2><pre>" + JSON.stringify(tasks, null, 1) + "</pre>";
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+@ray_tpu.remote
+class DashboardActor:
+    """Async actor hosting the aiohttp app (same pattern as the serve
+    ingress proxy — the server starts lazily on the core event loop)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._started = None
+        self._runner = None
+
+    async def _control(self, method: str, payload: dict = None):
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        return await cw.control.call(method, payload or {})
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/nodes", self._nodes)
+        app.router.add_get("/api/actors", self._actors)
+        app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/api/tasks", self._tasks)
+        app.router.add_get("/api/task_summary", self._task_summary)
+        app.router.add_get("/api/placement_groups", self._pgs)
+        app.router.add_get("/api/cluster_load", self._cluster_load)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+
+    async def ready(self) -> str:
+        if self._started is None:
+            self._started = asyncio.ensure_future(self._start())
+        try:
+            await self._started
+        except BaseException:
+            # a transient bind failure must not brick the detached actor:
+            # the next ready() retries the startup
+            self._started = None
+            raise
+        return f"http://{self.host}:{self.port}"
+
+    async def _index(self, request):
+        from aiohttp import web
+
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    async def _nodes(self, request):
+        from aiohttp import web
+
+        from ray_tpu._private.protocol import NodeInfo
+
+        reply = await self._control("get_all_nodes")
+        return web.json_response([
+            {
+                "node_id": NodeInfo.from_wire(n).node_id.hex()[:12],
+                "state": n["state"],
+                "address": n["address"],
+                "resources": NodeInfo.from_wire(n).resources.to_dict(),
+            }
+            for n in reply["nodes"]
+        ])
+
+    async def _actors(self, request):
+        from aiohttp import web
+
+        reply = await self._control("list_actors")
+        return web.json_response([
+            {
+                "actor_id": a["actor_id"].hex()[:12],
+                "state": a["state"],
+                "name": a.get("name", ""),
+                "restarts": a.get("num_restarts", 0),
+            }
+            for a in reply["actors"]
+        ])
+
+    async def _jobs(self, request):
+        from aiohttp import web
+
+        reply = await self._control("get_all_jobs")
+        return web.json_response([
+            {
+                "job_id": j["job_id"].hex(),
+                "finished": j.get("finished", False),
+                "age_s": round(time.time() - j.get("start_time", time.time())),
+            }
+            for j in reply["jobs"]
+        ])
+
+    async def _tasks(self, request):
+        from aiohttp import web
+
+        reply = await self._control("list_task_events", {"limit": 200})
+        return web.json_response([
+            {
+                "task_id": ev["task_id"].hex()[:12],
+                "name": ev["name"],
+                "state": ev["event"],
+                "duration_s": round(ev.get("duration_s", 0), 4),
+            }
+            for ev in reply["events"]
+        ])
+
+    async def _task_summary(self, request):
+        from aiohttp import web
+
+        reply = await self._control("list_task_events", {"limit": 0})
+        counts: Dict[str, int] = {}
+        latest: Dict[bytes, str] = {}
+        for ev in reply["events"]:
+            latest[ev["task_id"]] = ev["event"]
+        for st in latest.values():
+            counts[st] = counts.get(st, 0) + 1
+        return web.json_response(counts)
+
+    async def _pgs(self, request):
+        from aiohttp import web
+
+        reply = await self._control("list_placement_groups")
+        return web.json_response([
+            {
+                "pg_id": pg["pg_id"].hex()[:12],
+                "state": pg["state"],
+                "bundles": len(pg.get("bundles", [])),
+            }
+            for pg in reply["pgs"]
+        ])
+
+    async def _cluster_load(self, request):
+        from aiohttp import web
+
+        return web.json_response(await self._control("get_cluster_load"))
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        from ray_tpu.util.metrics import render_prometheus
+
+        reply = await self._control("get_metrics")
+        return web.Response(text=render_prometheus(reply["workers"]),
+                            content_type="text/plain")
+
+    async def stop(self) -> bool:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        return True
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> str:
+    """Start (or reuse) the dashboard; returns its URL (reference: the
+    dashboard head process started by `ray start --head`)."""
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_NAME, namespace=DASH_NAMESPACE)
+    except ValueError:
+        actor = DashboardActor.options(
+            name=DASHBOARD_NAME, namespace=DASH_NAMESPACE,
+            lifetime="detached", max_concurrency=64,
+        ).remote(host=host, port=port)
+    return ray_tpu.get(actor.ready.remote(), timeout=60)
+
+
+def stop_dashboard():
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_NAME, namespace=DASH_NAMESPACE)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(actor.stop.remote(), timeout=30)
+    except Exception:  # noqa: BLE001
+        pass
+    ray_tpu.kill(actor)
+
+
+__all__ = ["DashboardActor", "start_dashboard", "stop_dashboard"]

@@ -1,0 +1,70 @@
+"""JAX/TPU profiler capture across the cluster.
+
+Reference surface: python/ray/util/tpu.py:1060 init_jax_profiler (starts
+the profiler server inside workers) and the dashboard's JAX capture
+endpoint (dashboard/modules/reporter/jax_profile_manager.py:11). Here
+capture is a plain remote task pinned to the target node, writing an
+XPlane/perfetto trace directory the driver can fetch or inspect.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import ray_tpu
+
+
+def init_jax_profiler(port: int = 9999) -> int:
+    """Start the in-process profiler server (attachable from TensorBoard /
+    xprof; reference: util/tpu.py init_jax_profiler)."""
+    import jax
+
+    jax.profiler.start_server(port)
+    return port
+
+
+def capture_local(logdir: str, duration_s: float = 2.0,
+                  workload=None) -> str:
+    """Trace this process's JAX activity for duration_s (or around
+    `workload()` if given); returns the trace dir."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        if workload is not None:
+            workload()
+        else:
+            time.sleep(duration_s)
+    finally:
+        jax.profiler.stop_trace()
+    return logdir
+
+
+@ray_tpu.remote
+def _capture_task(logdir: str, duration_s: float) -> List[str]:
+    """Runs on the target node's worker: captures its JAX runtime trace."""
+    capture_local(logdir, duration_s)
+    out = []
+    for root, _dirs, files in os.walk(logdir):
+        out.extend(os.path.join(root, f) for f in files)
+    return out
+
+
+def capture_on_node(node_id_hex: str, logdir: str,
+                    duration_s: float = 2.0) -> List[str]:
+    """Capture a JAX profile on a specific node (reference: the dashboard
+    agent's per-node capture). Returns trace file paths on that node."""
+    from ray_tpu._private.protocol import SchedulingStrategy
+
+    task = _capture_task.options(
+        scheduling_strategy=SchedulingStrategy(
+            kind="NODE_AFFINITY", node_id=node_id_hex, soft=False),
+    )
+    return ray_tpu.get(task.remote(logdir, duration_s),
+                       timeout=duration_s + 120)
+
+
+__all__ = ["capture_local", "capture_on_node", "init_jax_profiler"]

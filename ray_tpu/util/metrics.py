@@ -156,16 +156,13 @@ def _fmt_tags(tags: Dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
-def prometheus_text() -> str:
-    """Cluster-wide metrics in Prometheus exposition format, aggregated from
-    every reporting worker's latest snapshot (counters/histograms summed,
-    gauges per-worker-last merged by last writer)."""
-    from ray_tpu._private.core_worker import get_core_worker
-
-    cw = get_core_worker()
-    reply = cw.run_sync(cw.control.call("get_metrics", {}))
+def render_prometheus(workers_reply: Dict[Any, dict]) -> str:
+    """Aggregate per-worker snapshots (the control store's get_metrics
+    reply) into Prometheus exposition text: counters/histograms summed,
+    gauges last-writer-wins. Shared by prometheus_text() and the dashboard's
+    /metrics endpoint so the two cannot diverge."""
     merged: Dict[tuple, dict] = {}
-    for w in reply["workers"].values():
+    for w in workers_reply.values():
         for s in w["metrics"]:
             key = (s["name"], _tags_key(s["tags"]), s["type"])
             cur = merged.get(key)
@@ -199,3 +196,12 @@ def prometheus_text() -> str:
         else:
             lines.append(f"{name}{_fmt_tags(s['tags'])} {s['value']}")
     return "\n".join(lines) + "\n"
+
+
+def prometheus_text() -> str:
+    """Cluster-wide metrics in Prometheus exposition format."""
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    reply = cw.run_sync(cw.control.call("get_metrics", {}))
+    return render_prometheus(reply["workers"])

@@ -1,0 +1,75 @@
+"""Dashboard HTTP API tests (reference: dashboard modules' REST routes)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    stop_dashboard()
+    ray_tpu.shutdown()
+
+
+def test_dashboard_endpoints(ray_init):
+    import httpx
+
+    url = start_dashboard(port=18265)
+
+    @ray_tpu.remote
+    def traced():
+        from ray_tpu.util.metrics import Counter
+
+        Counter("dash_test_counter").inc(2)
+        time.sleep(1.2)  # let telemetry flush
+        return 1
+
+    @ray_tpu.remote
+    class DashActor:
+        def ping(self):
+            return "pong"
+
+    a = DashActor.options(name="dash-actor").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    assert ray_tpu.get(traced.remote(), timeout=60) == 1
+
+    page = httpx.get(f"{url}/", timeout=30)
+    assert page.status_code == 200 and "ray_tpu dashboard" in page.text
+
+    nodes = httpx.get(f"{url}/api/nodes", timeout=30).json()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+
+    actors = httpx.get(f"{url}/api/actors", timeout=30).json()
+    assert any(x["name"] == "dash-actor" for x in actors)
+
+    jobs = httpx.get(f"{url}/api/jobs", timeout=30).json()
+    assert len(jobs) >= 1
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        tasks = httpx.get(f"{url}/api/tasks", timeout=30).json()
+        if any("traced" in t["name"] for t in tasks):
+            break
+        time.sleep(0.5)
+    assert any("traced" in t["name"] for t in tasks)
+
+    summary = httpx.get(f"{url}/api/task_summary", timeout=30).json()
+    assert summary.get("FINISHED", 0) >= 1
+
+    deadline = time.time() + 15
+    metrics = ""
+    while time.time() < deadline:
+        metrics = httpx.get(f"{url}/metrics", timeout=30).text
+        if "dash_test_counter" in metrics:
+            break
+        time.sleep(0.5)
+    assert "dash_test_counter" in metrics
+
+    load = httpx.get(f"{url}/api/cluster_load", timeout=30).json()
+    assert "pending_total" in load and len(load["nodes"]) == 1
+    ray_tpu.kill(a)

@@ -133,3 +133,16 @@ def test_placement_group_listing(ray_init):
     pgs = state.list_placement_groups()
     assert any(p["state"] == "CREATED" for p in pgs)
     remove_placement_group(pg)
+
+
+def test_jax_profiler_capture(ray_init, tmp_path):
+    """JAX profiler capture on a cluster node writes an XPlane trace
+    (reference: jax_profile_manager.py capture + util/tpu.py profiler)."""
+    from ray_tpu.tpu.profiler import capture_on_node
+
+    node = state.list_nodes()[0]
+
+    files = capture_on_node(node["node_id"], str(tmp_path / "prof"),
+                            duration_s=0.5)
+    assert files, "no trace files produced"
+    assert any(f.endswith(".xplane.pb") or "trace" in f for f in files), files
