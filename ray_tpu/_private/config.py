@@ -134,6 +134,8 @@ _flag("object_spill_low_water", 0.5, "Spill until store fullness drops below thi
 _flag("object_spill_check_period_s", 0.25, "Spill loop poll period.")
 _flag("control_store_persist", False, "Persist control-store state (nodes/actors/PGs/KV/jobs) to a WAL+snapshot in the session dir; a restarted control store recovers it (reference: gcs redis/rocksdb store clients).")
 _flag("control_store_wal_compact_every", 512, "WAL records between snapshot compactions.")
+_flag("lineage_cache_max_tasks", 4096, "Completed task specs kept per owner for lineage reconstruction of lost shm objects (reference: task_manager lineage pinning).")
+_flag("max_lineage_reconstructions", 3, "Times one lost object may be recomputed from lineage before get() raises ObjectLostError (reference: object_recovery_manager.h retry cap).")
 
 # --- chaos / fault injection (day 1, per SURVEY §4) ---
 _flag("testing_event_loop_delay_us", "", "Inject delays into event-loop handlers. Format: 'method:min_us:max_us,...' ('*' matches all). Mirrors RAY_testing_asio_delay_us.")

@@ -415,6 +415,16 @@ class CoreWorker:
         # CancelTask / actor_task_submitter queued-task cancellation)
         self._submissions: Dict[bytes, dict] = {}
         self._return_to_task: Dict[bytes, bytes] = {}
+        # lineage cache (reference: object_recovery_manager.h + task_manager
+        # lineage pinning): completed task specs whose shm-resident returns
+        # are still referenced, so a lost object can be recomputed by
+        # resubmitting its creating task. keepalive pins the arg ObjectRefs
+        # for as long as the lineage entry lives (reference pins lineage via
+        # the reference counter).
+        self._lineage: Dict[bytes, tuple] = {}  # tid -> (spec, keepalive, n_rebuilt)
+        self._lineage_returns: Dict[bytes, bytes] = {}  # return oid -> tid
+        self._lineage_live: Dict[bytes, int] = {}  # tid -> live return count
+        self._reconstructing: Dict[bytes, asyncio.Future] = {}
         self._actor_states: Dict[bytes, ActorHandleState] = {}
         self._owned_actor_handles: Dict[bytes, int] = {}
         self._bg_futures: set = set()
@@ -587,31 +597,81 @@ class CoreWorker:
         oid = ref.binary()
         deadline = None if timeout is None else time.monotonic() + timeout
         if self.owns(ref):
-            fut = self.memory_store.wait_future(oid)
-            await self._await_deadline(fut, deadline, ref)
-            if oid in self.memory_store.objects:
-                data, meta = self.memory_store.objects[oid]
-                return self._materialize(data, meta, copy_buffers=False)
-            location = self.memory_store.locations[oid]
-            return await self._read_store_object(ref, location, deadline)
+            while True:
+                fut = self.memory_store.wait_future(oid)
+                await self._await_deadline(fut, deadline, ref)
+                if oid in self.memory_store.objects:
+                    data, meta = self.memory_store.objects[oid]
+                    return self._materialize(data, meta, copy_buffers=False)
+                location = self.memory_store.locations.get(oid)
+                if location is None:
+                    # a concurrent reconstruction cleared the stale location;
+                    # loop back and wait for the fresh execution to land
+                    await asyncio.sleep(0)
+                    continue
+                try:
+                    return await self._read_store_object(ref, location, deadline)
+                except ObjectLostError:
+                    # the store node died with the object; recompute from
+                    # lineage and retry with the fresh location (bounded by
+                    # the caller's deadline — recovery continues regardless)
+                    if not await self._bounded(
+                        self._maybe_reconstruct(oid, location.get("node_id")),
+                        deadline, ref, "reconstructing",
+                    ):
+                        raise
         # borrowed: ask the owner (bounded by the caller's deadline)
-        owner_call = self._call_owner(ref, "get_object", {"object_id": oid})
+        return await self._fetch_via_owner(ref, deadline, copy_buffers=False)
+
+    async def _bounded(self, coro, deadline, ref: ObjectRef, what: str):
+        """Await `coro`, raising GetTimeoutError past `deadline`. The work
+        itself is shielded: a caller timeout never aborts owner-side
+        recovery or an in-flight owner RPC."""
         if deadline is None:
-            reply = await owner_call
-        else:
+            return await coro
+        try:
+            return await asyncio.wait_for(
+                asyncio.shield(spawn(coro)),
+                max(0.0, deadline - time.monotonic()),
+            )
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(f"get() timed out {what} {ref.hex()}") from None
+
+    async def _fetch_via_owner(self, ref: ObjectRef, deadline,
+                               copy_buffers: bool) -> Any:
+        """Borrower-side fetch: ask the owner for the value or its location,
+        read the store copy, and on a lost store node ask the owner to
+        reconstruct from lineage — all bounded by the caller's deadline
+        (owner-side recovery keeps going past a caller timeout)."""
+        oid = ref.binary()
+        reconstruct_tries = 0
+        while True:
+            reply = await self._bounded(
+                self._call_owner(ref, "get_object", {"object_id": oid}),
+                deadline, ref, "waiting for",
+            )
+            if reply.get("error"):
+                raise ObjectLostError(ref.hex(), reply["error"])
+            if "data" in reply and reply["data"] is not None:
+                return self._materialize(reply["data"], reply["meta"],
+                                         copy_buffers=copy_buffers)
+            location = reply["location"]
             try:
-                reply = await asyncio.wait_for(
-                    owner_call, max(0.0, deadline - time.monotonic())
+                return await self._read_store_object(ref, location, deadline)
+            except ObjectLostError:
+                # ask the owner to rebuild it from lineage, then re-fetch
+                reconstruct_tries += 1
+                if reconstruct_tries > GLOBAL_CONFIG.get("max_lineage_reconstructions"):
+                    raise
+                rec = await self._bounded(
+                    self._call_owner(ref, "reconstruct_object", {
+                        "object_id": oid,
+                        "failed_node": location.get("node_id"),
+                    }),
+                    deadline, ref, "reconstructing",
                 )
-            except asyncio.TimeoutError:
-                raise GetTimeoutError(
-                    f"get() timed out waiting for {ref.hex()} at its owner"
-                ) from None
-        if reply.get("error"):
-            raise ObjectLostError(ref.hex(), reply["error"])
-        if "data" in reply and reply["data"] is not None:
-            return self._materialize(reply["data"], reply["meta"], copy_buffers=False)
-        return await self._read_store_object(ref, reply["location"], deadline)
+                if not rec.get("ok"):
+                    raise
 
     async def _await_deadline(self, fut, deadline, ref):
         if deadline is None:
@@ -633,6 +693,7 @@ class CoreWorker:
         # retries the applicable recovery (remote pull / spill restore) until
         # the pin lands or the deadline passes.
         last_restore = 0.0
+        failed_restores = 0
         while True:
             res = self.store.get(oid)  # pins on success
             if res is not None:
@@ -660,6 +721,14 @@ class CoreWorker:
                 )
                 if reply.get("ok"):
                     continue
+                failed_restores += 1
+                # not in shm, not spilled, and given ~5s of mid-seal grace:
+                # the object is gone (evicted or never landed) — surface it
+                # so the owner's lineage reconstruction can recompute it
+                if failed_restores >= 25:
+                    raise ObjectLostError(
+                        ref.hex(), "object missing from local store and spill dir"
+                    )
             await asyncio.sleep(0.002)
         view, meta = res
         if meta == META_ERROR:
@@ -757,7 +826,23 @@ class CoreWorker:
         if ret.get("inline") is not None:
             self.memory_store.put(oid, ret["inline"], ret.get("meta", META_NORMAL))
         else:
-            self.memory_store.set_location(oid, ret["location"])
+            new = ret["location"]
+            old = self.memory_store.locations.get(oid)
+            if old is not None and old.get("daemon") != new.get("daemon"):
+                # a retry/reconstruction relocated the object; free the
+                # superseded copy so healthy nodes don't accumulate orphans
+                spawn(self._free_store_copy(oid, old))
+            self.memory_store.set_location(oid, new)
+
+    async def _free_store_copy(self, oid: bytes, loc: dict):
+        try:
+            if loc.get("node_id") == self.node_id_hex:
+                self.store.delete(ObjectID(oid))
+            else:
+                client = await self._owner_client(loc["daemon"])
+                await client.call("free_objects", {"object_ids": [oid]}, timeout=5)
+        except Exception:  # noqa: BLE001 — the holder may be the dead node
+            pass
 
     def _stream_end(self, tid: bytes, total: int):
         st = self._streams.get(tid)
@@ -917,15 +1002,9 @@ class CoreWorker:
         key = oid.binary()
         loc = self.memory_store.locations.get(key)
         self.memory_store.delete(key)
+        self._drop_lineage_for(key)
         if loc is not None:
-            try:
-                if loc.get("node_id") == self.node_id_hex:
-                    self.store.delete(oid)
-                else:
-                    client = await self._owner_client(loc["daemon"])
-                    await client.call("free_objects", {"object_ids": [key]}, timeout=5)
-            except Exception:  # noqa: BLE001
-                pass
+            await self._free_store_copy(key, loc)
 
     # ------------------------------------------------------------------
     # task submission (reference: normal_task_submitter.h:87)
@@ -1194,6 +1273,7 @@ class CoreWorker:
                 return
             try:
                 await self._submit_once(spec)
+                self._record_lineage(spec, keepalive)
                 return
             except asyncio.CancelledError:
                 # ray_tpu.cancel() of a queued/leasing task cancels this
@@ -1290,6 +1370,138 @@ class CoreWorker:
             return
         for ret in reply["returns"]:
             self._record_return_entry(ret)
+
+    # ------------------------------------------------------------------
+    # lineage reconstruction (reference: object_recovery_manager.h —
+    # a lost shm-resident return is recovered by resubmitting its
+    # creating task; args resolve recursively through the same path)
+    # ------------------------------------------------------------------
+
+    def _return_is_live(self, oid: bytes) -> bool:
+        """An owned return is live while anyone (local or borrower) holds it."""
+        rc = self.ref_counter
+        return (rc.local_counts.get(oid, 0) > 0
+                or rc.borrower_counts.get(oid, 0) > 0)
+
+    def _record_lineage(self, spec: TaskSpec, keepalive):
+        """Cache the spec of a completed task whose returns live in a shm
+        store (location-recorded) — those die with their node. Inline
+        returns live in the owner's memory store and need no lineage.
+        Already-freed returns (refcount zero) are not re-registered — a
+        re-execution may have recreated them, but nothing can free them
+        again, so tracking them would leak the lineage entry."""
+        if spec.actor_id is not None or spec.is_streaming:
+            return  # actor state is not replayable; streams not recovered
+        ret_oids = [
+            oid.binary() for oid in spec.return_ids()
+            if oid.binary() in self.memory_store.locations
+            and self._return_is_live(oid.binary())
+        ]
+        if not ret_oids:
+            return
+        tid = spec.task_id.binary()
+        prior = self._lineage.get(tid)
+        self._lineage[tid] = (spec, keepalive, prior[2] if prior else 0)
+        for ob in ret_oids:
+            if self._lineage_returns.get(ob) != tid:
+                self._lineage_returns[ob] = tid
+                self._lineage_live[tid] = self._lineage_live.get(tid, 0) + 1
+        cap = GLOBAL_CONFIG.get("lineage_cache_max_tasks")
+        while len(self._lineage) > cap:
+            old_tid = next(iter(self._lineage))
+            old_spec, _, _ = self._lineage.pop(old_tid)
+            self._lineage_live.pop(old_tid, None)
+            for oid in old_spec.return_ids():
+                self._lineage_returns.pop(oid.binary(), None)
+
+    def _drop_lineage_for(self, oid: bytes):
+        tid = self._lineage_returns.pop(oid, None)
+        if tid is None:
+            return
+        live = self._lineage_live.get(tid, 1) - 1
+        if live <= 0:
+            self._lineage_live.pop(tid, None)
+            self._lineage.pop(tid, None)
+        else:
+            self._lineage_live[tid] = live
+
+    async def _maybe_reconstruct(self, oid: bytes,
+                                 failed_node: Optional[str] = None) -> bool:
+        """Owner-side: recompute a lost object by resubmitting its creating
+        task. Returns True if the object was (or already had been) recovered
+        — the caller should retry the read — False if it has no usable
+        lineage. `failed_node` is the node the caller's read failed against:
+        if the current location already points elsewhere, an earlier
+        reconstruction refreshed it and no new re-execution is needed."""
+        tid = self._lineage_returns.get(oid)
+        if tid is None:
+            return False
+        pending = self._reconstructing.get(tid)
+        if pending is not None:
+            await asyncio.shield(pending)
+            return True
+        if oid in self.memory_store.objects:
+            return True
+        cur = self.memory_store.locations.get(oid)
+        if (cur is not None and failed_node is not None
+                and cur.get("node_id") != failed_node):
+            return True  # a finished reconstruction already relocated it
+        entry = self._lineage.get(tid)
+        if entry is None:
+            return False
+        spec, keepalive, n_rebuilt = entry
+        if n_rebuilt >= GLOBAL_CONFIG.get("max_lineage_reconstructions"):
+            logger.warning(
+                "object %s lost and lineage reconstruction budget spent",
+                ObjectID(oid).hex(),
+            )
+            return False
+        self._lineage[tid] = (spec, keepalive, n_rebuilt + 1)
+        done = self.loop.create_future()
+        self._reconstructing[tid] = done
+        logger.info(
+            "reconstructing %s by resubmitting task %s (attempt %d)",
+            ObjectID(oid).hex(), spec.name or spec.function_key, n_rebuilt + 1,
+        )
+        try:
+            # clear only locations lost with the failed node, so healthy
+            # sibling copies stay readable; waiters block on the fresh run
+            for roid in spec.return_ids():
+                rb = roid.binary()
+                loc = self.memory_store.locations.get(rb)
+                if (rb not in self.memory_store.objects and loc is not None
+                        and (failed_node is None
+                             or loc.get("node_id") == failed_node)):
+                    self.memory_store.locations.pop(rb, None)
+            # track the resubmission so ray_tpu.cancel() can reach it
+            atask = spawn(self._submit_with_retries(spec, keepalive))
+            self._track_submission(spec, atask)
+            try:
+                await atask
+            except asyncio.CancelledError:
+                if not atask.cancelled():
+                    raise  # this coroutine was cancelled, not the resubmission
+                # cancelled resubmission already resolved the returns with
+                # TaskCancelledError; the retrying reader surfaces it
+            # the re-execution recreates every return; drop fresh copies of
+            # returns nobody references anymore (they can never be freed by
+            # refcount — their count is already zero)
+            for roid in spec.return_ids():
+                rb = roid.binary()
+                if rb != oid and not self._return_is_live(rb):
+                    spawn(self.free_owned_object(roid))
+        finally:
+            self._reconstructing.pop(tid, None)
+            if not done.done():
+                done.set_result(True)
+        return True
+
+    async def rpc_reconstruct_object(self, conn_id: int, payload: dict) -> dict:
+        """A borrower observed the object's store node die; recover it."""
+        ok = await self._maybe_reconstruct(
+            payload["object_id"], payload.get("failed_node")
+        )
+        return {"ok": ok} if ok else {"ok": False, "error": "no lineage for object"}
 
     async def _acquire_lease(self, spec: TaskSpec) -> dict:
         address = self.daemon_address
@@ -1729,12 +1941,7 @@ class CoreWorker:
                     view, copy_buffers=False,
                     release=functools.partial(self.store.release, ref.object_id()),
                 )
-        reply = await self._call_owner(ref, "get_object", {"object_id": ref.binary()})
-        if reply.get("error"):
-            raise ObjectLostError(ref.hex(), reply["error"])
-        if reply.get("data") is not None:
-            return self._materialize(reply["data"], reply["meta"], copy_buffers=True)
-        return await self._read_store_object(ref, reply["location"], None)
+        return await self._fetch_via_owner(ref, None, copy_buffers=True)
 
     async def _create_with_spill(self, oid: ObjectID, size: int,
                                  meta: int = META_NORMAL) -> memoryview:
