@@ -519,6 +519,19 @@ class ControlStore:
         self.pubsub.publish("nodes", info.to_wire())
         return {"ok": True}
 
+    async def rpc_undrain_node(self, conn_id: int, payload: dict) -> dict:
+        """Reverse a drain that never reached termination — demand returned
+        before the autoscaler terminated the node (reference: autoscaler v2
+        cancels drains for nodes it decides to keep)."""
+        node_id = payload["node_id"]
+        info = self.nodes.get(node_id)
+        if info is None or info.state != pb.NODE_DRAINING:
+            return {"ok": False}
+        info.state = pb.NODE_ALIVE
+        self._persist("node", info.to_wire())
+        self.pubsub.publish("nodes", info.to_wire())
+        return {"ok": True}
+
     async def rpc_unregister_node(self, conn_id: int, payload: dict) -> dict:
         await self._mark_node_dead(payload["node_id"], "unregistered")
         return {"ok": True}

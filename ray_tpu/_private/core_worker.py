@@ -1392,6 +1392,11 @@ class CoreWorker:
         again, so tracking them would leak the lineage entry."""
         if spec.actor_id is not None or spec.is_streaming:
             return  # actor state is not replayable; streams not recovered
+        if spec.max_retries <= 0:
+            # max_retries=0 is an at-most-once contract (side-effecting
+            # tasks); never silently re-run them (reference:
+            # object_recovery_manager reconstructs only retryable tasks)
+            return
         ret_oids = [
             oid.binary() for oid in spec.return_ids()
             if oid.binary() in self.memory_store.locations

@@ -140,6 +140,11 @@ class NodeDaemon:
         self.leases: Dict[bytes, Tuple[bytes, ResourceSet, Optional[bytes]]] = {}
         #   lease_id -> (worker_id, resources, pg_id, bundle_index)
         self.pending: List[PendingLease] = []
+        # recently-rejected infeasible lease shapes (deduped): reported in
+        # heartbeats so the autoscaler can provision nodes for demand no
+        # current node can host (clients retry infeasible leases every
+        # ~0.5s, refreshing these entries until capacity appears)
+        self._infeasible_seen: Dict[tuple, float] = {}
         # idempotency for retried RPCs (dropped/timed-out calls re-sent by
         # clients must not double-grant/double-create)
         self._lease_requests: Dict[bytes, asyncio.Task] = {}
@@ -154,6 +159,10 @@ class NodeDaemon:
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
         self._draining = False
+        # monotonic stamp of the last authoritative drain-state sync; an
+        # in-flight heartbeat reply issued BEFORE a pubsub drain update must
+        # not roll the state back (reply snapshots are unordered vs pubsub)
+        self._drain_sync_ts = 0.0
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
         # spilled objects: oid bytes -> (path, metadata, size). Reference:
         # raylet local_object_manager.h:45 spill/restore of primary copies.
@@ -225,10 +234,22 @@ class NodeDaemon:
         if self.store:
             self.store.destroy()
 
+    def _sync_drain_state(self, state: str):
+        """Mirror the control store's view of this node into the local
+        lease gate (reference: DrainRaylet; undrain re-opens local grants)."""
+        self._drain_sync_ts = time.monotonic()
+        draining = state == pb.NODE_DRAINING
+        if draining != self._draining:
+            self._draining = draining
+            logger.info("node %s drain -> %s", self.node_id.hex()[:8], draining)
+            if not draining:
+                self._try_schedule()
+
     def _on_node_update(self, message: dict):
         info = NodeInfo.from_wire(message)
         hexid = info.node_id.hex()
         if hexid == self.node_id.hex():
+            self._sync_drain_state(info.state)
             return
         if info.state == pb.NODE_ALIVE:
             self.peer_nodes[hexid] = info
@@ -246,18 +267,26 @@ class NodeDaemon:
                 pending_leases = [
                     p for p in self.pending if not p.future.done()
                 ]
+                now = time.monotonic()
+                self._infeasible_seen = {
+                    k: t for k, t in self._infeasible_seen.items()
+                    if now - t < 5.0
+                }
+                beat_started = time.monotonic()
                 reply = await self.control.call(
                     "heartbeat",
                     {
                         "node_id": self.node_id.binary(),
                         "available": self.available.to_wire(),
                         # scheduling load → autoscaler demand (reference:
-                        # raylet resource-view sync carries load)
-                        "pending": len(pending_leases),
+                        # raylet resource-view sync carries load). Infeasible
+                        # shapes count too: no live node can host them, but
+                        # the autoscaler may be able to provision one.
+                        "pending": len(pending_leases) + len(self._infeasible_seen),
                         "pending_resources": [
                             p.spec_resources.to_wire()
                             for p in pending_leases[:32]
-                        ],
+                        ] + [dict(k) for k in list(self._infeasible_seen)[:8]],
                     },
                     # short deadline: a dropped beat must not silence this
                     # node long enough to trip health_check_timeout_s
@@ -277,6 +306,11 @@ class NodeDaemon:
                 for nw in reply.get("nodes", []):
                     info = NodeInfo.from_wire(nw)
                     self.peer_nodes[info.node_id.hex()] = info
+                    if (info.node_id.hex() == self.node_id.hex()
+                            and beat_started > self._drain_sync_ts):
+                        # stale-reply guard: a reply snapshotted before the
+                        # last pubsub drain/undrain push must not revert it
+                        self._sync_drain_state(info.state)
                 self._try_schedule()
             except Exception as e:  # noqa: BLE001
                 logger.warning("heartbeat failed: %s", e)
@@ -498,7 +532,12 @@ class NodeDaemon:
         if strategy.kind == pb.STRATEGY_PLACEMENT_GROUP:
             if self._draining:
                 # DrainRaylet rejects all new leases; the caller retries until
-                # the node dies and the control store reschedules the PG
+                # the node dies and the control store reschedules the PG.
+                # Record the shape as demand — the autoscaler must see work
+                # a draining node turned away, or it can never undrain us.
+                self._infeasible_seen[
+                    tuple(sorted(spec_res.to_wire().items()))
+                ] = time.monotonic()
                 return {"retry": True, "draining": True}
             return await self._grant_pg_lease(spec_res, strategy, job_id)
 
@@ -521,11 +560,19 @@ class NodeDaemon:
                 return {"infeasible": True,
                         "error": f"node {choice} not available for hard affinity"}
         if choice is None and not self._feasible_anywhere(spec_res):
+            key = tuple(sorted(spec_res.to_wire().items()))
+            self._infeasible_seen[key] = time.monotonic()
             return {"infeasible": True}
         if self._draining:
             # Never grant locally while draining; the caller retries until the
             # drain finishes or another node has capacity (reference:
-            # DrainRaylet rejects new leases during drain).
+            # DrainRaylet rejects new leases during drain). The rejected shape
+            # still counts as demand: without it, work only this (draining)
+            # node can host is invisible to the autoscaler and the undrain
+            # that would unblock it never happens — a livelock.
+            self._infeasible_seen[
+                tuple(sorted(spec_res.to_wire().items()))
+            ] = time.monotonic()
             return {"retry": True, "draining": True}
         # Local grant path: queue until available.
         pending = PendingLease(spec_res, strategy, job_id, hops)
@@ -1190,8 +1237,17 @@ class NodeDaemon:
         }
 
     async def rpc_drain(self, conn_id: int, payload) -> dict:
-        """Graceful drain (reference: DrainRaylet node_manager.proto:510)."""
-        self._draining = True
+        """Graceful drain (reference: DrainRaylet node_manager.proto:510).
+        Routed through the control store so the cluster-wide record agrees —
+        a locally-set flag alone would be reverted by the next heartbeat's
+        authoritative state sync."""
+        try:
+            await self.control.call(
+                "drain_node", {"node_id": self.node_id.binary()}, timeout=10
+            )
+        except Exception as e:  # noqa: BLE001 — partitioned from the store
+            return {"ok": False, "error": str(e)}
+        self._sync_drain_state(pb.NODE_DRAINING)
         return {"ok": True}
 
 

@@ -166,6 +166,24 @@ class Autoscaler:
         for n in load["nodes"]:
             if n["idle"]:
                 self._idle_since.setdefault(n["node_id"], now)
+
+        # undrain before anything else: a DRAINING node rejects every lease,
+        # so a drain that never reaches termination (demand returned, or
+        # min_workers stops the removal) would strand capacity forever
+        # (reference: autoscaler v2 cancels drains for nodes it keeps)
+        allowed = max(0, len(self.workers) - self.config.min_workers)
+        drained = [nid for nid in self._draining if nid in by_id]
+        to_undrain = drained if demand > 0 else drained[allowed:]
+        for nid in to_undrain:
+            try:
+                cw.run_sync(cw.control.call(
+                    "undrain_node", {"node_id": bytes.fromhex(nid)}), 10)
+            except Exception:  # noqa: BLE001 — retry next poll
+                continue
+            self._draining.pop(nid, None)
+            self._idle_since.pop(nid, None)
+            logger.info("autoscaler undrained node %s", nid[:12])
+
         if len(self.workers) > self.config.min_workers and demand == 0:
             for w in list(self.workers):
                 nid = w["node_id"]
@@ -190,7 +208,8 @@ class Autoscaler:
                                     nid[:12])
                         if len(self.workers) <= self.config.min_workers:
                             break
-                elif now - since >= self.config.idle_timeout_s:
+                elif (now - since >= self.config.idle_timeout_s
+                      and len(self._draining) < allowed):
                     try:
                         cw.run_sync(cw.control.call(
                             "drain_node",

@@ -73,3 +73,126 @@ def test_max_workers_cap(ray_init):
         assert sum(ray_tpu.get(refs, timeout=120)) == 8
     finally:
         scaler.stop()
+
+
+def test_drained_node_undrains_when_demand_returns(ray_init):
+    """A node drained for idleness must return to service (not strand) when
+    demand reappears before termination (reference: autoscaler v2 cancels
+    drains for nodes it decides to keep). Driven by manual reconciles so the
+    drain→demand→undrain ordering is deterministic."""
+    provider = LocalNodeProvider(
+        ray_init["address"], ray_init["session_dir"])
+    scaler = Autoscaler(provider, AutoscalingConfig(
+        min_workers=0, max_workers=1,
+        worker_resources={"CPU": 2.0, "worker_only": 4.0},
+        idle_timeout_s=0.5, poll_period_s=0.3,
+    ))
+    try:
+        @ray_tpu.remote(resources={"worker_only": 1})
+        def on_worker():
+            return "ran"
+
+        ref = on_worker.remote()
+        deadline = time.time() + 60
+        done = False
+        while time.time() < deadline and not done:
+            scaler.reconcile_once()
+            try:
+                assert ray_tpu.get(ref, timeout=2) == "ran"
+                done = True
+            except ray_tpu.GetTimeoutError:
+                pass
+        assert done, "scale-up never satisfied the task"
+
+        # idle past the timeout → a reconcile drains (but cannot yet
+        # terminate — that needs a later confirmed-idle poll)
+        deadline = time.time() + 30
+        while time.time() < deadline and not scaler._draining:
+            scaler.reconcile_once()
+            time.sleep(0.4)
+        assert scaler._draining, "idle node was never drained"
+
+        # demand returns before termination: reconcile must undrain
+        held_node = scaler.workers[0]["node_id"]
+        ref2 = on_worker.remote()
+        time.sleep(2.5)  # pending/infeasible demand must reach a heartbeat
+        deadline = time.time() + 45
+        while time.time() < deadline and scaler._draining:
+            scaler.reconcile_once()
+            time.sleep(0.5)
+        assert not scaler._draining, "drained node was never returned to service"
+        done2 = False
+        deadline = time.time() + 60
+        while time.time() < deadline and not done2:
+            scaler.reconcile_once()
+            try:
+                assert ray_tpu.get(ref2, timeout=2) == "ran"
+                done2 = True
+            except ray_tpu.GetTimeoutError:
+                pass
+        assert done2
+        assert [w["node_id"] for w in scaler.workers] == [held_node], (
+            "the drained node should have been undrained, not replaced"
+        )
+    finally:
+        scaler.stop()
+
+
+def test_min_workers_node_is_never_drained(ray_init):
+    """Nodes the autoscaler may not terminate (min_workers floor) must not
+    be drained: a drained-but-kept node would reject leases forever."""
+    provider = LocalNodeProvider(
+        ray_init["address"], ray_init["session_dir"])
+    scaler = Autoscaler(provider, AutoscalingConfig(
+        min_workers=1, max_workers=1,
+        worker_resources={"CPU": 2.0, "worker_only": 4.0},
+        idle_timeout_s=0.3, poll_period_s=0.3,
+    ))
+    try:
+        @ray_tpu.remote(resources={"worker_only": 1})
+        def on_worker():
+            return "ran"
+
+        ref = on_worker.remote()
+        deadline = time.time() + 60
+        done = False
+        while time.time() < deadline and not done:
+            scaler.reconcile_once()
+            try:
+                assert ray_tpu.get(ref, timeout=2) == "ran"
+                done = True
+            except ray_tpu.GetTimeoutError:
+                pass
+        assert done
+        # idle well past the timeout: reconciles must neither drain nor
+        # terminate the floor node, and it must keep serving work
+        for _ in range(5):
+            scaler.reconcile_once()
+            time.sleep(0.3)
+        assert not scaler._draining
+        assert len(scaler.workers) == 1
+        assert ray_tpu.get(on_worker.remote(), timeout=60) == "ran"
+    finally:
+        scaler.stop()
+
+
+def test_infeasible_demand_triggers_scale_up(ray_init):
+    """A task whose shape no live node can host must still reach the
+    autoscaler as demand (reference: GcsAutoscalerStateManager aggregates
+    infeasible requests into cluster load)."""
+    provider = LocalNodeProvider(
+        ray_init["address"], ray_init["session_dir"])
+    scaler = Autoscaler(provider, AutoscalingConfig(
+        min_workers=0, max_workers=1,
+        worker_resources={"CPU": 4.0},
+        idle_timeout_s=60.0, poll_period_s=0.5,
+    )).start()
+    try:
+        @ray_tpu.remote(num_cpus=4)  # infeasible on the 2-CPU head
+        def wide():
+            return "wide"
+
+        assert ray_tpu.get(wide.remote(), timeout=90) == "wide"
+        assert len(scaler.workers) == 1
+    finally:
+        scaler.stop()

@@ -107,3 +107,27 @@ def test_reconstruction_budget_exhausted(cluster):
     cw.memory_store.objects.pop(ref.binary(), None)
     with pytest.raises((ray_tpu.ObjectLostError, ray_tpu.GetTimeoutError)):
         ray_tpu.get(ref, timeout=10)
+
+
+def test_at_most_once_task_not_reconstructed(cluster):
+    """max_retries=0 is an at-most-once contract: object loss must raise,
+    never silently re-run the task (reference: object_recovery_manager
+    reconstructs only retryable tasks)."""
+    nodes = [
+        cluster.add_node(resources={"CPU": 2, "prod": 1}),
+        cluster.add_node(resources={"CPU": 2, "prod": 1}),
+    ]
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote(resources={"prod": 0.5}, max_retries=0)
+    def produce_once():
+        return np.ones(150_000, dtype=np.float64)
+
+    ref = produce_once.remote()
+    ray_tpu.wait([ref], timeout=60)
+    holder_id = _node_holding(ref)
+    victims = [n for n in nodes if n.node_id == holder_id]
+    assert victims
+    cluster.kill_node(victims[0])
+    with pytest.raises((ray_tpu.ObjectLostError, ray_tpu.GetTimeoutError)):
+        ray_tpu.get(ref, timeout=15)
