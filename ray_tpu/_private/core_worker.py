@@ -395,7 +395,11 @@ class CoreWorker:
         self.node_id_hex = node_id_hex
         self.control_address = control_address
         self.daemon_address = daemon_address
-        self.store = ShmObjectStore(store_name)
+        # store_name=None → remote-client mode (reference: Ray Client,
+        # python/ray/util/client): a driver with no host shm store; object
+        # reads/writes ride daemon RPCs instead of mmap. Everything else
+        # (tasks, actors, ownership, PGs) is the normal driver path.
+        self.store = ShmObjectStore(store_name) if store_name else None
         self.store_name = store_name
         self.control = RpcClient(control_address, name=f"{mode}->cs")
         self.daemon = RpcClient(daemon_address, name=f"{mode}->daemon")
@@ -499,7 +503,8 @@ class CoreWorker:
         for st in self._actor_states.values():
             if st.client:
                 await st.client.close()
-        self.store.close()
+        if self.store is not None:
+            self.store.close()
 
     def schedule(self, coro) -> None:
         """Schedule a coroutine from any thread; pins the task (the loop keeps
@@ -573,6 +578,14 @@ class CoreWorker:
         ref = ObjectRef(oid, self.address, self.worker_id.binary())
         if sobj.total_bytes <= self._inline_max:
             self.memory_store.put(oid.binary(), sobj.to_bytes(), META_NORMAL)
+        elif self.store is None:
+            # remote-client mode: ship the bytes to the adopted daemon's
+            # store over RPC (chunked), then record it as the home location
+            await self._remote_put(oid, sobj)
+            self.memory_store.set_location(
+                oid.binary(),
+                {"daemon": self.daemon_address, "node_id": self.node_id_hex},
+            )
         else:
             view = await self._create_with_spill(oid, sobj.total_bytes)
             sobj.write_into(view)
@@ -583,6 +596,31 @@ class CoreWorker:
                 {"daemon": self.daemon_address, "node_id": self.node_id_hex, "local": True},
             )
         return ref
+
+    async def _remote_put(self, oid: ObjectID, sobj: "ser.SerializedObject"):
+        """Write a large object into the adopted daemon's store over RPC
+        (remote-client mode; reference: ray client server-side puts)."""
+        data = sobj.to_bytes()
+        reply = await self.daemon.call("create_object", {
+            "object_id": oid.binary(), "size": len(data), "meta": META_NORMAL,
+        }, timeout=60)
+        if not reply.get("ok"):
+            raise ObjectStoreFullError(reply.get("error", "create_object failed"))
+        if reply.get("exists"):
+            return
+        chunk = GLOBAL_CONFIG.get("object_chunk_bytes")
+        sem = asyncio.Semaphore(8)
+
+        async def write(off: int):
+            async with sem:
+                await self.daemon.call("write_chunk", {
+                    "object_id": oid.binary(), "offset": off,
+                    "data": data[off:off + chunk],
+                }, timeout=60)
+
+        await asyncio.gather(*[write(o) for o in range(0, len(data), chunk)])
+        await self.daemon.call("seal_object", {"object_id": oid.binary()},
+                               timeout=30)
 
     async def get_objects(self, refs: Sequence[ObjectRef],
                           timeout: Optional[float] = None) -> List[Any]:
@@ -684,6 +722,8 @@ class CoreWorker:
             raise GetTimeoutError(f"get() timed out waiting for {ref.hex()}") from None
 
     async def _read_store_object(self, ref: ObjectRef, location: dict, deadline) -> Any:
+        if self.store is None:
+            return await self._remote_read(ref, location, deadline)
         oid = ref.object_id()
         is_local = location.get("node_id") == self.node_id_hex
         pulled = False
@@ -742,6 +782,55 @@ class CoreWorker:
             view, copy_buffers=False,
             release=functools.partial(self.store.release, oid),
         )
+
+    async def _remote_read(self, ref: ObjectRef, location: dict, deadline) -> Any:
+        """Remote-client mode: materialize a store-resident object by asking
+        the adopted daemon to pull it locally, then fetching its bytes in
+        chunks over RPC (no shm mapping on this side)."""
+        oid = ref.object_id()
+
+        def remaining(default: float) -> float:
+            if deadline is None:
+                return default
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise GetTimeoutError(
+                    f"get() timed out materializing {ref.hex()} remotely"
+                )
+            return min(default, max(0.1, left))
+
+        reply = await self.daemon.call(
+            "pull_object",
+            {"object_id": oid.binary(), "from_address": location["daemon"]},
+            timeout=None if deadline is None else remaining(1e9),
+        )
+        if not reply.get("ok"):
+            raise ObjectLostError(ref.hex(), reply.get("error", "pull failed"))
+        info = await self.daemon.call(
+            "fetch_object_info", {"object_id": oid.binary()},
+            timeout=remaining(30),
+        )
+        if not info.get("found"):
+            raise ObjectLostError(ref.hex(), "object vanished after pull")
+        size, meta = info["size"], info["metadata"]
+        chunk = GLOBAL_CONFIG.get("object_chunk_bytes")
+        buf = bytearray(size)
+        sem = asyncio.Semaphore(8)
+
+        async def fetch(off: int):
+            async with sem:
+                r = await self.daemon.call("fetch_chunk", {
+                    "object_id": oid.binary(), "offset": off,
+                    "length": min(chunk, size - off),
+                }, timeout=remaining(60))
+                if not r.get("found"):
+                    raise ObjectLostError(ref.hex(), "object vanished mid-read")
+                buf[off:off + len(r["data"])] = r["data"]
+
+        await asyncio.gather(*[fetch(o) for o in range(0, size, chunk)])
+        if meta == META_ERROR:
+            raise self._deserialize_error(bytes(buf))
+        return ser.deserialize(bytes(buf), copy_buffers=True)
 
     def _materialize(self, data: bytes, meta: int, copy_buffers: bool) -> Any:
         if meta == META_ERROR:
@@ -836,7 +925,7 @@ class CoreWorker:
 
     async def _free_store_copy(self, oid: bytes, loc: dict):
         try:
-            if loc.get("node_id") == self.node_id_hex:
+            if loc.get("node_id") == self.node_id_hex and self.store is not None:
                 self.store.delete(ObjectID(oid))
             else:
                 client = await self._owner_client(loc["daemon"])
@@ -947,14 +1036,24 @@ class CoreWorker:
                 st.wake_all()
                 st.wake_consumers(force=True)
         if sub["state"] == "running" and sub["worker"]:
+            reply = {}
             try:
                 client = await self._worker_client(sub["worker"])
-                await client.call(
+                reply = await client.call(
                     "cancel_task", {"task_id": tid, "force": force}, timeout=10
                 )
             except Exception:  # noqa: BLE001 — worker already gone
                 pass
-            # the running push_task reply (an error for a cancelled task)
+            if not force and reply.get("ok") and reply.get("running"):
+                # The executor raises TaskCancelledError into the task's
+                # thread, but async-exc delivery waits for a Python bytecode
+                # boundary — a task blocked in C (time.sleep, IO) would pin
+                # the caller's get() arbitrarily long. Resolve the returns
+                # now; the eventual stale reply is dropped (reference:
+                # CancelTask acks fail the task at the owner promptly).
+                self._fail_task(spec, TaskCancelledError(
+                    f"task {spec.name or spec.function_key} was cancelled"))
+            # otherwise the push_task reply (an error for a cancelled task)
             # resolves the returns; force-kill resolves via the retry loop
             # seeing the cancelled flag
         elif spec.kind == pb.TASK_KIND_ACTOR_TASK:
@@ -1351,6 +1450,14 @@ class CoreWorker:
         self._record_task_reply(spec, reply)
 
     def _record_task_reply(self, spec: TaskSpec, reply: dict):
+        sub = self._submissions.get(spec.task_id.binary())
+        if (sub is not None and sub.get("cancelled") and all(
+                oid.binary() in self.memory_store.objects
+                for oid in spec.return_ids())):
+            # cancelled with returns already resolved to TaskCancelledError:
+            # drop the stale reply from the interrupted (or completed-late)
+            # execution instead of overwriting the cancellation
+            return
         if reply.get("error"):
             err = reply["error"]
             exc = TaskError(
@@ -1933,7 +2040,7 @@ class CoreWorker:
         if self.owns(ref):
             return await self._get_one(ref)
         # check local shm first (zero-copy fast path)
-        if self.store.contains(ref.object_id()):
+        if self.store is not None and self.store.contains(ref.object_id()):
             res = self.store.get(ref.object_id())
             if res is not None:
                 view, meta = res

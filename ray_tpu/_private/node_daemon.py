@@ -164,6 +164,10 @@ class NodeDaemon:
         # not roll the state back (reply snapshots are unordered vs pubsub)
         self._drain_sync_ts = 0.0
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        # in-progress remote-client puts: oid -> (writable view, last-touch
+        # ts). Swept by the reap loop — a client dying mid-put must not pin
+        # store capacity forever (unsealed entries are not evictable).
+        self._inbound_creates: Dict[bytes, Tuple[memoryview, float]] = {}
         # spilled objects: oid bytes -> (path, metadata, size). Reference:
         # raylet local_object_manager.h:45 spill/restore of primary copies.
         self.spilled: Dict[bytes, Tuple[str, int, int]] = {}
@@ -320,6 +324,7 @@ class NodeDaemon:
         """Poll worker processes for death; reap idle surplus."""
         while not self._stopped:
             await asyncio.sleep(0.1)
+            self._sweep_stale_inbound_creates()
             for w in list(self.workers.values()):
                 if w.state != W_DEAD and w.proc.poll() is not None:
                     await self._on_worker_death(w)
@@ -522,6 +527,14 @@ class NodeDaemon:
             task.add_done_callback(_settle)
         return await asyncio.shield(task)
 
+    def _note_infeasible(self, res: ResourceSet):
+        """Stamp a lease shape no live node can host (or a draining node
+        turned away) for the heartbeat demand signal; entries expire after
+        5s unless the retrying client refreshes them."""
+        self._infeasible_seen[
+            tuple(sorted(res.to_wire().items()))
+        ] = time.monotonic()
+
     async def _request_lease_inner(self, payload: dict) -> dict:
         spec_res = ResourceSet.from_wire(payload["resources"])
         strategy = pb.SchedulingStrategy.from_wire(payload.get("strategy"))
@@ -535,9 +548,7 @@ class NodeDaemon:
                 # the node dies and the control store reschedules the PG.
                 # Record the shape as demand — the autoscaler must see work
                 # a draining node turned away, or it can never undrain us.
-                self._infeasible_seen[
-                    tuple(sorted(spec_res.to_wire().items()))
-                ] = time.monotonic()
+                self._note_infeasible(spec_res)
                 return {"retry": True, "draining": True}
             return await self._grant_pg_lease(spec_res, strategy, job_id)
 
@@ -560,8 +571,7 @@ class NodeDaemon:
                 return {"infeasible": True,
                         "error": f"node {choice} not available for hard affinity"}
         if choice is None and not self._feasible_anywhere(spec_res):
-            key = tuple(sorted(spec_res.to_wire().items()))
-            self._infeasible_seen[key] = time.monotonic()
+            self._note_infeasible(spec_res)
             return {"infeasible": True}
         if self._draining:
             # Never grant locally while draining; the caller retries until the
@@ -570,9 +580,7 @@ class NodeDaemon:
             # still counts as demand: without it, work only this (draining)
             # node can host is invisible to the autoscaler and the undrain
             # that would unblock it never happens — a livelock.
-            self._infeasible_seen[
-                tuple(sorted(spec_res.to_wire().items()))
-            ] = time.monotonic()
+            self._note_infeasible(spec_res)
             return {"retry": True, "draining": True}
         # Local grant path: queue until available.
         pending = PendingLease(spec_res, strategy, job_id, hops)
@@ -1140,6 +1148,64 @@ class NodeDaemon:
         finally:
             view.release()
             self.store.release(oid)
+
+    # -- remote-client puts (reference: ray client server-side object puts;
+    # a storeless driver ships bytes here instead of mmapping shm) --------
+
+    async def rpc_create_object(self, conn_id: int, payload: dict) -> dict:
+        oid = ObjectID(payload["object_id"])
+        if self.store.contains(oid) or oid.binary() in self.spilled:
+            return {"ok": True, "exists": True}
+        if oid.binary() in self._inbound_creates:
+            return {"ok": True, "exists": False}
+        try:
+            view = await self._create_making_room(
+                oid, payload["size"], payload.get("meta", 0))
+        except FileExistsError:
+            return {"ok": True, "exists": True}
+        except ObjectStoreFullError as e:
+            return {"ok": False, "error": str(e)}
+        self._inbound_creates[oid.binary()] = (view, time.monotonic())
+        return {"ok": True, "exists": False}
+
+    async def rpc_write_chunk(self, conn_id: int, payload: dict) -> dict:
+        entry = self._inbound_creates.get(payload["object_id"])
+        if entry is None:
+            return {"ok": False, "error": "no in-progress create for object"}
+        view, _ = entry
+        off = payload["offset"]
+        view[off:off + len(payload["data"])] = payload["data"]
+        self._inbound_creates[payload["object_id"]] = (view, time.monotonic())
+        return {"ok": True}
+
+    async def rpc_seal_object(self, conn_id: int, payload: dict) -> dict:
+        entry = self._inbound_creates.pop(payload["object_id"], None)
+        if entry is None:
+            return {"ok": False, "error": "no in-progress create for object"}
+        view, _ = entry
+        view.release()
+        self.store.seal(ObjectID(payload["object_id"]))
+        return {"ok": True}
+
+    def _sweep_stale_inbound_creates(self, max_age_s: float = 60.0):
+        """Abort remote-client puts abandoned mid-transfer: release the
+        creator pin and delete the unsealed allocation (unsealed entries are
+        invisible to eviction/spill, so a leak here is permanent)."""
+        if not self._inbound_creates:
+            return
+        now = time.monotonic()
+        for ob, (view, ts) in list(self._inbound_creates.items()):
+            if now - ts <= max_age_s:
+                continue
+            self._inbound_creates.pop(ob, None)
+            view.release()
+            try:
+                self.store.release(ObjectID(ob))
+                self.store.delete(ObjectID(ob))
+            except Exception:  # noqa: BLE001
+                pass
+            logger.warning("aborted stale inbound create %s",
+                           ObjectID(ob).hex()[:12])
 
     async def rpc_pull_object(self, conn_id: int, payload: dict) -> dict:
         """Pull an object from a remote node into the local store."""

@@ -80,7 +80,12 @@ def init(
 ) -> Dict[str, Any]:
     """Start a new local cluster (head) or connect to an existing one.
 
-    Reference: ray.init python/ray/_private/worker.py:1438.
+    Reference: ray.init python/ray/_private/worker.py:1438. An
+    ``rt://host:port`` address connects as a REMOTE client (reference: Ray
+    Client, python/ray/util/client): a driver with no host shm store whose
+    object reads/writes ride daemon RPCs — same API, works from a machine
+    that is not a cluster node (requires bidirectional routability: cluster
+    workers resolve borrowed args by calling back to this driver).
     """
     if _context.initialized:
         if ignore_reinit_error:
@@ -88,6 +93,10 @@ def init(
         raise RayTpuError("ray_tpu.init() already called (pass ignore_reinit_error=True)")
     if system_config:
         GLOBAL_CONFIG.apply_system_config(system_config)
+
+    client_mode = address is not None and address.startswith("rt://")
+    if client_mode:
+        address = address[len("rt://"):]
 
     if address is None:
         # head mode: spawn control store + a node daemon
@@ -147,6 +156,8 @@ def init(
         daemon_address = info["daemon"]
         node_id_hex = info["node_id"]
         store_name = info["store"]
+    if client_mode:
+        store_name = None  # storeless: never mmap a (possibly remote) shm
 
     cw = CoreWorker(
         mode=MODE_DRIVER,

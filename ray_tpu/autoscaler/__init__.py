@@ -126,6 +126,30 @@ class Autoscaler:
             current = current - r
         return needed
 
+    def _gate_demand(self, load: dict) -> int:
+        """Demand that should block scale-down/undrain: pending shapes some
+        node type (worker bin or an existing ALIVE node) could ever host.
+        Permanently-infeasible shapes are excluded — work nothing can run
+        must not hold idle nodes alive forever."""
+        from ray_tpu._private.protocol import ResourceSet
+
+        shapes = [ResourceSet.from_wire(w) for w in load["pending_resources"]]
+        if not shapes:
+            return load["pending_total"]
+        bin_cap = ResourceSet(self.config.worker_resources)
+        totals = [
+            ResourceSet.from_wire(n["total"])
+            for n in load["nodes"] if n.get("state") == "ALIVE"
+        ]
+        hostable = sum(
+            1 for r in shapes
+            if r.is_subset_of(bin_cap)
+            or any(r.is_subset_of(t) for t in totals)
+        )
+        # shapes are capped in heartbeats; assume the uncounted tail is
+        # hostable (err toward keeping capacity)
+        return hostable + max(0, load["pending_total"] - len(shapes))
+
     def reconcile_once(self) -> Dict[str, int]:
         from ray_tpu._private.core_worker import get_core_worker
 
@@ -140,8 +164,10 @@ class Autoscaler:
             if w["proc"].poll() is None or w["node_id"] in alive_ids
         ]
 
-        # scale up: only for demand existing+starting capacity can't absorb
-        demand = load["pending_total"]
+        # scale up: only for demand existing+starting capacity can't absorb.
+        # Gating (undrain / scale-down) uses hostable demand only, so a
+        # permanently-infeasible task can't pin idle nodes forever.
+        demand = self._gate_demand(load)
         need = self._unmet_worker_need(load)
         to_add = min(need, self.config.max_workers - len(self.workers))
         for _ in range(max(0, to_add)):
