@@ -136,6 +136,7 @@ _flag("control_store_persist", False, "Persist control-store state (nodes/actors
 _flag("control_store_wal_compact_every", 512, "WAL records between snapshot compactions.")
 _flag("lineage_cache_max_tasks", 4096, "Completed task specs kept per owner for lineage reconstruction of lost shm objects (reference: task_manager lineage pinning).")
 _flag("max_lineage_reconstructions", 3, "Times one lost object may be recomputed from lineage before get() raises ObjectLostError (reference: object_recovery_manager.h retry cap).")
+_flag("device_object_transport", True, "Keep jax.Arrays HBM-resident through the object plane: same-process consumers get the original device array back (no h2d), others rebuild from host-staged bytes (reference: python/ray/experimental/rdt).")
 
 # --- chaos / fault injection (day 1, per SURVEY §4) ---
 _flag("testing_event_loop_delay_us", "", "Inject delays into event-loop handlers. Format: 'method:min_us:max_us,...' ('*' matches all). Mirrors RAY_testing_asio_delay_us.")

@@ -613,14 +613,23 @@ class CoreWorker:
 
         async def write(off: int):
             async with sem:
-                await self.daemon.call("write_chunk", {
+                r = await self.daemon.call("write_chunk", {
                     "object_id": oid.binary(), "offset": off,
                     "data": data[off:off + chunk],
                 }, timeout=60)
+                if not r.get("ok"):
+                    raise RayTpuError(
+                        f"remote put failed mid-transfer: {r.get('error')}"
+                    )
 
         await asyncio.gather(*[write(o) for o in range(0, len(data), chunk)])
-        await self.daemon.call("seal_object", {"object_id": oid.binary()},
-                               timeout=30)
+        r = await self.daemon.call("seal_object", {"object_id": oid.binary()},
+                                   timeout=30)
+        if not r.get("ok"):
+            # e.g. the daemon swept this create as stale mid-stall: the
+            # object does not exist; failing the put here beats handing out
+            # a ref that can never resolve
+            raise RayTpuError(f"remote put failed to seal: {r.get('error')}")
 
     async def get_objects(self, refs: Sequence[ObjectRef],
                           timeout: Optional[float] = None) -> List[Any]:
@@ -813,21 +822,16 @@ class CoreWorker:
         if not info.get("found"):
             raise ObjectLostError(ref.hex(), "object vanished after pull")
         size, meta = info["size"], info["metadata"]
-        chunk = GLOBAL_CONFIG.get("object_chunk_bytes")
         buf = bytearray(size)
-        sem = asyncio.Semaphore(8)
+        from ray_tpu.runtime.transfer import fetch_chunks
 
-        async def fetch(off: int):
-            async with sem:
-                r = await self.daemon.call("fetch_chunk", {
-                    "object_id": oid.binary(), "offset": off,
-                    "length": min(chunk, size - off),
-                }, timeout=remaining(60))
-                if not r.get("found"):
-                    raise ObjectLostError(ref.hex(), "object vanished mid-read")
-                buf[off:off + len(r["data"])] = r["data"]
-
-        await asyncio.gather(*[fetch(o) for o in range(0, size, chunk)])
+        await fetch_chunks(
+            self.daemon.call, oid.binary(), size, buf,
+            chunk_bytes=GLOBAL_CONFIG.get("object_chunk_bytes"),
+            timeout_for=remaining,
+            missing_error=lambda: ObjectLostError(
+                ref.hex(), "object vanished mid-read"),
+        )
         if meta == META_ERROR:
             raise self._deserialize_error(bytes(buf))
         return ser.deserialize(bytes(buf), copy_buffers=True)

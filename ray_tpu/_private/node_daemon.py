@@ -1246,29 +1246,23 @@ class NodeDaemon:
             await asyncio.sleep(delay)
             delay = min(delay * 2, max_delay)
         size, meta = info["size"], info["metadata"]
-        chunk = GLOBAL_CONFIG.get("object_chunk_bytes")
         try:
             view = await self._create_making_room(oid, size, meta)
         except FileExistsError:
             return
         # Parallel chunk fetch (reference: push_manager chunking).
-        offsets = list(range(0, size, chunk))
-        sem = asyncio.Semaphore(8)
-
-        async def fetch(off: int):
-            async with sem:
-                r = await client.call("fetch_chunk", {
-                    "object_id": oid.binary(), "offset": off,
-                    "length": min(chunk, size - off),
-                })
-                if not r.get("found"):
-                    raise RuntimeError("object vanished mid-pull")
-                view[off : off + len(r["data"])] = r["data"]
+        from ray_tpu.runtime.transfer import fetch_chunks
 
         try:
-            await asyncio.gather(*[fetch(o) for o in offsets])
+            await fetch_chunks(
+                client.call, oid.binary(), size, view,
+                chunk_bytes=GLOBAL_CONFIG.get("object_chunk_bytes"),
+            )
         except Exception:
             view.release()
+            # the creator ref is only dropped by seal; release it first or
+            # delete refuses (pinned) and the unsealed allocation leaks
+            self.store.release(oid)
             self.store.delete(oid)
             raise
         view.release()

@@ -16,12 +16,42 @@ ownership-based ref counting design (reference: src/ray/core_worker/reference_co
 from __future__ import annotations
 
 import contextvars
+import io
 import pickle
 import struct
 from typing import Any, List
 
 _HEADER = struct.Struct("<IQ")
 _LEN = struct.Struct("<Q")
+
+
+class _Pickler(pickle.Pickler):
+    """Protocol-5 pickler with the device-tensor transport hook (reference:
+    python/ray/experimental/rdt — tensors move out-of-band; see
+    ray_tpu/experimental/rdt.py)."""
+
+    def reducer_override(self, obj):
+        from ray_tpu.experimental.rdt import maybe_reduce_device_array
+
+        return maybe_reduce_device_array(obj)
+
+
+def _make_cloud_pickler_cls():
+    import cloudpickle
+
+    class _CloudPickler(cloudpickle.Pickler):
+        def reducer_override(self, obj):
+            from ray_tpu.experimental.rdt import maybe_reduce_device_array
+
+            r = maybe_reduce_device_array(obj)
+            if r is not NotImplemented:
+                return r
+            return super().reducer_override(obj)
+
+    return _CloudPickler
+
+
+_cloud_pickler_cls = None
 
 
 class SerializedObject:
@@ -82,20 +112,25 @@ def serialize(value: Any) -> SerializedObject:
     token = _CONTAINED_REFS.set([])
     try:
         try:
-            inband = pickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+            f = io.BytesIO()
+            _Pickler(f, protocol=5, buffer_callback=buffer_callback).dump(value)
+            inband = f.getvalue()
         except (pickle.PicklingError, AttributeError, TypeError):
             # lambdas / closures / local classes (e.g. Dataset UDFs riding as
             # task args): cloudpickle, same protocol-5 out-of-band buffers
             # (reference: ray cloudpickles all task arguments)
-            import cloudpickle
-
+            global _cloud_pickler_cls
+            if _cloud_pickler_cls is None:
+                _cloud_pickler_cls = _make_cloud_pickler_cls()
             buffers.clear()
             refs = _CONTAINED_REFS.get()
             if refs:
                 refs.clear()  # re-collected by the retry
-            inband = cloudpickle.dumps(
-                value, protocol=5, buffer_callback=buffer_callback
-            )
+            f = io.BytesIO()
+            _cloud_pickler_cls(
+                f, protocol=5, buffer_callback=buffer_callback
+            ).dump(value)
+            inband = f.getvalue()
         contained = _CONTAINED_REFS.get()
     finally:
         _CONTAINED_REFS.reset(token)

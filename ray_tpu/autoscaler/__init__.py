@@ -137,9 +137,13 @@ class Autoscaler:
         if not shapes:
             return load["pending_total"]
         bin_cap = ResourceSet(self.config.worker_resources)
+        # DRAINING nodes count as capacity here: demand only they can host
+        # must keep gating scale-down so the undrain path can rescue them —
+        # excluding them would terminate the one node able to run the work
         totals = [
             ResourceSet.from_wire(n["total"])
-            for n in load["nodes"] if n.get("state") == "ALIVE"
+            for n in load["nodes"]
+            if n.get("state") in ("ALIVE", "DRAINING")
         ]
         hostable = sum(
             1 for r in shapes

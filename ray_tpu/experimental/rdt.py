@@ -1,0 +1,116 @@
+"""Device-direct object transport for jax.Arrays (TPU RDT).
+
+Reference surface: python/ray/experimental/rdt/ (rdt_manager.py, the NIXL /
+CUDA-IPC tensor transports) — GPU tensors move out-of-band while the object
+store holds metadata. The TPU-native shape of that idea: a device array put
+into the object plane keeps living in HBM in its producer process; the store
+carries a host-staged copy plus a transport id. A consumer in the SAME
+process gets the original on-device array back untouched (no h2d upload, no
+d2h round trip, `is`-identical while the producer's array is alive); a
+consumer elsewhere rebuilds from the host bytes with `jax.device_put`.
+
+Unlike NIXL/CUDA-IPC there is no cross-process device-to-device path on TPU
+outside a mesh program: inter-chip movement belongs to XLA collectives
+(ppermute/all_gather inside jit), so the out-of-band transport here is
+process-local HBM reuse + host staging, which is what the hardware offers.
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+_STRONG_CAP = 256
+
+
+class DeviceObjectManager:
+    """Process-local registry of live device arrays keyed by transport id.
+
+    Weak references wherever the array type allows them (the registry must
+    not pin HBM the producer has dropped); a bounded strong-ref LRU
+    otherwise."""
+
+    def __init__(self, strong_cap: int = _STRONG_CAP):
+        self._weak: Dict[bytes, weakref.ref] = {}
+        self._strong: "OrderedDict[bytes, Any]" = OrderedDict()
+        self._strong_cap = strong_cap
+
+    def register(self, arr: Any) -> bytes:
+        tid = os.urandom(16)
+        try:
+            self._weak[tid] = weakref.ref(
+                arr, lambda _r, t=tid: self._weak.pop(t, None)
+            )
+        except TypeError:
+            self._strong[tid] = arr
+            while len(self._strong) > self._strong_cap:
+                self._strong.popitem(last=False)
+        return tid
+
+    def lookup(self, tid: bytes) -> Optional[Any]:
+        r = self._weak.get(tid)
+        if r is not None:
+            return r()
+        return self._strong.get(tid)
+
+    def __len__(self) -> int:
+        return len(self._weak) + len(self._strong)
+
+
+_manager: Optional[DeviceObjectManager] = None
+
+
+def device_object_manager() -> DeviceObjectManager:
+    global _manager
+    if _manager is None:
+        _manager = DeviceObjectManager()
+    return _manager
+
+
+def _rebuild_device_array(tid: bytes, host: Any) -> Any:
+    """Unpickle hook: same-process → the original HBM-resident array;
+    elsewhere → upload the host staging copy."""
+    arr = device_object_manager().lookup(tid)
+    if arr is not None:
+        return arr
+    import jax
+
+    return jax.device_put(host)
+
+
+def maybe_reduce_device_array(obj: Any):
+    """Custom-reduce hook used by the serializer: device arrays become
+    (transport id, host bytes) with the live array registered out-of-band.
+    Returns NotImplemented for everything that is not a concrete, fully
+    addressable jax.Array."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return NotImplemented  # no jax imported → can't be a jax.Array
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if not GLOBAL_CONFIG.get("device_object_transport"):
+        return NotImplemented
+    import jax
+
+    if not isinstance(obj, jax.Array):
+        return NotImplemented
+    try:
+        import numpy as np
+
+        if not obj.is_fully_addressable:
+            return NotImplemented  # multi-host array: owner can't stage it
+        host = np.asarray(obj)  # one d2h copy for the store's staging bytes
+    except Exception:  # noqa: BLE001 — tracers, deleted buffers, etc.
+        return NotImplemented
+    tid = device_object_manager().register(obj)
+    return (_rebuild_device_array, (tid, host))
+
+
+__all__ = [
+    "DeviceObjectManager",
+    "device_object_manager",
+    "maybe_reduce_device_array",
+]

@@ -1,0 +1,83 @@
+"""Device-direct object transport (reference: python/ray/experimental/rdt/):
+jax.Arrays stay HBM-resident through the object plane — same-process reads
+return the original device array; cross-process reads rebuild on device."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.experimental.rdt import device_object_manager
+
+
+def test_same_process_roundtrip_is_zero_copy():
+    x = jnp.arange(1024.0) * 2.0
+    blob = ser.serialize(x).to_bytes()
+    y = ser.deserialize(blob, copy_buffers=True)
+    assert y is x  # the original HBM-resident array, not a reupload
+
+
+def test_cross_process_rebuild_matches(tmp_path):
+    # simulate "another process": drop the producer's array so the manager
+    # weakref dies, forcing the host-staging rebuild path
+    x = jnp.linspace(0.0, 1.0, 333)
+    expect = np.asarray(x)
+    blob = ser.serialize(x).to_bytes()
+    del x
+    import gc
+
+    gc.collect()
+    y = ser.deserialize(blob, copy_buffers=True)
+    assert isinstance(y, jax.Array)
+    np.testing.assert_allclose(np.asarray(y), expect)
+
+
+def test_pytree_with_device_arrays():
+    tree = {"w": jnp.ones((4, 4)), "meta": "adam", "step": 7}
+    blob = ser.serialize(tree).to_bytes()
+    out = ser.deserialize(blob, copy_buffers=True)
+    assert out["meta"] == "adam" and out["step"] == 7
+    assert out["w"] is tree["w"]
+
+
+def test_disabled_flag_falls_back():
+    GLOBAL_CONFIG.apply_system_config({"device_object_transport": False})
+    try:
+        x = jnp.arange(16.0)
+        n_before = len(device_object_manager())
+        blob = ser.serialize(x).to_bytes()
+        assert len(device_object_manager()) == n_before  # nothing registered
+        y = ser.deserialize(blob, copy_buffers=True)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    finally:
+        GLOBAL_CONFIG.apply_system_config({"device_object_transport": True})
+
+
+def test_through_object_plane_tasks():
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def make():
+            return jnp.full((64, 64), 3.0)
+
+        @ray_tpu.remote
+        def consume(a):
+            # executes in a different process: the host-staged rebuild path
+            assert isinstance(a, jax.Array)
+            return float(a.sum())
+
+        ref = make.remote()
+        assert ray_tpu.get(consume.remote(ref), timeout=60) == 3.0 * 64 * 64
+        val = ray_tpu.get(ref, timeout=60)
+        assert isinstance(val, jax.Array)
+        assert float(val[0, 0]) == 3.0
+
+        # driver put → driver get: identity (the manager kept it alive)
+        local = jnp.arange(100_000, dtype=jnp.float32)  # > inline max
+        r2 = ray_tpu.put(local)
+        assert ray_tpu.get(r2, timeout=60) is local
+    finally:
+        ray_tpu.shutdown()
