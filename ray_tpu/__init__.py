@@ -68,6 +68,14 @@ def remote(*args, **kwargs):
     return wrapper
 
 
+def method(**opts):
+    """Per-actor-method options (reference: ray.method) — e.g.
+    ``@ray_tpu.method(concurrency_group="io", num_returns=2)``."""
+    from ray_tpu.actor import method as _method
+
+    return _method(**opts)
+
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -77,6 +85,7 @@ __all__ = [
     "shutdown",
     "is_initialized",
     "remote",
+    "method",
     "get",
     "put",
     "wait",

@@ -1264,7 +1264,8 @@ class CoreWorker:
                                  args: tuple, kwargs: dict,
                                  num_returns: int = 1,
                                  max_task_retries: int = 0,
-                                 stream_backpressure: int = -1):
+                                 stream_backpressure: int = -1,
+                                 concurrency_group: str = ""):
         """Loop-thread-safe actor submission: the sequence number is taken
         synchronously (ordering is decided here), arg serialization and
         delivery continue in a spawned task."""
@@ -1286,6 +1287,7 @@ class CoreWorker:
             incarnation=st.incarnation,
             name=method_name,
             stream_backpressure=stream_backpressure,
+            concurrency_group=concurrency_group,
         )
         refs = [
             ObjectRef(oid, self.address, self.worker_id.binary())
@@ -1776,6 +1778,7 @@ class CoreWorker:
         namespace: str = "",
         detached: bool = False,
         runtime_env: Optional[dict] = None,
+        concurrency_groups: Optional[Dict[str, int]] = None,
     ) -> ActorID:
         with self._lock:
             self._actor_index += 1
@@ -1786,6 +1789,7 @@ class CoreWorker:
             max_task_retries=max_task_retries, max_concurrency=max_concurrency,
             is_async=is_async, strategy=strategy, name=name,
             namespace=namespace, detached=detached, runtime_env=runtime_env,
+            concurrency_groups=concurrency_groups,
         )
         return actor_id
 
@@ -1830,6 +1834,7 @@ class CoreWorker:
         namespace: str = "",
         detached: bool = False,
         runtime_env: Optional[dict] = None,
+        concurrency_groups: Optional[Dict[str, int]] = None,
     ) -> None:
         from ray_tpu._private.runtime_env_mgr import prepare_runtime_env
 
@@ -1851,6 +1856,7 @@ class CoreWorker:
             max_task_retries=max_task_retries,
             max_concurrency=max_concurrency,
             is_async_actor=is_async,
+            concurrency_groups=dict(concurrency_groups or {}),
             runtime_env={**(runtime_env or {}), "namespace": namespace,
                          "detached": detached},
             name=name,
@@ -1889,6 +1895,7 @@ class CoreWorker:
         num_returns: int = 1,
         max_task_retries: int = 0,
         stream_backpressure: int = -1,
+        concurrency_group: str = "",
     ):
         st = self._actor_state(actor_id)
         # serialize BEFORE taking the sequence number: a failed serialization
@@ -1912,6 +1919,7 @@ class CoreWorker:
             incarnation=st.incarnation,
             name=method_name,
             stream_backpressure=stream_backpressure,
+            concurrency_group=concurrency_group,
         )
         refs = [
             ObjectRef(oid, self.address, self.worker_id.binary())

@@ -193,6 +193,11 @@ class TaskSpec:
     max_task_retries: int = 0
     max_concurrency: int = 1
     is_async_actor: bool = False
+    # named concurrency groups (reference: core_worker/task_execution/
+    # concurrency_group_manager.h): creation spec carries {group: max},
+    # each actor task carries the group its method is assigned to
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
+    concurrency_group: str = ""
     runtime_env: dict = field(default_factory=dict)
     name: str = ""
     # streaming generators: num_returns == NUM_RETURNS_STREAMING; executor
@@ -238,6 +243,8 @@ class TaskSpec:
             "max_task_retries": self.max_task_retries,
             "max_concurrency": self.max_concurrency,
             "is_async_actor": self.is_async_actor,
+            "concurrency_groups": self.concurrency_groups,
+            "concurrency_group": self.concurrency_group,
             "runtime_env": self.runtime_env,
             "name": self.name,
             "stream_backpressure": self.stream_backpressure,
@@ -267,6 +274,8 @@ class TaskSpec:
             max_task_retries=w.get("max_task_retries", 0),
             max_concurrency=w.get("max_concurrency", 1),
             is_async_actor=w.get("is_async_actor", False),
+            concurrency_groups=w.get("concurrency_groups") or {},
+            concurrency_group=w.get("concurrency_group", ""),
             runtime_env=w.get("runtime_env") or {},
             name=w.get("name", ""),
             stream_backpressure=w.get("stream_backpressure", -1),

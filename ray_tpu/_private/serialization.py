@@ -109,12 +109,28 @@ def serialize(value: Any) -> SerializedObject:
         buffers.append(buf.raw())
         return False  # do not also serialize in-band
 
+    # The device-tensor hook costs a Python callback per pickled object;
+    # keep the pure-C pickle.dumps fast path when no jax.Array can exist
+    # (jax never imported) or the transport is off.
+    import sys
+
+    use_hook = "jax" in sys.modules
+    if use_hook:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        use_hook = GLOBAL_CONFIG.get("device_object_transport")
+
     token = _CONTAINED_REFS.set([])
     try:
         try:
-            f = io.BytesIO()
-            _Pickler(f, protocol=5, buffer_callback=buffer_callback).dump(value)
-            inband = f.getvalue()
+            if use_hook:
+                f = io.BytesIO()
+                _Pickler(f, protocol=5, buffer_callback=buffer_callback).dump(value)
+                inband = f.getvalue()
+            else:
+                inband = pickle.dumps(
+                    value, protocol=5, buffer_callback=buffer_callback
+                )
         except (pickle.PicklingError, AttributeError, TypeError):
             # lambdas / closures / local classes (e.g. Dataset UDFs riding as
             # task args): cloudpickle, same protocol-5 out-of-band buffers

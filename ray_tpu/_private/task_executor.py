@@ -58,6 +58,9 @@ class TaskExecutor:
         self.actor_instance: Any = None
         self.actor_spec = None
         self._actor_sem: Optional[asyncio.Semaphore] = None
+        # named concurrency groups (reference: concurrency_group_manager.h)
+        self._group_pools: Dict[str, ThreadPoolExecutor] = {}
+        self._group_sems: Dict[str, asyncio.Semaphore] = {}
         # per-caller ordering for sync actors (keyed by caller; ordering holds
         # within the newest incarnation the caller has shown us)
         self._expected_seq: Dict[bytes, int] = {}
@@ -228,8 +231,10 @@ class TaskExecutor:
         except BaseException as e:  # noqa: BLE001 — all errors cross the wire
             return self._error_reply(spec, e)
 
-    async def _invoke(self, tid: bytes, fn, args, kwargs) -> Any:
-        """Call the user function with cancellation hooks installed."""
+    async def _invoke(self, tid: bytes, fn, args, kwargs, pool=None) -> Any:
+        """Call the user function with cancellation hooks installed; sync
+        functions run on `pool` (a concurrency group's lane) or the default
+        actor thread pool."""
         if inspect.iscoroutinefunction(fn):
             atask = asyncio.ensure_future(fn(*args, **kwargs))
             self._running_atasks[tid] = atask
@@ -242,7 +247,8 @@ class TaskExecutor:
             finally:
                 self._running_atasks.pop(tid, None)
         return await asyncio.get_running_loop().run_in_executor(
-            self.thread_pool, lambda: self._call_traced(tid, fn, *args, **kwargs)
+            pool if pool is not None else self.thread_pool,
+            lambda: self._call_traced(tid, fn, *args, **kwargs),
         )
 
     async def _execute_actor_creation(self, spec: pb.TaskSpec) -> dict:
@@ -260,6 +266,17 @@ class TaskExecutor:
                 )
             if spec.is_async_actor:
                 self._actor_sem = asyncio.Semaphore(max(1, spec.max_concurrency))
+            # named concurrency groups (reference: concurrency_group_manager.h):
+            # each group gets its own executor lane so one group saturating
+            # (or blocking) never starves another
+            for gname, gmax in (spec.concurrency_groups or {}).items():
+                if spec.is_async_actor:
+                    self._group_sems[gname] = asyncio.Semaphore(max(1, gmax))
+                else:
+                    self._group_pools[gname] = ThreadPoolExecutor(
+                        max_workers=max(1, gmax),
+                        thread_name_prefix=f"actor-cg-{gname}",
+                    )
             self.actor_instance = await asyncio.get_running_loop().run_in_executor(
                 self.thread_pool, lambda: cls(*args, **kwargs)
             )
@@ -270,8 +287,9 @@ class TaskExecutor:
     async def _execute_actor_task(self, spec: pb.TaskSpec) -> dict:
         caller = spec.owner_worker_id
         is_async = self.actor_spec is not None and self.actor_spec.is_async_actor
-        threaded = (
-            self.actor_spec is not None and self.actor_spec.max_concurrency > 1
+        threaded = self.actor_spec is not None and (
+            self.actor_spec.max_concurrency > 1
+            or bool(self.actor_spec.concurrency_groups)
         )
         if not is_async and not threaded:
             try:
@@ -369,14 +387,27 @@ class TaskExecutor:
             method = getattr(self.actor_instance, spec.method_name)
             args, kwargs = await self._resolve_args(spec.args)
             self.cw.current_task_id = spec.task_id
+            group = spec.concurrency_group
+            declared = (self.actor_spec.concurrency_groups or {}
+                        if self.actor_spec else {})
+            if group and group not in declared:
+                raise ValueError(
+                    f"method {spec.method_name!r} submitted with undeclared "
+                    f"concurrency group {group!r} (declared: "
+                    f"{sorted(declared) or 'none'})"
+                )
             if is_async:
-                async with self._actor_sem:
+                sem = self._group_sems.get(group, self._actor_sem)
+                async with sem:
                     if inspect.iscoroutinefunction(method):
                         result = await self._invoke(tid, method, args, kwargs)
                     else:
                         result = method(*args, **kwargs)
             else:
-                result = await self._invoke(tid, method, args, kwargs)
+                result = await self._invoke(
+                    tid, method, args, kwargs,
+                    pool=self._group_pools.get(group),
+                )
             if spec.is_streaming:
                 return await self._stream_out(spec, result)
             return await self._returns_reply(spec, result)

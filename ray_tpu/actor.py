@@ -21,25 +21,50 @@ _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
     "max_concurrency", "name", "namespace", "lifetime", "scheduling_strategy",
     "label_selector", "placement_group", "placement_group_bundle_index",
-    "runtime_env",
+    "runtime_env", "concurrency_groups",
 }
+
+_VALID_METHOD_OPTIONS = {"num_returns", "concurrency_group"}
+
+
+def method(**opts):
+    """Per-method options decorator (reference: ray.method, actor.py:848) —
+    ``@ray_tpu.method(concurrency_group="io", num_returns=2)``."""
+    for k in opts:
+        if k not in _VALID_METHOD_OPTIONS:
+            raise ValueError(f"invalid @method option {k!r}")
+
+    def decorate(fn):
+        fn.__rt_method_opts__ = dict(opts)
+        return fn
+
+    return decorate
 
 
 class ActorMethod:
-    __slots__ = ("_handle", "_method_name", "_num_returns")
+    __slots__ = ("_handle", "_method_name", "_num_returns", "_concurrency_group")
 
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1, concurrency_group: str = ""):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         return self._handle._submit(
-            self._method_name, args, kwargs, num_returns=self._num_returns
+            self._method_name, args, kwargs, num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group,
         )
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: Optional[int] = None,
+                concurrency_group: Optional[str] = None) -> "ActorMethod":
+        return ActorMethod(
+            self._handle, self._method_name,
+            self._num_returns if num_returns is None else num_returns,
+            self._concurrency_group if concurrency_group is None
+            else concurrency_group,
+        )
 
     def bind(self, *args, **kwargs):
         """Build a DAG node instead of executing (reference: actor.py bind —
@@ -72,7 +97,8 @@ class ActorHandle:
             except Exception:  # noqa: BLE001 — interpreter shutdown
                 pass
 
-    def _submit(self, method_name: str, args, kwargs, num_returns: int = 1):
+    def _submit(self, method_name: str, args, kwargs, num_returns: int = 1,
+                concurrency_group: str = ""):
         from ray_tpu._private.protocol import NUM_RETURNS_STREAMING
 
         cw = get_core_worker()
@@ -85,6 +111,7 @@ class ActorHandle:
                 self._actor_id.binary(), method_name, args, kwargs,
                 num_returns=wire_returns,
                 max_task_retries=self._max_task_retries,
+                concurrency_group=concurrency_group,
             )
         else:
             result = cw.run_sync(
@@ -92,6 +119,7 @@ class ActorHandle:
                     self._actor_id.binary(), method_name, args, kwargs,
                     num_returns=wire_returns,
                     max_task_retries=self._max_task_retries,
+                    concurrency_group=concurrency_group,
                 )
             )
         if streaming:
@@ -101,7 +129,14 @@ class ActorHandle:
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name, self._method_meta.get(name, 1))
+        meta = self._method_meta.get(name)
+        if isinstance(meta, int):  # legacy form: bare num_returns
+            meta = {"num_returns": meta}
+        meta = meta or {}
+        return ActorMethod(
+            self, name, meta.get("num_returns", 1),
+            meta.get("concurrency_group", ""),
+        )
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()[:16]})"
@@ -145,10 +180,31 @@ class ActorClass:
         clone._class_key = self._class_key
         return clone
 
+    def _method_meta(self) -> Dict[str, dict]:
+        """Collect @ray_tpu.method options declared on the class, walking
+        the MRO so base-class declarations apply to subclass actors
+        (subclass overrides win)."""
+        meta: Dict[str, dict] = {}
+        for klass in reversed(self._cls.__mro__):
+            for attr, fn in vars(klass).items():
+                mopts = getattr(fn, "__rt_method_opts__", None)
+                if mopts:
+                    meta[attr] = dict(mopts)
+        return meta
+
     def remote(self, *args, **kwargs) -> ActorHandle:
         cw = get_core_worker()
         opts = self._options
         is_async = _is_async_actor(self._cls)
+        method_meta = self._method_meta()
+        groups = dict(opts.get("concurrency_groups") or {})
+        for mname, mopts in method_meta.items():
+            g = mopts.get("concurrency_group")
+            if g and g not in groups:
+                raise ValueError(
+                    f"method {mname!r} uses undeclared concurrency group {g!r}"
+                    f" (declare it via concurrency_groups={{...}})"
+                )
 
         async def create():
             await cw.export_function(self._class_key, self._cls)
@@ -168,6 +224,7 @@ class ActorClass:
                 namespace=opts.get("namespace", ""),
                 detached=opts.get("lifetime") == "detached",
                 runtime_env=opts.get("runtime_env"),
+                concurrency_groups=groups,
             )
 
         if cw._loop_running_here():
@@ -184,13 +241,14 @@ class ActorClass:
                 namespace=opts.get("namespace", ""),
                 detached=opts.get("lifetime") == "detached",
                 runtime_env=opts.get("runtime_env"),
+                concurrency_groups=groups,
             )
         else:
             actor_id = cw.run_sync(create())
         # Unnamed, non-detached actors are GC'd with the creator's last handle.
         owned = not opts.get("name") and opts.get("lifetime") != "detached"
         return ActorHandle(
-            actor_id, self._class_key, {},
+            actor_id, self._class_key, method_meta,
             max_task_retries=opts.get("max_task_retries", 0),
             _owned=owned,
         )

@@ -168,11 +168,43 @@ class Autoscaler:
             if w["proc"].poll() is None or w["node_id"] in alive_ids
         ]
 
-        # scale up: only for demand existing+starting capacity can't absorb.
-        # Gating (undrain / scale-down) uses hostable demand only, so a
-        # permanently-infeasible task can't pin idle nodes forever.
         demand = self._gate_demand(load)
-        need = self._unmet_worker_need(load)
+        now = time.monotonic()
+        by_id = {n["node_id"]: n for n in load["nodes"]}
+        for node_id in list(self._idle_since):
+            n = by_id.get(node_id)
+            if n is None or (not n["idle"] and n.get("state") == "ALIVE"):
+                del self._idle_since[node_id]
+                self._draining.pop(node_id, None)
+        for n in load["nodes"]:
+            if n["idle"]:
+                self._idle_since.setdefault(n["node_id"], now)
+
+        # undrain BEFORE scale-up: a DRAINING node rejects every lease, so a
+        # drain that never reaches termination (demand returned, or
+        # min_workers stops the removal) would strand capacity forever —
+        # and rescuing existing capacity must win over launching fresh nodes
+        # for the same demand (reference: autoscaler v2 cancels drains for
+        # nodes it keeps)
+        allowed = max(0, len(self.workers) - self.config.min_workers)
+        drained = [nid for nid in self._draining if nid in by_id]
+        to_undrain = drained if demand > 0 else drained[allowed:]
+        undrained = 0
+        for nid in to_undrain:
+            try:
+                cw.run_sync(cw.control.call(
+                    "undrain_node", {"node_id": bytes.fromhex(nid)}), 10)
+            except Exception:  # noqa: BLE001 — retry next poll
+                continue
+            self._draining.pop(nid, None)
+            self._idle_since.pop(nid, None)
+            undrained += 1
+            logger.info("autoscaler undrained node %s", nid[:12])
+
+        # scale up: only for demand existing+starting capacity can't absorb.
+        # An undrain this pass returns capacity the load snapshot couldn't
+        # see; re-evaluate next poll instead of double-provisioning.
+        need = 0 if undrained else self._unmet_worker_need(load)
         to_add = min(need, self.config.max_workers - len(self.workers))
         for _ in range(max(0, to_add)):
             handle = self.provider.create_node(self.config.worker_resources)
@@ -186,34 +218,6 @@ class Autoscaler:
         # still idle on a later poll -> unregister + terminate. The drain
         # closes the race where work lands between a stale idle heartbeat
         # and the SIGTERM.
-        now = time.monotonic()
-        by_id = {n["node_id"]: n for n in load["nodes"]}
-        for node_id in list(self._idle_since):
-            n = by_id.get(node_id)
-            if n is None or (not n["idle"] and n.get("state") == "ALIVE"):
-                del self._idle_since[node_id]
-                self._draining.pop(node_id, None)
-        for n in load["nodes"]:
-            if n["idle"]:
-                self._idle_since.setdefault(n["node_id"], now)
-
-        # undrain before anything else: a DRAINING node rejects every lease,
-        # so a drain that never reaches termination (demand returned, or
-        # min_workers stops the removal) would strand capacity forever
-        # (reference: autoscaler v2 cancels drains for nodes it keeps)
-        allowed = max(0, len(self.workers) - self.config.min_workers)
-        drained = [nid for nid in self._draining if nid in by_id]
-        to_undrain = drained if demand > 0 else drained[allowed:]
-        for nid in to_undrain:
-            try:
-                cw.run_sync(cw.control.call(
-                    "undrain_node", {"node_id": bytes.fromhex(nid)}), 10)
-            except Exception:  # noqa: BLE001 — retry next poll
-                continue
-            self._draining.pop(nid, None)
-            self._idle_since.pop(nid, None)
-            logger.info("autoscaler undrained node %s", nid[:12])
-
         if len(self.workers) > self.config.min_workers and demand == 0:
             for w in list(self.workers):
                 nid = w["node_id"]

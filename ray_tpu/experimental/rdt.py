@@ -53,7 +53,10 @@ class DeviceObjectManager:
         r = self._weak.get(tid)
         if r is not None:
             return r()
-        return self._strong.get(tid)
+        arr = self._strong.get(tid)
+        if arr is not None:
+            self._strong.move_to_end(tid)  # true LRU: hot entries survive
+        return arr
 
     def __len__(self) -> int:
         return len(self._weak) + len(self._strong)
