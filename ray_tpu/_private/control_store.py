@@ -90,6 +90,9 @@ class ActorRecord:
             "num_restarts": self.num_restarts,
             "death_cause": self.death_cause,
             "name": self.name,
+            "class_key": self.spec.function_key,
+            "max_task_retries": self.spec.max_task_retries,
+            "method_meta": self.spec.method_meta,
         }
 
     def to_persist(self) -> dict:

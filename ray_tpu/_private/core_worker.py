@@ -874,7 +874,13 @@ class CoreWorker:
                     break
                 for d in done:
                     r = tasks.pop(d)
-                    if not d.cancelled() and d.exception() is None:
+                    # retrieve the exception unconditionally (else asyncio
+                    # logs "Task exception was never retrieved" for errored
+                    # waiters completing past the cap), then cap at
+                    # num_returns — ray.wait returns at most num_returns
+                    # ready refs; the rest stay in the not-ready list
+                    ok = not d.cancelled() and d.exception() is None
+                    if ok and len(ready) < num_returns:
                         ready.append(r)
         finally:
             for t in tasks:
@@ -1779,6 +1785,7 @@ class CoreWorker:
         detached: bool = False,
         runtime_env: Optional[dict] = None,
         concurrency_groups: Optional[Dict[str, int]] = None,
+        method_meta: Optional[Dict[str, dict]] = None,
     ) -> ActorID:
         with self._lock:
             self._actor_index += 1
@@ -1789,7 +1796,7 @@ class CoreWorker:
             max_task_retries=max_task_retries, max_concurrency=max_concurrency,
             is_async=is_async, strategy=strategy, name=name,
             namespace=namespace, detached=detached, runtime_env=runtime_env,
-            concurrency_groups=concurrency_groups,
+            concurrency_groups=concurrency_groups, method_meta=method_meta,
         )
         return actor_id
 
@@ -1835,6 +1842,7 @@ class CoreWorker:
         detached: bool = False,
         runtime_env: Optional[dict] = None,
         concurrency_groups: Optional[Dict[str, int]] = None,
+        method_meta: Optional[Dict[str, dict]] = None,
     ) -> None:
         from ray_tpu._private.runtime_env_mgr import prepare_runtime_env
 
@@ -1857,6 +1865,7 @@ class CoreWorker:
             max_concurrency=max_concurrency,
             is_async_actor=is_async,
             concurrency_groups=dict(concurrency_groups or {}),
+            method_meta=dict(method_meta or {}),
             runtime_env={**(runtime_env or {}), "namespace": namespace,
                          "detached": detached},
             name=name,

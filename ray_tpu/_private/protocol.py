@@ -198,6 +198,10 @@ class TaskSpec:
     # each actor task carries the group its method is assigned to
     concurrency_groups: Dict[str, int] = field(default_factory=dict)
     concurrency_group: str = ""
+    # @method declarations (num_returns / concurrency_group per method) —
+    # carried on the creation spec so get_actor handles rebuild the same
+    # call behavior the original handle had
+    method_meta: Dict[str, dict] = field(default_factory=dict)
     runtime_env: dict = field(default_factory=dict)
     name: str = ""
     # streaming generators: num_returns == NUM_RETURNS_STREAMING; executor
@@ -245,6 +249,7 @@ class TaskSpec:
             "is_async_actor": self.is_async_actor,
             "concurrency_groups": self.concurrency_groups,
             "concurrency_group": self.concurrency_group,
+            "method_meta": self.method_meta,
             "runtime_env": self.runtime_env,
             "name": self.name,
             "stream_backpressure": self.stream_backpressure,
@@ -276,6 +281,7 @@ class TaskSpec:
             is_async_actor=w.get("is_async_actor", False),
             concurrency_groups=w.get("concurrency_groups") or {},
             concurrency_group=w.get("concurrency_group", ""),
+            method_meta=w.get("method_meta") or {},
             runtime_env=w.get("runtime_env") or {},
             name=w.get("name", ""),
             stream_backpressure=w.get("stream_backpressure", -1),

@@ -337,9 +337,17 @@ def _handle_from_named_actor_reply(name: str, reply: dict) -> "Any":
     from ray_tpu._private.ids import ActorID
     from ray_tpu.actor import ActorHandle
 
-    if reply["actor"] is None or reply["actor"]["state"] == "DEAD":
+    rec = reply["actor"]
+    if rec is None or rec["state"] == "DEAD":
         raise ValueError(f"no live actor named {name!r}")
-    return ActorHandle(ActorID(reply["actor"]["actor_id"]), class_key="", method_meta=None)
+    # carry the class's @method declarations so a get_actor handle behaves
+    # like the original (concurrency groups, multi-returns)
+    return ActorHandle(
+        ActorID(rec["actor_id"]),
+        class_key=rec.get("class_key", ""),
+        method_meta=rec.get("method_meta") or None,
+        max_task_retries=rec.get("max_task_retries", 0),
+    )
 
 
 def get_actor(name: str, namespace: str = "") -> "Any":

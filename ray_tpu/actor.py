@@ -225,6 +225,7 @@ class ActorClass:
                 detached=opts.get("lifetime") == "detached",
                 runtime_env=opts.get("runtime_env"),
                 concurrency_groups=groups,
+                method_meta=method_meta,
             )
 
         if cw._loop_running_here():
@@ -242,6 +243,7 @@ class ActorClass:
                 detached=opts.get("lifetime") == "detached",
                 runtime_env=opts.get("runtime_env"),
                 concurrency_groups=groups,
+                method_meta=method_meta,
             )
         else:
             actor_id = cw.run_sync(create())

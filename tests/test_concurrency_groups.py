@@ -107,3 +107,26 @@ def test_method_num_returns_meta(ray_init):
     m = Multi.remote()
     r1, r2 = m.pair.remote()
     assert ray_tpu.get([r1, r2], timeout=30) == [1, 2]
+
+
+def test_get_actor_preserves_method_meta(ray_init):
+    @ray_tpu.remote(name="meta-actor", concurrency_groups={"io": 1})
+    class Named:
+        @ray_tpu.method(concurrency_group="io")
+        def io_call(self):
+            return "io"
+
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    handle = Named.remote()
+    ray_tpu.get(handle.io_call.remote(), timeout=30)  # wait alive
+
+    fetched = ray_tpu.get_actor("meta-actor")
+    # concurrency group survives the round-trip (would raise undeclared
+    # group at execution if dropped — and run on the wrong lane)
+    assert ray_tpu.get(fetched.io_call.remote(), timeout=30) == "io"
+    r1, r2 = fetched.pair.remote()
+    assert ray_tpu.get([r1, r2], timeout=30) == [1, 2]
+    ray_tpu.kill(fetched)

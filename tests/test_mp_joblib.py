@@ -1,0 +1,81 @@
+"""Ecosystem shims: multiprocessing.Pool + joblib backend (reference:
+python/ray/util/multiprocessing/, python/ray/util/joblib/)."""
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def ray_init():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_pool_map_and_apply(ray_init):
+    from ray_tpu.util.multiprocessing import Pool
+
+    sq = lambda x: x * x  # noqa: E731 — by-value pickling for workers
+    add = lambda a, b: a + b  # noqa: E731
+
+    with Pool(processes=2) as p:
+        assert p.map(sq, range(10)) == [x * x for x in range(10)]
+        assert p.apply(add, (3, 4)) == 7
+        r = p.apply_async(add, (10, 20))
+        assert r.get(timeout=30) == 30
+        assert r.successful()
+
+
+def test_pool_starmap_imap(ray_init):
+    from ray_tpu.util.multiprocessing import Pool
+
+    sq = lambda x: x * x  # noqa: E731
+    add = lambda a, b: a + b  # noqa: E731
+
+    with Pool(processes=2) as p:
+        assert p.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert list(p.imap(sq, range(6), chunksize=2)) == [0, 1, 4, 9, 16, 25]
+        assert sorted(p.imap_unordered(sq, range(6), chunksize=2)) == [
+            0, 1, 4, 9, 16, 25
+        ]
+
+
+def test_pool_error_propagates(ray_init):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def boom(x):
+        raise RuntimeError("pool boom")
+
+    with Pool(processes=1) as p:
+        with pytest.raises(Exception, match="pool boom"):
+            p.map(boom, [1])
+
+
+def test_pool_initializer(ray_init):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def init_env(val):
+        import os
+
+        os.environ["POOL_INIT"] = val
+
+    def read_env(_):
+        import os
+
+        return os.environ.get("POOL_INIT")
+
+    with Pool(processes=2, initializer=init_env, initargs=("yes",)) as p:
+        assert p.map(read_env, range(4)) == ["yes"] * 4
+
+
+def test_joblib_backend(ray_init):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    sq = lambda x: x * x  # noqa: E731
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = joblib.Parallel()(joblib.delayed(sq)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
