@@ -119,7 +119,12 @@ class Dataset:
         return Dataset(refs, [], _refs=refs)
 
     def _block_refs(self) -> List[Any]:
-        return self.materialize()._refs
+        # cache the materialization on THIS dataset too: repeated consumers
+        # (sum then mean then std; schema after count) must not re-execute
+        # the whole plan per call
+        refs = self.materialize()._refs
+        self._refs = refs
+        return refs
 
     # -- consumption ----------------------------------------------------
 
@@ -300,6 +305,126 @@ class Dataset:
         ]
         return Dataset(new_refs, [], _refs=new_refs)
 
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        """Distributed sort (materializes): sample key range → range-partition
+        scatter → per-partition sort (reference: data sort ops; the classic
+        TeraSort shape, O(N) movement + parallel partition sorts)."""
+        import ray_tpu
+        from ray_tpu.remote_function import RemoteFunction
+
+        refs = self._block_refs()
+        k = len(refs)
+        if k == 0:
+            return Dataset([], [], _refs=[])
+
+        def _sample(block):
+            col = np.asarray(block[key]) if isinstance(block, dict) else (
+                np.asarray([r[key] for r in block_rows(block)])
+            )
+            if col.size == 0:
+                return col
+            take = min(64, col.size)
+            idx = np.random.default_rng(0).choice(col.size, take, replace=False)
+            return col[idx]
+
+        samples = np.concatenate([
+            s for s in ray_tpu.get(
+                [RemoteFunction(_sample).remote(r) for r in refs], timeout=600)
+            if s.size
+        ]) if k else np.array([])
+        if samples.size == 0 or k == 1:
+            def _sort_one(block):
+                return _sort_block(block, key, descending)
+
+            new_refs = [RemoteFunction(_sort_one).remote(r) for r in refs]
+            return Dataset(new_refs, [], _refs=new_refs)
+        # positional quantiles, not np.quantile: sort keys may be strings
+        # (any sortable dtype) and only order matters for range bounds
+        srt = np.sort(samples)
+        bounds = srt[[
+            min(srt.size - 1, max(0, (srt.size * i) // k)) for i in range(1, k)
+        ]]
+
+        def _scatter(block, bounds):
+            col = np.asarray(block[key]) if isinstance(block, dict) else (
+                np.asarray([r[key] for r in block_rows(block)])
+            )
+            assign = np.searchsorted(bounds, col, side="right")
+            n_parts = len(bounds) + 1
+            if isinstance(block, dict):
+                return tuple(
+                    {c: np.asarray(v)[assign == i] for c, v in block.items()}
+                    for i in range(n_parts)
+                )
+            items = list(block)
+            return tuple(
+                [items[t] for t in np.flatnonzero(assign == i)]
+                for i in range(n_parts)
+            )
+
+        def _merge_sort(*parts):
+            return _sort_block(block_concat(list(parts)), key, descending)
+
+        scatter = RemoteFunction(_scatter).options(num_returns=k)
+        partitions = [scatter.remote(r, bounds) for r in refs]
+        order = range(k - 1, -1, -1) if descending else range(k)
+        new_refs = [
+            RemoteFunction(_merge_sort).remote(*[partitions[j][i] for j in range(k)])
+            for i in order
+        ]
+        return Dataset(new_refs, [], _refs=new_refs)
+
+    def groupby(self, key: str) -> "GroupedData":
+        """Group rows by a key column (reference: Dataset.groupby +
+        hash-shuffle aggregate ops)."""
+        return GroupedData(self, key)
+
+    # -- global aggregates (reference: Dataset.sum/min/max/mean/std) ----
+
+    def _column_stats(self, col: str):
+        import ray_tpu
+        from ray_tpu.remote_function import RemoteFunction
+
+        def _stats(block):
+            v = np.asarray(block[col]) if isinstance(block, dict) else (
+                np.asarray([r[col] for r in block_rows(block)])
+            )
+            if v.size == 0:
+                return (0, 0.0, 0.0, None, None)
+            return (int(v.size), float(v.sum()), float((v.astype(np.float64) ** 2).sum()),
+                    v.min().item(), v.max().item())
+
+        parts = ray_tpu.get(
+            [RemoteFunction(_stats).remote(r) for r in self._block_refs()],
+            timeout=600,
+        )
+        n = sum(p[0] for p in parts)
+        total = sum(p[1] for p in parts)
+        sq = sum(p[2] for p in parts)
+        mins = [p[3] for p in parts if p[3] is not None]
+        maxs = [p[4] for p in parts if p[4] is not None]
+        return n, total, sq, (min(mins) if mins else None), (max(maxs) if maxs else None)
+
+    def sum(self, col: str):
+        return self._column_stats(col)[1]
+
+    def mean(self, col: str):
+        n, total, *_ = self._column_stats(col)
+        return total / n if n else None
+
+    def min(self, col: str):
+        return self._column_stats(col)[3]
+
+    def max(self, col: str):
+        return self._column_stats(col)[4]
+
+    def std(self, col: str, ddof: int = 1):
+        n, total, sq, _, _ = self._column_stats(col)
+        if n <= ddof:
+            return None
+        mean = total / n
+        return float(np.sqrt(max(0.0, (sq - n * mean * mean) / (n - ddof))))
+
     # -- introspection --------------------------------------------------
 
     def schema(self) -> Optional[Dict[str, str]]:
@@ -316,3 +441,111 @@ class Dataset:
     def __repr__(self):
         ops = "->".join(k for k, _ in self._ops) or "source"
         return f"Dataset(blocks={len(self._producers)}, plan={ops})"
+
+
+def _sort_block(block: Block, key: str, descending: bool) -> Block:
+    if isinstance(block, dict):
+        col = np.asarray(block[key])
+        order = np.argsort(col, kind="stable")
+        if descending:
+            order = order[::-1]
+        return {c: np.asarray(v)[order] for c, v in block.items()}
+    rows = sorted(block_rows(block), key=lambda r: r[key], reverse=descending)
+    return rows_to_block(rows)
+
+
+class GroupedData:
+    """Hash-partitioned group-by + aggregates (reference: data groupby with
+    hash_shuffle aggregate operators). Keys scatter to k partitions by hash;
+    each partition aggregates its groups independently."""
+
+    # per-group leaf computed inside one partition: hash partitioning puts
+    # ALL rows of a group in the same partition, so no cross-partition
+    # combine is needed — mean included
+    _AGGS = {
+        "count": len,
+        "sum": lambda vals: np.sum(vals).item(),
+        "min": lambda vals: np.min(vals).item(),
+        "max": lambda vals: np.max(vals).item(),
+        "mean": lambda vals: float(np.mean(vals)),
+    }
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _aggregate(self, agg: str, col: Optional[str]) -> Dataset:
+        from ray_tpu.remote_function import RemoteFunction
+
+        if agg not in self._AGGS:
+            raise ValueError(f"unknown aggregate {agg!r}")
+        key = self._key
+        refs = self._ds._block_refs()
+        if not refs:
+            return Dataset([], [], _refs=[])
+        k = len(refs)
+
+        def _scatter(block, k):
+            import hashlib as _hl
+
+            def stable(x) -> int:
+                # NOT hash(): str hashing is per-process randomized, which
+                # would scatter equal keys to different partitions
+                x = x.item() if hasattr(x, "item") else x
+                d = _hl.blake2b(repr(x).encode(), digest_size=8).digest()
+                return int.from_bytes(d, "little")
+
+            keys = (np.asarray(block[key]) if isinstance(block, dict)
+                    else np.asarray([r[key] for r in block_rows(block)]))
+            assign = np.asarray([stable(x) % k for x in keys.tolist()])
+            if isinstance(block, dict):
+                return tuple(
+                    {c: np.asarray(v)[assign == i] for c, v in block.items()}
+                    for i in range(k)
+                )
+            items = list(block)
+            return tuple(
+                [items[t] for t in np.flatnonzero(assign == i)]
+                for i in range(k)
+            )
+
+        def _agg_partition(agg, col, *parts):
+            whole = block_concat(list(parts))
+            groups: Dict[Any, list] = {}
+            for r in block_rows(whole):
+                groups.setdefault(r[key], []).append(
+                    r[col] if col is not None else 1
+                )
+            leaf = GroupedData._AGGS[agg]
+            out_name = f"{agg}({col})" if col else "count()"
+            return rows_to_block([
+                {key: gk, out_name: leaf(vals)} for gk, vals in groups.items()
+            ])
+
+        agg_fn = RemoteFunction(_agg_partition)
+        if k == 1:
+            # num_returns=1 .remote() yields a bare ref; no scatter needed
+            new_refs = [agg_fn.remote(agg, col, refs[0])]
+        else:
+            scatter = RemoteFunction(_scatter).options(num_returns=k)
+            partitions = [scatter.remote(r, k) for r in refs]
+            new_refs = [
+                agg_fn.remote(agg, col, *[partitions[j][i] for j in range(k)])
+                for i in range(k)
+            ]
+        return Dataset(new_refs, [], _refs=new_refs)
+
+    def count(self) -> Dataset:
+        return self._aggregate("count", None)
+
+    def sum(self, col: str) -> Dataset:
+        return self._aggregate("sum", col)
+
+    def mean(self, col: str) -> Dataset:
+        return self._aggregate("mean", col)
+
+    def min(self, col: str) -> Dataset:
+        return self._aggregate("min", col)
+
+    def max(self, col: str) -> Dataset:
+        return self._aggregate("max", col)

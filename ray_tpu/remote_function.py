@@ -64,6 +64,14 @@ def build_resources(opts: Dict[str, Any], default_cpu: float = 1.0) -> Dict[str,
     return res
 
 
+def _value_digest(value) -> bytes:
+    """Stable bytes for hashing a captured value into a function key."""
+    try:
+        return cloudpickle.dumps(value)
+    except Exception:  # noqa: BLE001 — unpicklable capture: fall back
+        return repr(value).encode()
+
+
 class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._fn = fn
@@ -77,6 +85,14 @@ class RemoteFunction:
         h.update(fn.__qualname__.encode())
         if code is not None:
             h.update(code.co_code)
+        # closure cells and defaults are part of the function's behavior:
+        # two closures over the same code but different captured values must
+        # not collide on one exported definition (the export is cached by
+        # key cluster-wide)
+        for cell in getattr(fn, "__closure__", None) or ():
+            h.update(_value_digest(cell.cell_contents))
+        for default in getattr(fn, "__defaults__", None) or ():
+            h.update(_value_digest(default))
         self._function_key = f"{fn.__qualname__}:{h.hexdigest()}"
         self._exported = False
 

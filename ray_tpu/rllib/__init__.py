@@ -4,6 +4,8 @@ env/env_runner_group.py)."""
 
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import PPOLearner, compute_gae
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.learner import VTraceLearner
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
-__all__ = ["EnvRunner", "PPO", "PPOConfig", "PPOLearner", "compute_gae"]
+__all__ = ["EnvRunner", "IMPALA", "IMPALAConfig", "PPO", "PPOConfig", "PPOLearner", "VTraceLearner", "compute_gae"]

@@ -106,6 +106,43 @@ class EnvRunner:
             "advantages": adv, "returns": ret,
         }
 
+    def sample_raw(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps raw transitions for V-trace learners: no GAE —
+        the learner computes values under its CURRENT policy and corrects
+        off-policyness itself (reference: IMPALA env runners ship raw
+        fragments; impala.py:526)."""
+        assert self.weights is not None, "set_weights before sample"
+        obs_buf = np.zeros((num_steps, *np.shape(self.obs)), dtype=np.float32)
+        next_obs_buf = np.zeros_like(obs_buf)
+        act_buf = np.zeros(num_steps, dtype=np.int32)
+        logp_buf = np.zeros(num_steps, dtype=np.float32)
+        rew_buf = np.zeros(num_steps, dtype=np.float32)
+        term_buf = np.zeros(num_steps, dtype=np.float32)
+        cut_buf = np.zeros(num_steps, dtype=np.float32)
+        for t in range(num_steps):
+            action, logp, _ = self._policy(np.asarray(self.obs, np.float32))
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            obs_buf[t] = self.obs
+            next_obs_buf[t] = nxt  # pre-reset successor on episode end
+            act_buf[t] = action
+            logp_buf[t] = logp
+            rew_buf[t] = reward
+            done = terminated or truncated
+            term_buf[t] = float(terminated)
+            cut_buf[t] = float(done)
+            self._episode_return += float(reward)
+            if done:
+                self._completed_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                self.obs, _ = self.env.reset()
+            else:
+                self.obs = nxt
+        return {
+            "obs": obs_buf, "next_obs": next_obs_buf, "actions": act_buf,
+            "logp": logp_buf, "rewards": rew_buf, "terminated": term_buf,
+            "cut": cut_buf,
+        }
+
     def episode_returns(self, clear: bool = True) -> List[float]:
         out = list(self._completed_returns)
         if clear:

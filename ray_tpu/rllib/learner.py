@@ -173,3 +173,104 @@ def _ppo_update(params, opt_state, rng, batch, *, tx, clip, vf_coeff,
         "total_loss": loss, "policy_loss": pg,
         "vf_loss": vf, "entropy": ent,
     }
+
+
+# ---------------------------------------------------------------------------
+# IMPALA / V-trace (reference: rllib/algorithms/impala/impala.py:526 +
+# vtrace targets from Espeholt et al. — off-policy correction so stale
+# behavior policies from async sampling still yield on-policy gradients)
+# ---------------------------------------------------------------------------
+
+
+def _vtrace_loss(params, batch, *, gamma, rho_bar, c_bar, vf_coeff,
+                 entropy_coeff):
+    obs = batch["obs"]
+    next_obs = batch["next_obs"]
+    logits = policy_logits(params, obs)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    v = value_fn(params, obs)
+    next_v = value_fn(params, next_obs)
+    not_term = 1.0 - batch["terminated"]
+    not_cut = 1.0 - batch["cut"]  # chain break: terminal OR truncation
+    rho = jnp.minimum(jnp.exp(logp - batch["logp"]), rho_bar)
+    c = jnp.minimum(rho, c_bar)
+    rho_sg = jax.lax.stop_gradient(rho)
+    v_sg = jax.lax.stop_gradient(v)
+    next_v_sg = jax.lax.stop_gradient(next_v)
+    delta = rho_sg * (batch["rewards"] + gamma * next_v_sg * not_term - v_sg)
+
+    def back(carry, x):
+        d, c_t, disc = x
+        carry = d + disc * c_t * carry
+        return carry, carry
+
+    _, vs_minus_v = jax.lax.scan(
+        back, 0.0,
+        (delta, jax.lax.stop_gradient(c), gamma * not_cut),
+        reverse=True,
+    )
+    vs = v_sg + vs_minus_v
+    # vs_{t+1}: next step's vs inside a chain; bootstrap value at a cut
+    vs_next = jnp.where(
+        not_cut.astype(bool),
+        jnp.concatenate([vs[1:], next_v_sg[-1:]]),
+        next_v_sg,
+    )
+    pg_adv = rho_sg * (batch["rewards"] + gamma * vs_next * not_term - v_sg)
+    pg_loss = -(pg_adv * logp).mean()
+    vf_loss = 0.5 * ((v - vs) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    return total, (pg_loss, vf_loss, entropy)
+
+
+def _vtrace_update(params, opt_state, batch, *, tx, gamma, rho_bar, c_bar,
+                   vf_coeff, entropy_coeff):
+    (loss, aux), grads = jax.value_and_grad(_vtrace_loss, has_aux=True)(
+        params, batch, gamma=gamma, rho_bar=rho_bar, c_bar=c_bar,
+        vf_coeff=vf_coeff, entropy_coeff=entropy_coeff)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    pg, vf, ent = aux
+    return params, opt_state, {
+        "total_loss": loss, "policy_loss": pg, "vf_loss": vf, "entropy": ent,
+    }
+
+
+class VTraceLearner:
+    """IMPALA learner: one SGD step per arriving fragment, with V-trace
+    off-policy correction (reference: impala TorchLearner loss)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *,
+                 hidden: Tuple[int, ...] = (64, 64), lr: float = 5e-4,
+                 gamma: float = 0.99, rho_bar: float = 1.0, c_bar: float = 1.0,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 seed: int = 0):
+        key = jax.random.PRNGKey(seed)
+        kp, kv = jax.random.split(key)
+        self.params = {
+            "pi": init_mlp(kp, [obs_dim, *hidden, num_actions]),
+            "vf": init_mlp(kv, [obs_dim, *hidden, 1]),
+        }
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update_jit = jax.jit(functools.partial(
+            _vtrace_update, tx=self.tx, gamma=gamma, rho_bar=rho_bar,
+            c_bar=c_bar, vf_coeff=vf_coeff, entropy_coeff=entropy_coeff,
+        ))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self.params, self.opt_state, metrics = self._update_jit(
+            self.params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in batch.items()},
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights: Any):
+        self.params = jax.device_put(weights)

@@ -16,7 +16,9 @@ from ray_tpu.serve._controller import (
     SERVE_NAMESPACE,
     get_or_create_controller,
 )
+from ray_tpu.serve._batching import batch
 from ray_tpu.serve._handle import DeploymentHandle
+from ray_tpu.serve._multiplex import get_multiplexed_model_id, multiplexed
 
 
 class Deployment:
@@ -159,6 +161,9 @@ def shutdown():
 
 
 __all__ = [
+    "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
     "Deployment",
     "DeploymentHandle",
     "deployment",

@@ -1,0 +1,109 @@
+"""@serve.batch: transparent request batching inside a replica.
+
+Reference: python/ray/serve/batching.py — individual calls queue up and the
+wrapped function runs once per batch (list in, list out), amortizing model
+invocation cost. Flush triggers: the batch reaches max_batch_size, or
+batch_wait_timeout_s elapses since the first queued item.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+from typing import Any, Callable, Dict, List, Optional
+
+
+class _BatchQueue:
+    __slots__ = ("items", "timer")
+
+    def __init__(self):
+        self.items: List[tuple] = []  # (item, future)
+        self.timer: Optional[asyncio.TimerHandle] = None
+
+
+# queues for batched FREE functions, keyed by wrapper identity. Module-level
+# (not closure state): the wrapper travels to replicas by value via
+# cloudpickle, and runtime queue state must not ride along.
+_free_queues: Dict[int, _BatchQueue] = {}
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorate an async function/method taking a LIST of requests and
+    returning a LIST of responses; callers invoke it with single items."""
+
+    def decorate(fn: Callable):
+        params = list(inspect.signature(fn).parameters)
+        is_method = bool(params) and params[0] == "self"
+
+        def queue_for(self_obj, wrapper_id: int) -> _BatchQueue:
+            if self_obj is None:
+                q = _free_queues.get(wrapper_id)
+                if q is None:
+                    q = _free_queues[wrapper_id] = _BatchQueue()
+                return q
+            # per-instance state lives ON the instance (picklable classes
+            # must not capture queues in the decorator closure)
+            queues = getattr(self_obj, "_rt_batch_queues", None)
+            if queues is None:
+                queues = {}
+                self_obj._rt_batch_queues = queues
+            q = queues.get(fn.__name__)
+            if q is None:
+                q = queues[fn.__name__] = _BatchQueue()
+            return q
+
+        async def flush(q: _BatchQueue, self_obj):
+            if q.timer is not None:
+                q.timer.cancel()
+                q.timer = None
+            items, q.items = q.items, []
+            if not items:
+                return
+            batch_in = [it for it, _ in items]
+            try:
+                out = fn(self_obj, batch_in) if is_method else fn(batch_in)
+                if inspect.isawaitable(out):
+                    out = await out
+                if len(out) != len(items):
+                    raise ValueError(
+                        f"batched function returned {len(out)} results for "
+                        f"{len(items)} requests"
+                    )
+                for (_, fut), r in zip(items, out):
+                    if not fut.done():
+                        fut.set_result(r)
+            except BaseException as e:  # noqa: BLE001 — fan the error out
+                for _, fut in items:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+        @functools.wraps(fn)
+        async def wrapper(*call_args) -> Any:
+            if is_method:
+                self_obj, item = call_args
+            else:
+                (item,) = call_args
+                self_obj = None
+            loop = asyncio.get_running_loop()
+            q = queue_for(self_obj, id(wrapper))
+            fut = loop.create_future()
+            q.items.append((item, fut))
+            if len(q.items) >= max_batch_size:
+                await flush(q, self_obj)
+            elif q.timer is None:
+                from ray_tpu._private.aio import spawn
+
+                q.timer = loop.call_later(
+                    batch_wait_timeout_s,
+                    lambda: spawn(flush(q, self_obj)),
+                )
+            return await fut
+
+        wrapper._rt_batched = True  # introspection marker
+        return wrapper
+
+    if _fn is not None:
+        return decorate(_fn)
+    return decorate

@@ -30,8 +30,19 @@ class DeploymentHandle:
         # counts survive replica-set changes and periodic refreshes — wiping
         # them would erase the power-of-two-choices load signal every 2 s
         self._inflight: Dict[bytes, int] = {}
+        # multiplexing: model id -> replica actor-id that loaded it last
+        # (reference: multiplex-aware routing in pow_2_router.py)
+        self._model_affinity: Dict[str, bytes] = {}
         self._last_refresh = 0.0
         self._lock = threading.Lock()
+
+    def options(self, *, multiplexed_model_id: str = "") -> Any:
+        """Per-request options (reference: handle.options). Currently:
+        multiplexed_model_id routes to a replica that already holds the
+        model and exposes the id via serve.get_multiplexed_model_id()."""
+        if not multiplexed_model_id:
+            return self
+        return _ModelRouter(self, multiplexed_model_id)
 
     def _resolve_controller(self):
         if self._controller is None:
@@ -133,6 +144,41 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (_rebuild_handle, (self.deployment_name,))
+
+
+class _ModelRouter:
+    """Handle view bound to one multiplexed model id: sticky routing to the
+    replica that last served the model (falls back to power-of-two when it
+    is gone), with the id delivered to the replica's request context."""
+
+    def __init__(self, handle: DeploymentHandle, model_id: str):
+        self._handle = handle
+        self._model_id = model_id
+
+    def _pick_sticky(self) -> tuple:
+        h = self._handle
+        h._refresh()
+        with h._lock:
+            rid = h._model_affinity.get(self._model_id)
+            if rid is not None:
+                for r in h._replicas:
+                    if r._actor_id.binary() == rid:
+                        h._inflight[rid] = h._inflight.get(rid, 0) + 1
+                        return rid, r
+        rid, replica = h._pick()
+        with h._lock:
+            h._model_affinity[self._model_id] = rid
+        return rid, replica
+
+    def remote(self, *args, **kwargs):
+        rid, replica = self._pick_sticky()
+        kwargs["__serve_model_id"] = self._model_id
+        try:
+            ref = replica.handle_request.remote(*args, **kwargs)
+            return _TrackedRef(ref, self._handle, rid, call=(None, args, kwargs))
+        except Exception:
+            self._handle._refresh(force=True)
+            raise
 
 
 class _MethodCaller:

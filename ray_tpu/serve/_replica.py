@@ -59,8 +59,15 @@ class ServeReplica:
                         getattr(fn, "__call__", None))
                 ):
                     return await fn(*args, **kwargs)
+                # copy_context: run_in_executor does not propagate
+                # contextvars (the multiplexed model id must be visible in
+                # sync callables; asyncio.to_thread does this same dance)
+                import contextvars
+
+                ctx = contextvars.copy_context()
                 result = await asyncio.get_running_loop().run_in_executor(
-                    self._pool, functools.partial(fn, *args, **kwargs)
+                    self._pool,
+                    functools.partial(ctx.run, fn, *args, **kwargs),
                 )
                 if inspect.isawaitable(result):
                     result = await result
@@ -73,6 +80,12 @@ class ServeReplica:
         if not callable(fn):
             raise TypeError(
                 f"deployment {self.deployment_name} is not callable")
+        model_id = kwargs.pop("__serve_model_id", None)
+        if model_id:
+            # visible to serve.get_multiplexed_model_id() inside the request
+            from ray_tpu.serve._multiplex import _set_model_id
+
+            _set_model_id(model_id)
         return await self._run(fn, *args, **kwargs)
 
     async def call_method(self, method: str, *args, **kwargs) -> Any:

@@ -18,7 +18,14 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu.tune._scheduler import CONTINUE, STOP, ASHAScheduler, FIFOScheduler
+from ray_tpu.tune._scheduler import (
+    CONTINUE,
+    EXPLOIT,
+    STOP,
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
 from ray_tpu.tune._search import (
     choice,
     generate_variants,
@@ -27,6 +34,7 @@ from ray_tpu.tune._search import (
     randint,
     uniform,
 )
+from ray_tpu.tune._session import get_checkpoint
 from ray_tpu.tune._trial import TrialActor, report
 
 
@@ -117,7 +125,8 @@ class Tuner:
 
         cfg = self._cfg
         scheduler = cfg.scheduler or FIFOScheduler()
-        if isinstance(scheduler, ASHAScheduler) and scheduler.metric is None:
+        if (isinstance(scheduler, (ASHAScheduler, PopulationBasedTraining))
+                and scheduler.metric is None):
             scheduler.metric = cfg.metric
             scheduler.mode = cfg.mode
         variants = list(generate_variants(
@@ -142,6 +151,47 @@ class Tuner:
                     fn_blob, results[i].config)
                 running[i] = actor
                 results[i].status = "RUNNING"
+                if hasattr(scheduler, "register"):
+                    scheduler.register(results[i].trial_id, results[i].config)
+
+        trial_index = {r.trial_id: i for i, r in enumerate(results)}
+
+        def exploit(i: int, actor) -> Any:
+            """PBT: stop the trial, copy a donor's checkpoint + mutated
+            config, and relaunch it mid-run (reference: pbt.py _exploit)."""
+            r = results[i]
+            decision = scheduler.take_exploit(r.trial_id)
+            if decision is None:
+                return actor
+            donor_i = trial_index.get(decision["donor"])
+            checkpoint = None
+            donor_actor = running.get(donor_i)
+            try:
+                if donor_actor is not None:
+                    cps = ray_tpu.get(
+                        donor_actor.get_checkpoints.remote(), timeout=30)
+                elif donor_i is not None:
+                    cps = results[donor_i].checkpoints
+                else:
+                    cps = []
+                if cps:
+                    checkpoint = cps[-1]["data"]
+            except Exception:  # noqa: BLE001 — donor died; explore only
+                pass
+            try:
+                ray_tpu.get(actor.stop.remote(), timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+            ray_tpu.kill(actor)
+            r.config = dict(decision["config"])
+            opts = {}
+            if self._trial_resources:
+                opts["resources"] = dict(self._trial_resources)
+            last_t = (r.metrics or {}).get("training_iteration", 0)
+            replacement = TrialActor.options(**opts).remote(
+                fn_blob, r.config, checkpoint, last_t)
+            running[i] = replacement
+            return replacement
 
         launch()
         while running:
@@ -163,11 +213,18 @@ class Tuner:
                     launch()
                     continue
                 stop_now = False
+                exploit_now = False
                 for metrics in polled["results"]:
                     r.history.append(metrics)
                     r.metrics = metrics
-                    if scheduler.on_result(r.trial_id, metrics) == STOP:
+                    decision = scheduler.on_result(r.trial_id, metrics)
+                    if decision == STOP:
                         stop_now = True
+                    elif decision == EXPLOIT:
+                        exploit_now = True
+                if exploit_now and polled["status"] == "RUNNING" and not stop_now:
+                    actor = exploit(i, actor)
+                    continue
                 if stop_now and polled["status"] == "RUNNING":
                     try:
                         ray_tpu.get(actor.stop.remote(), timeout=30)
@@ -190,6 +247,8 @@ class Tuner:
 __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
+    "PopulationBasedTraining",
+    "get_checkpoint",
     "Result",
     "ResultGrid",
     "TuneConfig",

@@ -9,10 +9,12 @@ recorded at that rung so far.
 
 from __future__ import annotations
 
-from typing import Dict, List
+import random
+from typing import Any, Callable, Dict, List, Optional, Union
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+EXPLOIT = "EXPLOIT"
 
 
 class FIFOScheduler:
@@ -72,3 +74,93 @@ class ASHAScheduler:
                 if sign * value > cutoff:
                     return STOP
         return CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT (reference: python/ray/tune/schedulers/pbt.py): every
+    perturbation_interval, trials in the bottom quantile EXPLOIT a top-
+    quantile trial (copy its checkpoint + config) and EXPLORE (mutate
+    hyperparameters: continuous ranges scale by 0.8/1.2, categorical lists
+    resample), continuing training from the donor's state."""
+
+    def __init__(self, metric: str = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        assert 0 < quantile_fraction <= 0.5
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = dict(hyperparam_mutations or {})
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = random.Random(seed)
+        self._configs: Dict[str, dict] = {}
+        self._scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, float] = {}
+        self._pending_exploit: Dict[str, dict] = {}
+
+    def register(self, trial_id: str, config: dict):
+        self._configs[trial_id] = dict(config)
+        self._last_perturb.setdefault(trial_id, 0)
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        self._scores[trial_id] = float(value)
+        if t - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        lower, upper = self._quantiles()
+        if trial_id not in lower or not upper:
+            return CONTINUE
+        donor = self._rng.choice(upper)
+        self._pending_exploit[trial_id] = {
+            "donor": donor,
+            "config": self._explore(self._configs.get(donor, {})),
+        }
+        return EXPLOIT
+
+    def take_exploit(self, trial_id: str) -> Optional[dict]:
+        decision = self._pending_exploit.pop(trial_id, None)
+        if decision is not None:
+            self._configs[trial_id] = dict(decision["config"])
+        return decision
+
+    def _quantiles(self):
+        if len(self._scores) < 2:
+            return [], []
+        sign = 1.0 if self.mode == "max" else -1.0
+        ranked = sorted(self._scores, key=lambda tid: sign * self._scores[tid])
+        n = max(1, int(len(ranked) * self.quantile))
+        return ranked[:n], ranked[-n:]
+
+    def _explore(self, config: dict) -> dict:
+        out = dict(config)
+        for name, spec in self.mutations.items():
+            cur = out.get(name)
+            if callable(spec):
+                out[name] = spec()
+            elif isinstance(spec, list):
+                if self._rng.random() < self.resample_p or cur not in spec:
+                    out[name] = self._rng.choice(spec)
+                else:
+                    # shift one step along the list (explore neighbors)
+                    i = spec.index(cur)
+                    j = min(len(spec) - 1, max(0, i + self._rng.choice((-1, 1))))
+                    out[name] = spec[j]
+            elif isinstance(spec, tuple) and len(spec) == 2:
+                lo, hi = spec
+                if self._rng.random() < self.resample_p or cur is None:
+                    out[name] = self._rng.uniform(lo, hi)
+                else:
+                    out[name] = min(hi, max(lo, cur * self._rng.choice((0.8, 1.2))))
+            else:
+                raise ValueError(f"unsupported mutation spec for {name!r}")
+        return out

@@ -19,12 +19,14 @@ class StopTrial(Exception):
 
 
 class TrialContext:
-    def __init__(self):
+    def __init__(self, start_checkpoint: Optional[dict] = None):
         self.results: List[dict] = []
         self.checkpoints: List[dict] = []
         self.iteration = 0
         self.stopped = False
         self.lock = threading.Lock()
+        # checkpoint to resume from (PBT exploit / trial restore)
+        self.start_checkpoint = start_checkpoint
 
     def record(self, metrics: Dict[str, Any], checkpoint: Optional[dict]):
         with self.lock:
@@ -47,6 +49,13 @@ def set_ctx(ctx: Optional[TrialContext]):
 
 def get_ctx() -> Optional[TrialContext]:
     return getattr(_local, "ctx", None)
+
+
+def get_checkpoint() -> Optional[dict]:
+    """Checkpoint to resume from, if the controller restored/exploited one
+    (reference: tune.get_checkpoint in function trainables)."""
+    ctx = get_ctx()
+    return ctx.start_checkpoint if ctx is not None else None
 
 
 def report(metrics: Dict[str, Any], checkpoint: Optional[dict] = None):

@@ -23,12 +23,16 @@ class TrialActor:
     """Runs one trial's trainable on a worker thread; the controller polls
     poll() for fresh results and final status."""
 
-    def __init__(self, fn_blob: bytes, config: dict):
+    def __init__(self, fn_blob: bytes, config: dict, checkpoint: dict = None,
+                 start_iteration: int = 0):
         import cloudpickle
 
         self._fn = cloudpickle.loads(fn_blob)
         self._config = config
-        self._ctx = _session.TrialContext()
+        self._ctx = _session.TrialContext(start_checkpoint=checkpoint)
+        # PBT exploit replaces the actor mid-run: the trial's time axis must
+        # continue from where the old actor stopped, not restart at 1
+        self._ctx.iteration = start_iteration
         self._status = "RUNNING"
         self._error = ""
         self._thread = threading.Thread(target=self._run, daemon=True)

@@ -8,17 +8,16 @@ Original implementation over ray_tpu actors and futures.
 from __future__ import annotations
 
 import itertools
+import multiprocessing as _stdlib_mp
 import os
-import time
 from typing import Any, Callable, Iterable, List, Optional
 
 import ray_tpu
 
-_CHUNK_ARGS = object()  # sentinel
 
-
-class TimeoutError(Exception):  # noqa: A001 — matches multiprocessing's name
-    pass
+class TimeoutError(_stdlib_mp.TimeoutError):  # noqa: A001 — drop-in parity
+    """Matches multiprocessing.TimeoutError so existing except clauses
+    written against the stdlib Pool keep catching it."""
 
 
 class AsyncResult:
@@ -205,14 +204,12 @@ class Pool:
                 pass
         self._actors = []
 
-    def join(self, timeout: float = 30.0):
+    def join(self):
+        """No outstanding-work tracking beyond AsyncResults: consumers hold
+        their own results, and terminate()/handle GC reap the actors — so
+        join only validates the close-before-join contract."""
         if not self._closed:
             raise ValueError("Pool is still running — call close() first")
-        deadline = time.time() + timeout
-
-        while self._actors and time.time() < deadline:
-            time.sleep(0.05)
-            break  # actors are killed lazily via GC of handles
 
     def __enter__(self) -> "Pool":
         return self

@@ -199,3 +199,43 @@ def test_dataset_feeds_training(ray_init, tmp_path):
     rows = [m["rows"] for m in result.metrics_history]
     assert sum(rows) == 256
     assert rows == [128, 128]
+
+
+def test_sort(ray_init):
+    ds = rd.from_items(
+        [{"k": int(x), "v": int(x) * 10} for x in [5, 3, 8, 1, 9, 2, 7, 0, 6, 4]],
+        parallelism=3,
+    )
+    out = ds.sort("k").take_all()
+    assert [r["k"] for r in out] == list(range(10))
+    assert [r["v"] for r in out] == [k * 10 for k in range(10)]
+    desc = ds.sort("k", descending=True).take_all()
+    assert [r["k"] for r in desc] == list(range(9, -1, -1))
+
+
+def test_groupby_aggregates(ray_init):
+    rows = [{"cat": c, "x": i} for i, c in enumerate("ababcacbc")]
+    ds = rd.from_items(rows, parallelism=3)
+
+    counts = {r["cat"]: r["count()"] for r in ds.groupby("cat").count().take_all()}
+    assert counts == {"a": 3, "b": 3, "c": 3}
+
+    sums = {r["cat"]: r["sum(x)"] for r in ds.groupby("cat").sum("x").take_all()}
+    assert sums == {"a": 0 + 2 + 5, "b": 1 + 3 + 7, "c": 4 + 6 + 8}
+
+    means = {r["cat"]: r["mean(x)"] for r in ds.groupby("cat").mean("x").take_all()}
+    assert means["a"] == pytest.approx((0 + 2 + 5) / 3)
+
+    mins = {r["cat"]: r["min(x)"] for r in ds.groupby("cat").min("x").take_all()}
+    maxs = {r["cat"]: r["max(x)"] for r in ds.groupby("cat").max("x").take_all()}
+    assert mins == {"a": 0, "b": 1, "c": 4}
+    assert maxs == {"a": 5, "b": 7, "c": 8}
+
+
+def test_global_aggregates(ray_init):
+    ds = rd.range(100, parallelism=4)  # rows {"id": i}
+    assert ds.sum("id") == sum(range(100))
+    assert ds.min("id") == 0
+    assert ds.max("id") == 99
+    assert ds.mean("id") == pytest.approx(49.5)
+    assert ds.std("id") == pytest.approx(np.std(np.arange(100), ddof=1))

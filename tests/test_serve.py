@@ -225,3 +225,68 @@ def test_replica_failure_recovery(ray_init):
     assert serve.status()["Fragile"]["running"] == 2
     handle._refresh(force=True)
     assert handle.remote().result(timeout=60) == "ok"
+
+
+def test_serve_batch(ray_init):
+    """@serve.batch coalesces single calls into one batched invocation
+    (reference: python/ray/serve/batching.py)."""
+    @serve.deployment
+    class Batcher:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 10 for x in items]
+
+        async def sizes(self):
+            return list(self.batch_sizes)
+
+    handle = serve.run(Batcher.bind())
+    refs = [handle.remote(i) for i in range(8)]
+    out = sorted(r.result(timeout=60) for r in refs)
+    assert out == [i * 10 for i in range(8)]
+    sizes = handle.method("sizes").remote().result(timeout=30)
+    assert sum(sizes) == 8
+    assert max(sizes) > 1, f"no batching happened: {sizes}"
+    serve.delete("Batcher")
+
+
+def test_serve_multiplex(ray_init):
+    """@serve.multiplexed LRU model loading + sticky model routing
+    (reference: python/ray/serve/multiplex.py)."""
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"model": model_id}
+
+        async def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return f"{model['model']}:{x}"
+
+        async def load_log(self):
+            return list(self.loads)
+
+    handle = serve.run(MultiModel.bind())
+    outs = [
+        handle.options(multiplexed_model_id="m1").remote(i).result(timeout=60)
+        for i in range(4)
+    ]
+    assert outs == [f"m1:{i}" for i in range(4)]
+    out2 = handle.options(multiplexed_model_id="m2").remote(9).result(timeout=60)
+    assert out2 == "m2:9"
+    # sticky routing: m1 was loaded exactly once across the replica pool
+    logs = [
+        handle._replicas[i].call_method.remote("load_log")
+        for i in range(len(handle._replicas))
+    ]
+    all_loads = sum(ray_tpu.get(logs, timeout=30), [])
+    assert all_loads.count("m1") == 1, all_loads
+    serve.delete("MultiModel")
