@@ -926,6 +926,8 @@ class ControlStore:
             )
         }
         placements: Dict[int, bytes] = {}
+        if rec.strategy == pb.PG_TOPOLOGY_STRICT_PACK:
+            return self._place_topology_strict(rec, avail)
         if rec.strategy in (pb.PG_STRICT_PACK,):
             for nid, a in avail.items():
                 need = ResourceSet()
@@ -952,6 +954,66 @@ class ControlStore:
             used_nodes.add(nid)
             avail[nid] = avail[nid] - b.resources
         return placements
+
+    def _place_topology_strict(
+        self, rec: PlacementGroupRecord, avail: Dict[bytes, ResourceSet]
+    ) -> Optional[Dict[int, bytes]]:
+        """ICI-topology-aware gang placement (reference:
+        topology_bundle_scheduling_policy.h:89): one bundle per host, hosts
+        chosen to minimize the ICI bounding box — a torus program's
+        collective latency scales with the block's extent, so (0,0),(0,1),
+        (0,2) beats any set including a far-away host. Greedy: for each
+        anchor host, grow by nearest manhattan distance; keep the set with
+        the smallest (max-distance, sum-distance) score. Bundle index i maps
+        to the i-th host in row-major coordinate order (gang rank ↔ physical
+        position, the property MEGASCALE mesh construction relies on)."""
+        n = len(rec.bundles)
+
+        def coord_of(nid: bytes):
+            raw = self.nodes[nid].labels.get(pb.TPU_COORD_LABEL)
+            if not raw:
+                return None
+            try:
+                return tuple(int(x) for x in raw.split(","))
+            except ValueError:
+                return None
+
+        # per-host feasibility: any bundle must fit any chosen host (one
+        # bundle lands per host; assignment is by rank, not by size)
+        candidates = [
+            (nid, coord)
+            for nid, a in avail.items()
+            for coord in [coord_of(nid)]
+            if coord is not None
+            and all(b.resources.is_subset_of(a) for b in rec.bundles)
+        ]
+        if len(candidates) < n:
+            return None
+
+        def dist(a, b):
+            return sum(abs(x - y) for x, y in zip(a, b))
+
+        best: Optional[tuple] = None
+        for anchor_nid, anchor in candidates:
+            ranked = sorted(
+                candidates, key=lambda cn: (dist(cn[1], anchor), cn[1])
+            )[:n]
+            # score the SET, not the anchor view: two hosts each at
+            # distance d from the anchor can be 2d apart, so the true ICI
+            # extent is the pairwise maximum
+            dmax = max(
+                (dist(a, b) for _, a in ranked for _, b in ranked),
+                default=0,
+            )
+            dsum = sum(dist(c, anchor) for _, c in ranked)
+            score = (dmax, dsum)
+            if best is None or score < best[0]:
+                best = (score, ranked)
+        chosen = sorted(best[1], key=lambda cn: cn[1])  # row-major rank order
+        return {
+            b.index: chosen[i][0]
+            for i, b in enumerate(sorted(rec.bundles, key=lambda b: b.index))
+        }
 
     async def _schedule_pg(self, rec: PlacementGroupRecord):
         deadline = time.monotonic() + GLOBAL_CONFIG.get("placement_group_timeout_s")

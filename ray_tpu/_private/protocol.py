@@ -362,6 +362,15 @@ PG_PACK = "PACK"
 PG_SPREAD = "SPREAD"
 PG_STRICT_PACK = "STRICT_PACK"
 PG_STRICT_SPREAD = "STRICT_SPREAD"
+# ICI-topology-aware gang placement (reference: raylet/scheduling/policy/
+# topology_bundle_scheduling_policy.h:89 TopologyStrictPackSchedulingPolicy):
+# one bundle per host, hosts chosen to form the tightest contiguous block in
+# the slice topology (labels carry per-host coordinates; see control_store
+# _place_bundles). Bundle index order follows row-major coordinate order so
+# gang ranks map onto physically adjacent hosts.
+PG_TOPOLOGY_STRICT_PACK = "TOPOLOGY_STRICT_PACK"
+# node label carrying the host's coordinates inside its slice, "x,y[,z]"
+TPU_COORD_LABEL = "rt.tpu.coord"
 
 PG_PENDING = "PENDING"
 PG_CREATED = "CREATED"

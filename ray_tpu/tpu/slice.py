@@ -25,6 +25,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu._private.protocol import TPU_COORD_LABEL
 from ray_tpu.util.placement_group import PlacementGroup, placement_group
 
 logger = logging.getLogger(__name__)
@@ -101,9 +102,26 @@ class SlicePlacementGroup:
                 host = head_node.get("address", "").rsplit(":", 1)[0]
                 self._coordinator = f"{host}:{self.megascale_port}"
             selector = {"tpu-slice-name": slice_name} if slice_name else None
+            # multi-host gangs use ICI-topology-aware placement when ENOUGH
+            # in-scope hosts advertise coordinates (rt.tpu.coord) to place
+            # every bundle — a partial label rollout must fall back to
+            # STRICT_SPREAD, not time out on an unplaceable topology PG
+            # (reference: topology_bundle_scheduling_policy.h:89)
+            labeled_in_scope = sum(
+                1 for n in node_info.values()
+                if TPU_COORD_LABEL in n.get("labels", {})
+                and (not slice_name
+                     or n.get("labels", {}).get("tpu-slice-name") == slice_name)
+            )
+            if self.hosts_per_slice <= 1:
+                strategy = "PACK"
+            elif labeled_in_scope >= self.hosts_per_slice:
+                strategy = "TOPOLOGY_STRICT_PACK"
+            else:
+                strategy = "STRICT_SPREAD"
             self._slice_pgs.append(placement_group(
                 bundles,
-                strategy="STRICT_SPREAD" if self.hosts_per_slice > 1 else "PACK",
+                strategy=strategy,
                 name=f"slice:{self.pod_type}:{slice_idx}",
                 bundle_label_selector=selector,
             ))

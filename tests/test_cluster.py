@@ -150,3 +150,29 @@ def test_placement_group_infeasible():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_topology_strict_pack_picks_contiguous_hosts(cluster):
+    """ICI-topology-aware gang placement (reference:
+    topology_bundle_scheduling_policy.h:89): bundles land on the hosts
+    forming the tightest contiguous coordinate block, rank-ordered
+    row-major — never on a distant host even if it has capacity."""
+    coords = {"0,0": None, "0,1": None, "7,7": None, "0,2": None}
+    for c in coords:
+        coords[c] = cluster.add_node(
+            resources={"CPU": 2, "TPU": 4},
+            labels={"rt.tpu.coord": c},
+        )
+    ray_tpu.init(address=cluster.address)
+
+    pg = placement_group(
+        [{"TPU": 4}] * 3, strategy="TOPOLOGY_STRICT_PACK")
+    assert pg.ready(timeout=60)
+    placements = pg.bundle_placements()
+    by_node_id = {coords[c].node_id: c for c in coords}
+    # rank order follows row-major coordinates; the distant 7,7 host is
+    # excluded despite having capacity
+    assert [by_node_id[placements[i]] for i in range(3)] == [
+        "0,0", "0,1", "0,2"
+    ], placements
+    remove_placement_group(pg)
