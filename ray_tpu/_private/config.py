@@ -136,6 +136,9 @@ _flag("control_store_persist", False, "Persist control-store state (nodes/actors
 _flag("control_store_wal_compact_every", 512, "WAL records between snapshot compactions.")
 _flag("lineage_cache_max_tasks", 4096, "Completed task specs kept per owner for lineage reconstruction of lost shm objects (reference: task_manager lineage pinning).")
 _flag("max_lineage_reconstructions", 3, "Times one lost object may be recomputed from lineage before get() raises ObjectLostError (reference: object_recovery_manager.h retry cap).")
+_flag("max_pending_lease_requests", 16, "In-flight lease requests per scheduling key (reference: normal_task_submitter.h:57 LeaseRequestRateLimiter) — recycled leases serve queued submissions; fetchers only prime the pump.")
+_flag("worker_lease_idle_s", 0.5, "Cached worker leases idle past this are returned to the daemon (reference: normal_task_submitter lease pools + idle lease timeout).")
+_flag("lease_pool_max_idle", 16, "Max granted-but-idle leases cached per scheduling key before extras are returned immediately.")
 _flag("device_object_transport", True, "Keep jax.Arrays HBM-resident through the object plane: same-process consumers get the original device array back (no h2d), others rebuild from host-staged bytes (reference: python/ray/experimental/rdt).")
 
 # --- chaos / fault injection (day 1, per SURVEY §4) ---

@@ -979,15 +979,26 @@ class ControlStore:
                 return None
 
         # per-host feasibility: any bundle must fit any chosen host (one
-        # bundle lands per host; assignment is by rank, not by size)
-        candidates = [
-            (nid, coord)
-            for nid, a in avail.items()
-            for coord in [coord_of(nid)]
-            if coord is not None
-            and all(b.resources.is_subset_of(a) for b in rec.bundles)
-        ]
-        if len(candidates) < n:
+        # bundle lands per host; assignment is by rank, not by size).
+        # Candidates are grouped by physical slice (tpu-slice-name label):
+        # coordinates are only meaningful WITHIN one slice — two slices both
+        # have a host at (0,0), and a "tight" set spanning slices has no ICI
+        # connectivity at all.
+        groups: Dict[str, list] = {}
+        for nid, a in avail.items():
+            coord = coord_of(nid)
+            if coord is None:
+                continue
+            if not all(b.resources.is_subset_of(a) for b in rec.bundles):
+                continue
+            slice_name = self.nodes[nid].labels.get("tpu-slice-name", "")
+            groups.setdefault(slice_name, []).append((nid, coord))
+        candidates = None
+        for members in groups.values():
+            if len(members) >= n:
+                candidates = (members if candidates is None
+                              else min(candidates, members, key=len))
+        if candidates is None:
             return None
 
         def dist(a, b):

@@ -19,6 +19,7 @@ zero-copy.
 from __future__ import annotations
 
 import asyncio
+import collections
 from ray_tpu._private.aio import spawn
 import functools
 import logging
@@ -48,7 +49,7 @@ from ray_tpu._private.errors import (
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.protocol import ResourceSet, SchedulingStrategy, TaskSpec
 from ray_tpu.runtime.object_store import META_ERROR, META_NORMAL, ShmObjectStore
-from ray_tpu.runtime.rpc import RpcClient, RpcServer
+from ray_tpu.runtime.rpc import RpcClient, RpcConnectionLost, RpcServer
 
 logger = logging.getLogger(__name__)
 
@@ -429,6 +430,14 @@ class CoreWorker:
         self._lineage_returns: Dict[bytes, bytes] = {}  # return oid -> tid
         self._lineage_live: Dict[bytes, int] = {}  # tid -> live return count
         self._reconstructing: Dict[bytes, asyncio.Future] = {}
+        # granted-but-idle worker leases by scheduling key, reused by the
+        # next same-shaped task (reference: normal_task_submitter lease
+        # pools). Each entry: {"idle": [lease...], "waiters": deque[Future]}.
+        # Released leases hand off DIRECTLY to a waiting submission —
+        # parking while submissions queue at the daemon would deadlock
+        # capacity behind the sweep period. Idle leases swept by
+        # _lease_pool_sweep.
+        self._lease_pools: Dict[tuple, dict] = {}
         self._actor_states: Dict[bytes, ActorHandleState] = {}
         self._owned_actor_handles: Dict[bytes, int] = {}
         self._bg_futures: set = set()
@@ -461,6 +470,13 @@ class CoreWorker:
             lambda: self.control.call("subscribe", {"channel": "actors"})
         )
         self._telemetry_task = spawn(self._telemetry_loop())
+        self._lease_sweep_task = spawn(self._lease_pool_sweep())
+        if self.mode == MODE_WORKER:
+            # fate-share with the node daemon (reference: workers die with
+            # their raylet — agent_manager/worker fate-sharing). An orphaned
+            # worker that outlives its daemon would keep accepting pushes
+            # and store returns into a store no daemon serves.
+            self._fate_task = spawn(self._daemon_fate_watch())
 
     async def _telemetry_loop(self):
         """Flush buffered task events + metric snapshots to the control
@@ -491,10 +507,48 @@ class CoreWorker:
                     # control store blip: keep the batch for the next flush
                     self.task_events.requeue(events)
 
+    async def _daemon_fate_watch(self):
+        """Exit the worker process when its daemon is gone (reference:
+        raylet↔worker fate sharing via the IPC socket). Wall-clock window,
+        not a probe count: under CPU starvation a loaded daemon can miss
+        several short probes while being perfectly alive — the bar matches
+        the cluster's own node-death declaration (health_check_timeout_s)."""
+        period = GLOBAL_CONFIG.get("health_check_period_s")
+        window = GLOBAL_CONFIG.get("health_check_timeout_s") * 1.5
+        first_fail = None
+        while not self._closed:
+            await asyncio.sleep(period)
+            try:
+                await self.daemon.call("ping", {}, timeout=period * 4)
+                first_fail = None
+            except Exception:  # noqa: BLE001 — daemon unreachable
+                now = time.monotonic()
+                if first_fail is None:
+                    first_fail = now
+                elif now - first_fail >= window:
+                    logger.error(
+                        "node daemon unreachable for %.0fs; worker exiting "
+                        "(fate-sharing)", now - first_fail)
+                    os._exit(1)
+
     async def close(self):
         self._closed = True
+        if getattr(self, "_fate_task", None) is not None:
+            self._fate_task.cancel()
         if self._telemetry_task is not None:
             self._telemetry_task.cancel()
+        if getattr(self, "_lease_sweep_task", None) is not None:
+            self._lease_sweep_task.cancel()
+        # return every cached lease so the daemons free the capacity now
+        # (snapshot: an in-flight submit can insert a pool key mid-await)
+        for pool in list(self._lease_pools.values()):
+            for lease in list(pool["idle"]):
+                try:
+                    await self._return_lease_quiet(
+                        lease["daemon_address"], lease["lease_id"])
+                except Exception:  # noqa: BLE001
+                    pass
+        self._lease_pools.clear()
         await self.server.stop()
         await self.control.close()
         await self.daemon.close()
@@ -1438,28 +1492,183 @@ class CoreWorker:
     def owns_oid(self, owner_worker_id: bytes) -> bool:
         return owner_worker_id == self.worker_id.binary()
 
+    def _lease_key(self, spec: TaskSpec) -> Optional[tuple]:
+        """Scheduling key: tasks of the same shape can reuse one lease
+        (reference: normal_task_submitter.h SchedulingKey lease pools).
+        None → never pool: SPREAD tasks must spread across nodes, and
+        reusing one granted worker would pin them to it."""
+        if spec.strategy.kind == pb.STRATEGY_SPREAD:
+            return None
+        return (
+            tuple(sorted(spec.resources.to_wire().items())),
+            tuple(sorted(
+                (k, str(v)) for k, v in spec.strategy.to_wire().items()
+            )),
+        )
+
+    def _pool_for(self, key: tuple) -> dict:
+        pool = self._lease_pools.get(key)
+        if pool is None:
+            pool = self._lease_pools[key] = {
+                "idle": [], "waiters": collections.deque(), "fetching": 0,
+            }
+        return pool
+
+    async def _pool_lease(self, key: tuple, spec: TaskSpec) -> dict:
+        """Take an idle cached lease, or register as a waiter while a
+        detached fetcher requests a fresh one — a lease released by a
+        finishing task is handed to the oldest waiter directly."""
+        pool = self._pool_for(key)
+        if pool["idle"]:
+            return pool["idle"].pop()
+        fut = self.loop.create_future()
+        pool["waiters"].append(fut)
+        # Bounded fetchers (reference: LeaseRequestRateLimiter): a burst of
+        # N submissions must not flood the daemon with N lease requests —
+        # recycled leases serve most waiters; fetchers only prime the pump.
+        if pool["fetching"] < min(
+            len(pool["waiters"]), GLOBAL_CONFIG.get("max_pending_lease_requests")
+        ):
+            pool["fetching"] += 1
+            spawn(self._lease_fetch(key, spec))
+        return await fut
+
+    async def _lease_fetch(self, key: tuple, spec: TaskSpec):
+        try:
+            lease = await self._acquire_lease(spec)
+        except Exception as e:  # noqa: BLE001 — deliver the failure
+            pool = self._lease_pools.get(key)
+            if pool:
+                pool["fetching"] = max(0, pool["fetching"] - 1)
+            delivered = False
+            while pool and pool["waiters"] and not delivered:
+                fut = pool["waiters"].popleft()
+                if not fut.done():
+                    fut.set_exception(e)
+                    delivered = True
+            # each failure fails exactly one waiter; keep priming so the
+            # REST eventually get a lease or their own failure instead of
+            # hanging with fetching==0 and nothing recycling
+            if pool and pool["waiters"] and pool["fetching"] < min(
+                len(pool["waiters"]),
+                GLOBAL_CONFIG.get("max_pending_lease_requests"),
+            ):
+                pool["fetching"] += 1
+                spawn(self._lease_fetch(key, spec))
+            return
+        pool = self._lease_pools.get(key)
+        if pool:
+            pool["fetching"] = max(0, pool["fetching"] - 1)
+            # keep priming while demand outstrips supply
+            if pool["waiters"] and pool["fetching"] < min(
+                len(pool["waiters"]),
+                GLOBAL_CONFIG.get("max_pending_lease_requests"),
+            ):
+                pool["fetching"] += 1
+                spawn(self._lease_fetch(key, spec))
+        lease["fresh"] = True  # straight from the daemon, never executed on
+        self._lease_pool_put(key, lease)
+
+    def _lease_pool_put(self, key: tuple, lease: dict):
+        pool = self._pool_for(key)
+        while pool["waiters"]:
+            fut = pool["waiters"].popleft()
+            if not fut.done():
+                fut.set_result(lease)
+                return
+        if len(pool["idle"]) >= GLOBAL_CONFIG.get("lease_pool_max_idle"):
+            self.schedule(self._return_lease_quiet(
+                lease["daemon_address"], lease["lease_id"]))
+            return
+        lease["idle_since"] = time.monotonic()
+        pool["idle"].append(lease)
+
     async def _submit_once(self, spec: TaskSpec):
         await self._wait_args_ready(spec)
-        lease = await self._acquire_lease(spec)
-        worker_addr = lease["worker_address"]
-        lease_id = lease["lease_id"]
-        daemon_addr = lease["daemon_address"]
-        sub = self._submissions.get(spec.task_id.binary())
-        if sub is not None:
-            sub["state"] = "running"
-            sub["worker"] = worker_addr
-        try:
-            client = await self._worker_client(worker_addr)
-            reply = await client.call("push_task", {"spec": spec.to_wire()}, timeout=None)
-        except (RpcError, ConnectionError) as e:
-            raise WorkerCrashedError(f"worker at {worker_addr} died mid-task: {e}") from e
-        finally:
+        key = self._lease_key(spec)
+        while True:
+            if key is None:
+                lease = await self._acquire_lease(spec)
+                lease["fresh"] = True
+            else:
+                lease = await self._pool_lease(key, spec)
+            # a recycled lease (another task already ran on its worker) can
+            # be stale; only those get the transparent-refresh retry below
+            cached = not lease.pop("fresh", False)
+            worker_addr = lease["worker_address"]
+            sub = self._submissions.get(spec.task_id.binary())
+            if sub is not None:
+                sub["state"] = "running"
+                sub["worker"] = worker_addr
             try:
-                dclient = await self._owner_client(daemon_addr)
-                await dclient.call("return_lease", {"lease_id": lease_id}, timeout=5)
-            except Exception:  # noqa: BLE001
-                pass
-        self._record_task_reply(spec, reply)
+                client = await self._worker_client(worker_addr)
+                reply = await client.call(
+                    "push_task", {"spec": spec.to_wire()}, timeout=None)
+            except (RpcError, ConnectionError) as e:
+                # never reuse a lease whose worker just failed
+                self.schedule(self._return_lease_quiet(
+                    lease["daemon_address"], lease["lease_id"]))
+                if cached:
+                    # a cached lease can be stale (worker reaped, node died
+                    # between tasks): siblings from the same daemon are
+                    # equally dead — drop them all, then retry with a fresh
+                    # lease rather than burning a task failure retry
+                    self._drop_pooled_leases_from(lease["daemon_address"])
+                    continue
+                raise WorkerCrashedError(
+                    f"worker at {worker_addr} died mid-task: {e}") from e
+            except BaseException:
+                # cancellation (ray_tpu.cancel of this submit, close()) must
+                # not strand the lease: the daemon would count the worker
+                # leased forever (the pre-pool code's finally did this)
+                self.schedule(self._return_lease_quiet(
+                    lease["daemon_address"], lease["lease_id"]))
+                raise
+            # success: recycle the lease — next same-shaped task skips the
+            # lease RPCs (reference: lease reuse + pipelining); the sweeper
+            # returns it if nothing claims it in time
+            if key is None:
+                self.schedule(self._return_lease_quiet(
+                    lease["daemon_address"], lease["lease_id"]))
+            else:
+                self._lease_pool_put(key, lease)
+            self._record_task_reply(spec, reply)
+            return
+
+    def _drop_pooled_leases_from(self, daemon_address: str):
+        """A worker from `daemon_address` just failed: every cached lease
+        from that daemon is suspect (node death kills them all at once)."""
+        for pool in self._lease_pools.values():
+            suspect = [
+                lease for lease in pool["idle"]
+                if lease["daemon_address"] == daemon_address
+            ]
+            if suspect:
+                pool["idle"] = [
+                    lease for lease in pool["idle"] if lease not in suspect
+                ]
+                for lease in suspect:
+                    self.schedule(self._return_lease_quiet(
+                        daemon_address, lease["lease_id"]))
+
+    async def _lease_pool_sweep(self):
+        """Return leases idle past worker_lease_idle_s so cached capacity
+        doesn't starve other drivers (reference: lease idle timeout)."""
+        period = GLOBAL_CONFIG.get("worker_lease_idle_s")
+        while not self._closed:
+            await asyncio.sleep(period / 2)
+            cutoff = time.monotonic() - period
+            for key, pool in list(self._lease_pools.items()):
+                keep = []
+                for lease in pool["idle"]:
+                    if lease["idle_since"] < cutoff:
+                        spawn(self._return_lease_quiet(
+                            lease["daemon_address"], lease["lease_id"]))
+                    else:
+                        keep.append(lease)
+                pool["idle"] = keep
+                if not keep and not pool["waiters"]:
+                    self._lease_pools.pop(key, None)
 
     def _record_task_reply(self, spec: TaskSpec, reply: dict):
         sub = self._submissions.get(spec.task_id.binary())
@@ -1588,6 +1797,12 @@ class CoreWorker:
             ObjectID(oid).hex(), spec.name or spec.function_key, n_rebuilt + 1,
         )
         try:
+            # never resubmit onto a cached lease from the failed node: an
+            # orphaned worker there may still accept the push and write the
+            # "recovered" object into a store no daemon serves
+            failed_loc = (cur or {}).get("daemon")
+            if failed_loc:
+                self._drop_pooled_leases_from(failed_loc)
             # clear only locations lost with the failed node, so healthy
             # sibling copies stay readable; waiters block on the fresh run
             for roid in spec.return_ids():
@@ -1636,7 +1851,18 @@ class CoreWorker:
         # request instead of double-granting
         request_key = os.urandom(16)
         while True:
-            client = await self._owner_client(address)
+            try:
+                client = await self._owner_client(address)
+            except (RpcConnectionLost, ConnectionError, OSError):
+                if address != self.daemon_address:
+                    # spillback target died before gossip caught up: route
+                    # back through the local daemon rather than failing the
+                    # submit (it re-picks from the refreshed view)
+                    address = self.daemon_address
+                    hops = 0
+                    await asyncio.sleep(0.2)
+                    continue
+                raise
             inner = spawn(self._lease_call_with_deadline(client, {
                 "resources": spec.resources.to_wire(),
                 "strategy": spec.strategy.to_wire(),
@@ -1653,6 +1879,17 @@ class CoreWorker:
                 inner.add_done_callback(
                     functools.partial(self._return_orphan_lease, address)
                 )
+                raise
+            except (RpcConnectionLost, ConnectionError):
+                # connection-level loss ONLY: a server-side error reply must
+                # still propagate (rerouting it would loop forever against a
+                # healthy-but-erroring daemon)
+                if address != self.daemon_address:
+                    # spillback daemon died mid-call: reroute via local
+                    address = self.daemon_address
+                    hops = 0
+                    await asyncio.sleep(0.2)
+                    continue
                 raise
             if reply.get("granted"):
                 reply["daemon_address"] = address

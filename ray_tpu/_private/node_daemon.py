@@ -1296,6 +1296,10 @@ class NodeDaemon:
             "num_pending_leases": len(self.pending),
         }
 
+    async def rpc_ping(self, conn_id: int, payload) -> dict:
+        """Liveness probe for worker fate-sharing watchdogs."""
+        return {"ok": True}
+
     async def rpc_drain(self, conn_id: int, payload) -> dict:
         """Graceful drain (reference: DrainRaylet node_manager.proto:510).
         Routed through the control store so the cluster-wide record agrees —

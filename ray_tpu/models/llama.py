@@ -401,48 +401,15 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, learning_rate: float = 3e-4,
         return (params, opt_state), loss
 
     data_sharding = jax.sharding.NamedSharding(mesh, data_spec())
-    replicated = jax.sharding.NamedSharding(mesh, P())
 
     def shard_state(state):
-        """Place a (params, opt_state) pytree onto the mesh.
-
-        Optimizer moments mirror the param tree inside optax's state, so each
-        moment leaf's key path ENDS with its param's key path — match on that
-        suffix (shape alone is ambiguous: wq/wk/wv/wo coincide whenever
-        n_heads*head_dim == dim, and a transposed spec would silently force a
-        per-step reshard of donated optimizer state).
-        """
-        from jax.tree_util import keystr, tree_flatten_with_path
+        """Place a (params, opt_state) pytree onto the mesh (moment leaves
+        matched to param shardings by key-path suffix — see
+        parallel.mesh.shard_train_state)."""
+        from ray_tpu.parallel.mesh import shard_train_state
 
         params, opt_state = state
-        params = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), params, param_shardings
-        )
-        param_paths = [
-            (keystr(path), leaf.shape, sharding)
-            for (path, leaf), sharding in zip(
-                tree_flatten_with_path(params)[0],
-                jax.tree.leaves(
-                    param_shardings,
-                    is_leaf=lambda s: isinstance(s, jax.sharding.NamedSharding),
-                ),
-            )
-        ]
-
-        def sharding_for(opt_path, x):
-            if not hasattr(x, "ndim") or x.ndim == 0:
-                return replicated
-            ks = keystr(opt_path)
-            for pk, shape, sharding in param_paths:
-                if ks.endswith(pk) and x.shape == shape:
-                    return sharding
-            return replicated
-
-        flat, treedef = tree_flatten_with_path(opt_state)
-        placed = [
-            jax.device_put(x, sharding_for(path, x)) for path, x in flat
-        ]
-        return params, jax.tree.unflatten(treedef, placed)
+        return shard_train_state(params, opt_state, param_shardings, mesh)
 
     jitted = jax.jit(train_step, donate_argnums=(0,))
     return init_state, shard_state, jitted, data_sharding

@@ -131,3 +131,46 @@ def host_local_mesh_info(mesh: Mesh) -> dict:
         "process_count": jax.process_count(),
         "local_coords": coords,
     }
+
+
+def shard_train_state(params, opt_state, param_shardings, mesh: Mesh):
+    """Place (params, opt_state) on `mesh`: params by their shardings,
+    optimizer moments by key-path suffix match against the param tree.
+
+    Moments mirror the param tree inside optax's state, so each moment
+    leaf's key path ENDS with its param's key path — match on that suffix
+    (shape alone is ambiguous: wq/wk/wv/wo coincide whenever
+    n_heads*head_dim == dim, and a transposed spec would silently force a
+    per-step reshard of donated optimizer state). Scalars and unmatched
+    leaves are replicated. Shared by every model's make_train_step
+    (models/llama.py, models/vit.py).
+    """
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    replicated = NamedSharding(mesh, P())
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), params, param_shardings
+    )
+    param_paths = [
+        (keystr(path), leaf.shape, sharding)
+        for (path, leaf), sharding in zip(
+            tree_flatten_with_path(params)[0],
+            jax.tree.leaves(
+                param_shardings,
+                is_leaf=lambda s: isinstance(s, NamedSharding),
+            ),
+        )
+    ]
+
+    def sharding_for(opt_path, x):
+        if not hasattr(x, "ndim") or x.ndim == 0:
+            return replicated
+        ks = keystr(opt_path)
+        for pk, shape, sharding in param_paths:
+            if ks.endswith(pk) and x.shape == shape:
+                return sharding
+        return replicated
+
+    flat, treedef = tree_flatten_with_path(opt_state)
+    placed = [jax.device_put(x, sharding_for(path, x)) for path, x in flat]
+    return params, jax.tree.unflatten(treedef, placed)

@@ -1,0 +1,74 @@
+"""ViT model family: forward parity, sharded training step, learning signal
+(reference workload: Ray Train image-classification benchmark,
+doc/source/train/benchmarks.rst:31-47)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.vit import (
+    ViTConfig,
+    forward,
+    init_params,
+    make_train_step,
+    patchify,
+)
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+def test_patchify_layout():
+    cfg = ViTConfig.tiny()
+    img = jnp.arange(2 * 32 * 32 * 3, dtype=jnp.float32).reshape(2, 32, 32, 3)
+    patches = patchify(cfg, img)
+    assert patches.shape == (2, cfg.num_patches, cfg.patch_dim)
+    # first patch = top-left 8x8 block, row-major
+    np.testing.assert_array_equal(
+        np.asarray(patches[0, 0]).reshape(8, 8, 3), np.asarray(img[0, :8, :8]))
+
+
+def test_forward_shapes_and_param_count():
+    cfg = ViTConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert n == cfg.num_params()
+    img = jax.random.normal(jax.random.key(1), (3, 32, 32, 3))
+    logits = forward(cfg, params, img)
+    assert logits.shape == (3, cfg.num_classes)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_sharded_train_step_learns():
+    cfg = ViTConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                         attention_impl="xla")
+    mesh = MeshSpec(dp=2, fsdp=2, tp=2, sp=1).build(jax.devices()[:8])
+    init_state, shard_state, train_step, (img_sh, lbl_sh) = make_train_step(
+        cfg, mesh, learning_rate=1e-2)
+    state = shard_state(init_state(jax.random.key(0)))
+    # a tiny fixed batch: loss must drop when overfitting it
+    images = jax.device_put(
+        jax.random.normal(jax.random.key(1), (8, 32, 32, 3)), img_sh)
+    labels = jax.device_put(
+        jax.random.randint(jax.random.key(2), (8,), 0, cfg.num_classes,
+                           dtype=jnp.int32), lbl_sh)
+    state, first = train_step(state, images, labels)
+    for _ in range(30):
+        state, loss = train_step(state, images, labels)
+    assert float(loss) < float(first) * 0.5, (float(first), float(loss))
+
+
+def test_flash_vs_xla_forward_parity():
+    """The non-causal flash path must match plain attention (CPU exercises
+    the XLA fallback of the same code path; parity on TPU is covered by the
+    kernel's own tests)."""
+    cfg_x = ViTConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                           attention_impl="xla")
+    cfg_f = ViTConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                           attention_impl="flash")
+    params = init_params(cfg_x, jax.random.key(0))
+    img = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    np.testing.assert_allclose(
+        np.asarray(forward(cfg_x, params, img)),
+        np.asarray(forward(cfg_f, params, img)),
+        rtol=2e-4, atol=2e-4,
+    )
