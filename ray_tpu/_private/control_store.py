@@ -1144,13 +1144,118 @@ class ControlStore:
         return {"ok": True}
 
 
+def _leader_lock_file(persist_dir: str):
+    os.makedirs(persist_dir, exist_ok=True)
+    return open(os.path.join(persist_dir, "LEADER"), "a+")
+
+
+def _try_flock(f) -> bool:
+    import fcntl
+
+    try:
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        return True
+    except OSError:
+        return False
+
+
+async def _acquire_leadership(persist_dir: str, blocking: bool):
+    """Exclusive flock on <persist_dir>/LEADER (reference: gcs
+    leader_election/leader_elector.h via k8s Lease objects — here the
+    shared persist dir IS the coordination medium). Blocking mode parks in
+    a thread on the kernel lock, waking the instant the leader dies.
+    Returns the held file object (the lock lives as long as the process),
+    or None when non-blocking and another control store leads."""
+    import fcntl
+
+    f = _leader_lock_file(persist_dir)
+    if not _try_flock(f):
+        if not blocking:
+            f.close()
+            return None
+        await asyncio.to_thread(fcntl.flock, f.fileno(), fcntl.LOCK_EX)
+    f.seek(0)
+    f.truncate()
+    f.write(f"pid={os.getpid()}\n")
+    f.flush()
+    return f
+
+
+async def _wait_port_free(host: str, port: int, timeout_s: float = 60.0):
+    """Wait for the dead leader's listening socket to vanish; only
+    EADDRINUSE is retried — any other bind error (bad host, port owned by
+    an unrelated service) must surface instead of wedging the failover
+    silently while we hold the leadership lock."""
+    import errno
+    import socket
+
+    deadline = time.monotonic() + timeout_s
+    attempt = 0
+    while True:
+        probe = socket.socket()
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            probe.bind((host, port))
+            return
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE:
+                raise
+            attempt += 1
+            if attempt % 10 == 1:
+                logger.warning(
+                    "takeover address %s:%d still bound; waiting", host, port)
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"takeover address {host}:{port} never freed up "
+                    f"(held by a process that is not the dead leader?)")
+        finally:
+            probe.close()
+        await asyncio.sleep(0.5)
+
+
 async def run_control_store(host: str, port: int, ready_file: Optional[str] = None,
-                            persist_dir: Optional[str] = None):
+                            persist_dir: Optional[str] = None,
+                            standby: bool = False):
+    """Serve the control store; with `standby=True`, block on the
+    leadership lock, wait for the leader's port to free, then recover from
+    the shared WAL ONCE and serve at the SAME address — clients'
+    auto-reconnect finds the new incumbent without re-configuration
+    (reference: GCS HA = leader election + Redis/RocksDB-backed state +
+    NotifyGCSRestart fan-out; here the restart notification is the daemons'
+    re-register-on-unknown heartbeat path)."""
+    lock = None
+    if standby:
+        if not persist_dir or port == 0:
+            raise ValueError(
+                "standby mode needs --persist-dir (shared WAL) and a fixed "
+                "--port (takeover address)")
+        GLOBAL_CONFIG.apply_system_config({"control_store_persist": True})
+        lock = await _acquire_leadership(persist_dir, blocking=True)
+        logger.info("standby won leadership")
+        if not any(
+            name != "LEADER" for name in os.listdir(persist_dir)
+        ):
+            logger.error(
+                "taking over %s but it holds no WAL/snapshot — the old "
+                "leader persisted nothing; serving EMPTY state", persist_dir)
+        # recovery must run exactly once: re-running it per bind retry
+        # would replay the WAL onto populated tables and double-spawn
+        # pending actor/PG scheduling
+        await _wait_port_free(host, port)
+    elif persist_dir:
+        # the active leader always marks leadership, persist flag or not —
+        # otherwise a standby pointed here would instantly "win" while the
+        # leader is alive
+        lock = await _acquire_leadership(persist_dir, blocking=False)
+        if lock is None:
+            raise RuntimeError(
+                f"another control store already leads {persist_dir}")
     store = ControlStore(persist_dir=persist_dir)
     addr = await store.start(host, port)
     if ready_file:
         with open(ready_file, "w") as f:
             json.dump({"address": addr}, f)
+    _ = lock  # pinned for process lifetime
     await asyncio.Event().wait()  # run forever
 
 
@@ -1164,6 +1269,9 @@ def main():
     parser.add_argument("--config-json", default="")
     parser.add_argument("--log-level", default="INFO")
     parser.add_argument("--persist-dir", default=None)
+    parser.add_argument("--standby", action="store_true",
+                        help="wait for leadership over --persist-dir, then "
+                             "take over serving at --host:--port")
     args = parser.parse_args()
     logging.basicConfig(
         level=os.environ.get("RT_LOG_LEVEL", args.log_level),
@@ -1173,7 +1281,8 @@ def main():
         GLOBAL_CONFIG.load_overrides(args.config_json)
     try:
         asyncio.run(run_control_store(
-            args.host, args.port, args.ready_file, persist_dir=args.persist_dir
+            args.host, args.port, args.ready_file,
+            persist_dir=args.persist_dir, standby=args.standby,
         ))
     except KeyboardInterrupt:
         pass

@@ -232,3 +232,155 @@ def test_control_store_restart_actors_keep_serving():
         assert ray_tpu.get(c.incr.remote(), timeout=30) == 4
     finally:
         ray_tpu.shutdown()
+
+
+def test_control_store_standby_failover(tmp_path):
+    """HA standby: a second control store waits on the shared persist dir's
+    leadership lock; when the leader dies it recovers the WAL and serves at
+    the SAME address, so reconnecting clients find the new incumbent
+    (reference: gcs leader_election + store-backed state + restart
+    notification fan-out)."""
+    import json as _json
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time as _t
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    persist = str(tmp_path / "cs")
+    cfg = _json.dumps({"control_store_persist": True})
+    ready1 = str(tmp_path / "r1.json")
+    ready2 = str(tmp_path / "r2.json")
+    argv = [sys.executable, "-m", "ray_tpu._private.control_store",
+            "--port", str(port), "--persist-dir", persist,
+            "--config-json", cfg]
+    from ray_tpu._private.node import _wait_ready
+
+    leader = subprocess.Popen(argv + ["--ready-file", ready1])
+    standby = None
+    try:
+        addr = _wait_ready(ready1, leader)["address"]
+
+        standby = subprocess.Popen(argv + ["--ready-file", ready2, "--standby"])
+
+        import asyncio as aio
+
+        from ray_tpu.runtime.rpc import RpcClient
+
+        async def put_state():
+            c = RpcClient(addr, name="test")
+            await c.connect()
+            await c.call("kv_put", {"ns": "ha", "key": b"k", "value": b"v1"})
+            job = await c.call("add_job", {"driver_address": ""})
+            await c.close()
+            return job["job_id"]
+
+        job_id = aio.run(put_state())
+        _t.sleep(0.5)  # standby must still be waiting, not serving
+        assert not os.path.exists(ready2)
+
+        leader.send_signal(signal.SIGKILL)
+        leader.wait(timeout=10)
+
+        addr2 = _wait_ready(ready2, standby)["address"]
+        assert addr2 == addr, "takeover must reuse the leader's address"
+
+        async def read_state():
+            c = RpcClient(addr, name="test2")
+            await c.connect()
+            kv = await c.call("kv_get", {"ns": "ha", "key": b"k"})
+            jobs = await c.call("get_all_jobs", {})
+            await c.close()
+            return kv, jobs
+
+        kv, jobs = aio.run(read_state())
+        assert kv["value"] == b"v1", "KV state lost across failover"
+        assert any(j["job_id"] == job_id for j in jobs["jobs"]), (
+            "job record lost across failover")
+    finally:
+        for proc in (leader, standby):
+            if proc is not None:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+
+
+def test_cluster_failover_to_standby(tmp_path):
+    """Full-cluster HA: actor calls (worker-direct) ride through the
+    failover; the standby recovers named-actor state from the WAL; daemons
+    re-register with the new incumbent and new tasks schedule."""
+    import json as _json
+    import socket
+    import subprocess
+    import sys
+    import time as _t
+
+    import ray_tpu
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    GLOBAL_CONFIG.apply_system_config({"control_store_persist": True})
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    session = node_mod.new_session_dir()
+    cs_proc, addr = node_mod.start_control_store(session, port=port)
+    persist = os.path.join(session, "control_store")
+    ready2 = os.path.join(session, "standby_ready.json")
+    standby = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.control_store",
+         "--port", str(port), "--persist-dir", persist,
+         "--config-json", GLOBAL_CONFIG.serialize_overrides(),
+         "--ready-file", ready2, "--standby"],
+        start_new_session=True)
+    nd_proc = None
+    try:
+        nd_proc, _ = node_mod.start_node_daemon(
+            addr, session, resources={"CPU": 4})
+        ray_tpu.init(address=addr)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="ha-counter").remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+
+        from ray_tpu._private.node import _wait_ready
+
+        cs_proc.kill()
+        cs_proc.wait(timeout=10)
+        assert _wait_ready(ready2, standby)["address"] == addr
+
+        # worker-direct actor path unaffected by the control-plane blip
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 2
+        _t.sleep(3)  # daemon re-register beat with the new incumbent
+        h = ray_tpu.get_actor("ha-counter")  # recovered from the WAL
+        assert ray_tpu.get(h.incr.remote(), timeout=60) == 3
+
+        @ray_tpu.remote
+        def ping():
+            return "pong"
+
+        assert ray_tpu.get(ping.remote(), timeout=120) == "pong"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        for proc in (standby, nd_proc):
+            if proc is not None:
+                try:
+                    node_mod.kill_process(proc)
+                except Exception:
+                    pass
+        GLOBAL_CONFIG.apply_system_config({"control_store_persist": False})
