@@ -213,6 +213,8 @@ class NodeDaemon:
             self._on_node_update(nw)
         self._tasks.append(spawn(self._heartbeat_loop()))
         self._tasks.append(spawn(self._reap_loop()))
+        if GLOBAL_CONFIG.get("log_to_driver"):
+            self._tasks.append(spawn(self._log_forward_loop()))
         if GLOBAL_CONFIG.get("object_spill_enabled"):
             os.makedirs(self.spill_dir, exist_ok=True)
             self._tasks.append(spawn(self._spill_loop()))
@@ -342,6 +344,51 @@ class NodeDaemon:
                     w = self.workers.get(wid)
                     if w is not None and w.state == W_IDLE:
                         self._kill_worker_proc(w, "idle reaping")
+
+    async def _log_forward_loop(self):
+        """Tail workers' stdout/stderr files and push fresh lines to the
+        control store's per-job log channel (reference: log_monitor.py
+        tailing + GCS pubsub; drivers print them via print_worker_logs)."""
+        offsets: Dict[Tuple[bytes, str], int] = {}
+        while not self._stopped:
+            await asyncio.sleep(0.5)
+            for w in list(self.workers.values()):
+                short = w.worker_id.hex()[:12]
+                for suffix in (".out", ".err"):
+                    path = os.path.join(
+                        self.session_dir, "logs", f"worker-{short}{suffix}")
+                    key = (w.worker_id.binary(), suffix)
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue
+                    off = offsets.get(key, 0)
+                    if size <= off:
+                        continue
+                    try:
+                        with open(path, "rb") as f:
+                            f.seek(off)
+                            chunk = f.read(min(size - off, 256 * 1024))
+                    except OSError:
+                        continue
+                    offsets[key] = off + len(chunk)
+                    lines = chunk.decode("utf-8", "replace").splitlines()
+                    if not lines:
+                        continue
+                    try:
+                        await self.control.call("publish_logs", {
+                            "job_id": w.job_id,
+                            "worker_id": w.worker_id.binary(),
+                            "node_id": self.node_id.hex(),
+                            "stream": suffix[1:],
+                            "lines": lines[:200],
+                        }, timeout=5)
+                    except Exception:  # noqa: BLE001 — control blip; retry next tick
+                        offsets[key] = off  # re-read the chunk next round
+            # drop offsets of forgotten workers
+            live = {w.worker_id.binary() for w in self.workers.values()}
+            for key in [k for k in offsets if k[0] not in live]:
+                offsets.pop(key, None)
 
     # ------------------------------------------------------------------
     # worker pool (reference: worker_pool.h:284)
