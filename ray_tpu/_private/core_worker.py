@@ -1531,7 +1531,21 @@ class CoreWorker:
         ):
             pool["fetching"] += 1
             spawn(self._lease_fetch(key, spec))
-        return await fut
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            # Cancelled in the window after _lease_pool_put resolved this
+            # future but before this coroutine resumed: the delivered lease
+            # would otherwise be orphaned — never re-pooled, never returned —
+            # permanently leaking that worker's capacity (advisor r2).
+            if fut.done() and not fut.cancelled() and fut.exception() is None:
+                self._lease_pool_put(key, fut.result())
+            else:
+                try:
+                    pool["waiters"].remove(fut)
+                except ValueError:
+                    pass
+            raise
 
     async def _lease_fetch(self, key: tuple, spec: TaskSpec):
         try:
@@ -1885,7 +1899,16 @@ class CoreWorker:
                 # still propagate (rerouting it would loop forever against a
                 # healthy-but-erroring daemon)
                 if address != self.daemon_address:
-                    # spillback daemon died mid-call: reroute via local
+                    # spillback daemon died mid-call: reroute via local.
+                    # It may have granted just before the blip — request_key
+                    # idempotency is per-daemon, so the rerouted request
+                    # would double-grant and leak the first worker forever
+                    # (advisor r2). Best-effort release of the possible
+                    # orphan, and a fresh key so a future spillback back to
+                    # this daemon can't attach to the released grant.
+                    spawn(self._cancel_lease_request_quiet(
+                        address, request_key))
+                    request_key = os.urandom(16)
                     address = self.daemon_address
                     hops = 0
                     await asyncio.sleep(0.2)
@@ -1917,6 +1940,10 @@ class CoreWorker:
             if reply.get("retry"):
                 await asyncio.sleep(0.2)
                 address = self.daemon_address
+                # fresh routing attempt: without this, spillback→retry cycles
+                # accumulate hops to the cap and the local daemon then queues
+                # the lease locally even when only a remote node can host it
+                hops = 0
                 continue
             raise RayTpuError(f"lease request failed: {reply}")
 
@@ -1941,6 +1968,24 @@ class CoreWorker:
                     await asyncio.sleep(0.05)
                     continue
                 raise
+
+    async def _cancel_lease_request_quiet(
+        self, daemon_address: str, request_key: bytes
+    ):
+        """Ask `daemon_address` to release whatever lease it may have granted
+        under `request_key` (the connection died mid-request_lease and the
+        caller rerouted, so a grant would never be claimed). Best-effort with
+        brief retries — the daemon was reachable moments ago and connection
+        blips heal; if it truly died, its leases die with it."""
+        for _ in range(5):
+            try:
+                client = await self._owner_client(daemon_address)
+                await client.call(
+                    "cancel_lease_request",
+                    {"request_key": request_key}, timeout=5.0)
+                return
+            except Exception:  # noqa: BLE001 — best-effort
+                await asyncio.sleep(0.5)
 
     def _return_orphan_lease(self, daemon_address: str, t: asyncio.Task):
         if t.cancelled() or t.exception() is not None:

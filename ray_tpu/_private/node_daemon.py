@@ -825,6 +825,34 @@ class NodeDaemon:
         self._release_lease(payload["lease_id"])
         return {"ok": True}
 
+    async def rpc_cancel_lease_request(self, conn_id: int, payload: dict) -> dict:
+        """Release whatever grant `request_key` produced (or will produce):
+        the caller lost its connection mid-request_lease and rerouted, so a
+        grant under this key is unclaimable — without this it leaks the
+        worker forever (reference: NormalTaskSubmitter cancels pending lease
+        requests it abandons). Idempotent; unknown keys are a no-op."""
+        key = payload.get("request_key")
+        task = self._lease_requests.get(key) if key is not None else None
+        if task is None:
+            return {"ok": True}
+
+        def _release(t, key=key):
+            reply = None if t.cancelled() or t.exception() else t.result()
+            # pop the key directly: in the done-task race window _settle may
+            # not have cached the lease_id↔key mapping yet, and relying on
+            # _release_lease's map-based pop would leak both entries
+            self._lease_requests.pop(key, None)
+            if reply is not None and reply.get("granted"):
+                self._lease_key_by_id.pop(reply["lease_id"], None)
+                self._release_lease(reply["lease_id"])
+
+        # Always via add_done_callback — even for a done task it schedules
+        # through call_soon, which queues AFTER any pending _settle callback
+        # from rpc_request_lease; running _release first would let _settle
+        # re-cache a stale lease_id↔key entry for the released lease.
+        task.add_done_callback(_release)
+        return {"ok": True}
+
     async def rpc_kill_worker(self, conn_id: int, payload: dict) -> dict:
         w = self.workers.get(payload["worker_id"])
         if w is None:

@@ -390,17 +390,31 @@ class Dataset:
                 np.asarray([r[col] for r in block_rows(block)])
             )
             if v.size == 0:
-                return (0, 0.0, 0.0, None, None)
-            return (int(v.size), float(v.sum()), float((v.astype(np.float64) ** 2).sum()),
-                    v.min().item(), v.max().item())
+                # None (not 0.0) so an empty block can't masquerade as a
+                # numeric contribution on a non-numeric column
+                return (0, None, None, None, None)
+            # String keys are legal sort()/min()/max() inputs; only numeric
+            # dtypes have a sum / sum-of-squares (advisor r2).
+            if np.issubdtype(v.dtype, np.number) or v.dtype == np.bool_:
+                total = float(v.sum())
+                sq = float((v.astype(np.float64) ** 2).sum())
+                mn, mx = v.min().item(), v.max().item()
+            else:
+                # np.min has no ufunc loop for str/object dtypes
+                total = sq = None
+                vals = v.tolist()
+                mn, mx = min(vals), max(vals)
+            return (int(v.size), total, sq, mn, mx)
 
         parts = ray_tpu.get(
             [RemoteFunction(_stats).remote(r) for r in self._block_refs()],
             timeout=600,
         )
         n = sum(p[0] for p in parts)
-        total = sum(p[1] for p in parts)
-        sq = sum(p[2] for p in parts)
+        sums = [p[1] for p in parts if p[1] is not None]
+        sqs = [p[2] for p in parts if p[2] is not None]
+        total = sum(sums) if sums else None
+        sq = sum(sqs) if sqs else None
         mins = [p[3] for p in parts if p[3] is not None]
         maxs = [p[4] for p in parts if p[4] is not None]
         return n, total, sq, (min(mins) if mins else None), (max(maxs) if maxs else None)
@@ -410,7 +424,7 @@ class Dataset:
 
     def mean(self, col: str):
         n, total, *_ = self._column_stats(col)
-        return total / n if n else None
+        return total / n if (n and total is not None) else None
 
     def min(self, col: str):
         return self._column_stats(col)[3]
@@ -420,7 +434,7 @@ class Dataset:
 
     def std(self, col: str, ddof: int = 1):
         n, total, sq, _, _ = self._column_stats(col)
-        if n <= ddof:
+        if n <= ddof or total is None or sq is None:
             return None
         mean = total / n
         return float(np.sqrt(max(0.0, (sq - n * mean * mean) / (n - ddof))))

@@ -77,7 +77,13 @@ def _rebuild_device_array(tid: bytes, host: Any) -> Any:
     elsewhere → upload the host staging copy."""
     arr = device_object_manager().lookup(tid)
     if arr is not None:
-        return arr
+        # A producer that donated its array to a jitted step after put()
+        # (donate_argnums — the standard training loop) leaves a deleted
+        # buffer registered here; handing it out would fail gets that the
+        # host staging bytes can serve (advisor r2).
+        deleted = getattr(arr, "is_deleted", None)
+        if deleted is None or not deleted():
+            return arr
     import jax
 
     return jax.device_put(host)

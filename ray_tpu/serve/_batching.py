@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import inspect
+import weakref
 from typing import Any, Callable, Dict, List, Optional
 
 
@@ -22,10 +23,17 @@ class _BatchQueue:
         self.timer: Optional[asyncio.TimerHandle] = None
 
 
-# queues for batched FREE functions, keyed by wrapper identity. Module-level
-# (not closure state): the wrapper travels to replicas by value via
-# cloudpickle, and runtime queue state must not ride along.
-_free_queues: Dict[int, _BatchQueue] = {}
+# queues for batched FREE functions, keyed WEAKLY by the wrapper function
+# object — NOT by id(): CPython reuses ids after gc, which would cross-wire a
+# new function's batch queue with a dead one's leftover state (advisor r2).
+# Weak keying makes cleanup automatic in EVERY process the wrapper lands in
+# (a cloudpickled copy on a replica is its own key; a weakref.finalize
+# registered at decoration time would not survive the pickle round-trip).
+# Module-level (not closure state): runtime queue state must not ride along
+# when the wrapper travels to replicas by value via cloudpickle.
+_free_queues: "weakref.WeakKeyDictionary[Callable, _BatchQueue]" = (
+    weakref.WeakKeyDictionary()
+)
 
 
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
@@ -37,11 +45,17 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
         params = list(inspect.signature(fn).parameters)
         is_method = bool(params) and params[0] == "self"
 
-        def queue_for(self_obj, wrapper_id: int) -> _BatchQueue:
+        def queue_for(self_obj, wrapper: Callable) -> _BatchQueue:
             if self_obj is None:
-                q = _free_queues.get(wrapper_id)
+                # resolve the registry through the module at call time:
+                # naming the global here would make cloudpickle capture the
+                # (unpicklable, process-local) WeakKeyDictionary by value
+                # when the wrapper ships to a replica
+                from ray_tpu.serve import _batching
+
+                q = _batching._free_queues.get(wrapper)
                 if q is None:
-                    q = _free_queues[wrapper_id] = _BatchQueue()
+                    q = _batching._free_queues[wrapper] = _BatchQueue()
                 return q
             # per-instance state lives ON the instance (picklable classes
             # must not capture queues in the decorator closure)
@@ -87,7 +101,7 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
                 (item,) = call_args
                 self_obj = None
             loop = asyncio.get_running_loop()
-            q = queue_for(self_obj, id(wrapper))
+            q = queue_for(self_obj, wrapper)
             fut = loop.create_future()
             q.items.append((item, fut))
             if len(q.items) >= max_batch_size:

@@ -1,0 +1,297 @@
+"""Regression tests for the round-3 advisor fixes:
+
+- _pool_lease: lease delivered to a cancelled waiter is re-pooled, not leaked
+- _acquire_lease reroute: possibly-granted lease on a dead-connection
+  spillback daemon is released via cancel_lease_request (daemon-side RPC)
+- RDT: deleted device buffers (donate_argnums) fall back to host staging
+- Dataset.min/max on string columns
+- @serve.batch free-function queues keyed by token, cleaned up on gc
+"""
+
+import asyncio
+import gc
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# lease-pool cancellation window (advisor r2 #1)
+# ---------------------------------------------------------------------------
+
+
+class _PoolStub:
+    """Minimal surface _pool_lease/_lease_pool_put touch, bound to the real
+    CoreWorker method objects so the test exercises production code."""
+
+    def __init__(self):
+        from ray_tpu._private.core_worker import CoreWorker
+
+        self.loop = asyncio.get_running_loop()
+        self._lease_pools = {}
+        self.returned = []
+        self._pool_for = CoreWorker._pool_for.__get__(self)
+        self._pool_lease = CoreWorker._pool_lease.__get__(self)
+        self._lease_pool_put = CoreWorker._lease_pool_put.__get__(self)
+
+    async def _lease_fetch(self, key, spec):  # never completes in the test
+        await asyncio.sleep(3600)
+
+    def schedule(self, coro):
+        coro.close()
+        self.returned.append(coro)
+
+
+def test_pool_lease_cancel_repools_delivered_lease():
+    async def scenario():
+        stub = _PoolStub()
+        key = ("cpu",)
+        waiter = asyncio.ensure_future(stub._pool_lease(key, None))
+        await asyncio.sleep(0)  # waiter registered, fetcher parked
+        lease = {"daemon_address": "d", "lease_id": b"L", "worker_address": "w"}
+        stub._lease_pool_put(key, lease)  # resolves the waiter's future
+        waiter.cancel()  # …in the window before the waiter resumes
+        with pytest.raises(asyncio.CancelledError):
+            await waiter
+        pool = stub._lease_pools[key]
+        # the delivered lease must be back in the pool (or handed to another
+        # waiter) — NOT orphaned
+        assert pool["idle"] == [lease] or stub.returned
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_pool_lease_cancel_before_delivery_removes_waiter():
+    async def scenario():
+        stub = _PoolStub()
+        key = ("cpu",)
+        waiter = asyncio.ensure_future(stub._pool_lease(key, None))
+        await asyncio.sleep(0)
+        waiter.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await waiter
+        assert not stub._lease_pools[key]["waiters"]  # no dead futures pile up
+        return True
+
+    assert asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# cancel_lease_request daemon RPC (advisor r2 #2)
+# ---------------------------------------------------------------------------
+
+
+class _DaemonStub:
+    def __init__(self):
+        from ray_tpu._private.node_daemon import NodeDaemon
+
+        self._lease_requests = {}
+        self._lease_key_by_id = {}
+        self.released = []
+        self.rpc_cancel_lease_request = (
+            NodeDaemon.rpc_cancel_lease_request.__get__(self)
+        )
+
+    def _release_lease(self, lease_id):
+        self.released.append(lease_id)
+
+
+def test_cancel_lease_request_releases_completed_grant():
+    async def scenario():
+        stub = _DaemonStub()
+
+        async def granted():
+            return {"granted": True, "lease_id": b"L1"}
+
+        t = asyncio.ensure_future(granted())
+        await t
+        stub._lease_requests[b"k1"] = t
+        out = await stub.rpc_cancel_lease_request(0, {"request_key": b"k1"})
+        assert out["ok"]
+        await asyncio.sleep(0)  # release defers via call_soon (after _settle)
+        assert stub.released == [b"L1"]
+        assert b"k1" not in stub._lease_requests
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_cancel_lease_request_releases_late_grant():
+    """Cancel arrives while the request is still queued: the grant must be
+    released the moment it lands."""
+
+    async def scenario():
+        stub = _DaemonStub()
+        gate = asyncio.Event()
+
+        async def granted_later():
+            await gate.wait()
+            return {"granted": True, "lease_id": b"L2"}
+
+        t = asyncio.ensure_future(granted_later())
+        stub._lease_requests[b"k2"] = t
+        out = await stub.rpc_cancel_lease_request(0, {"request_key": b"k2"})
+        assert out["ok"] and stub.released == []
+        gate.set()
+        await t
+        await asyncio.sleep(0)  # let done-callbacks run
+        assert stub.released == [b"L2"]
+        assert b"k2" not in stub._lease_requests
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_cancel_lease_request_unknown_key_noop():
+    async def scenario():
+        stub = _DaemonStub()
+        out = await stub.rpc_cancel_lease_request(0, {"request_key": b"nope"})
+        assert out["ok"] and stub.released == []
+        return True
+
+    assert asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# RDT deleted-buffer fallback (advisor r2 #3)
+# ---------------------------------------------------------------------------
+
+
+def test_rdt_deleted_buffer_falls_back_to_host():
+    from ray_tpu.experimental.rdt import (
+        _rebuild_device_array,
+        device_object_manager,
+    )
+
+    class DonatedArray:
+        """Stands in for a jax.Array whose buffer was donated to a jit."""
+
+        def is_deleted(self):
+            return True
+
+    tid = device_object_manager().register(DonatedArray())
+    host = np.arange(4, dtype=np.int32)
+    out = _rebuild_device_array(tid, host)
+    assert not isinstance(out, DonatedArray)
+    assert np.asarray(out).tolist() == [0, 1, 2, 3]
+
+
+def test_rdt_live_buffer_returned_same_process():
+    from ray_tpu.experimental.rdt import (
+        _rebuild_device_array,
+        device_object_manager,
+    )
+
+    class LiveArray:
+        def is_deleted(self):
+            return False
+
+    arr = LiveArray()
+    tid = device_object_manager().register(arr)
+    assert _rebuild_device_array(tid, np.zeros(1)) is arr
+
+
+# ---------------------------------------------------------------------------
+# @serve.batch queue lifetime (advisor r2 #5)
+# ---------------------------------------------------------------------------
+
+
+def test_batch_free_function_queue_gc():
+    from ray_tpu.serve import _batching
+
+    n0 = len(_batching._free_queues)
+
+    @_batching.batch(max_batch_size=2, batch_wait_timeout_s=5.0)
+    async def f(xs):
+        return [x + 1 for x in xs]
+
+    async def run():
+        return await asyncio.gather(f(1), f(2))
+
+    assert asyncio.run(run()) == [2, 3]
+    assert len(_batching._free_queues) == n0 + 1
+    del f
+    gc.collect()
+    assert len(_batching._free_queues) == n0  # no leak, no id-reuse hazard
+
+
+def test_batch_unpickled_copy_own_queue_and_gc():
+    """A cloudpickled wrapper (how replicas receive it) must get its own
+    process-local queue AND be cleaned up on gc — weak keying works where a
+    decoration-time finalizer would not survive the pickle round-trip."""
+    import cloudpickle
+
+    from ray_tpu.serve import _batching
+
+    @_batching.batch(max_batch_size=1, batch_wait_timeout_s=5.0)
+    async def f(xs):
+        return [x * 10 for x in xs]
+
+    copy = cloudpickle.loads(cloudpickle.dumps(f))
+    n0 = len(_batching._free_queues)
+    assert asyncio.run(copy(3)) == 30
+    assert len(_batching._free_queues) == n0 + 1
+    del copy
+    gc.collect()
+    assert len(_batching._free_queues) == n0
+
+
+def test_batch_two_functions_distinct_queues():
+    from ray_tpu.serve import _batching
+
+    @_batching.batch(max_batch_size=2, batch_wait_timeout_s=5.0)
+    async def a(xs):
+        return [("a", x) for x in xs]
+
+    @_batching.batch(max_batch_size=2, batch_wait_timeout_s=5.0)
+    async def b(xs):
+        return [("b", x) for x in xs]
+
+    async def run():
+        return await asyncio.gather(a(1), b(1), a(2), b(2))
+
+    out = asyncio.run(run())
+    assert out == [("a", 1), ("b", 1), ("a", 2), ("b", 2)]
+
+
+# ---------------------------------------------------------------------------
+# Dataset aggregates on string columns (advisor r2 #4)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_dataset_min_max_string_column(ray_init):
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items(
+        [{"k": s, "v": i} for i, s in enumerate(["pear", "apple", "mango"])]
+    )
+    assert ds.min("k") == "apple"
+    assert ds.max("k") == "pear"
+    assert ds.mean("k") is None
+    assert ds.std("k") is None
+    # numeric columns keep full stats
+    assert ds.sum("v") == 3
+    assert ds.min("v") == 0 and ds.max("v") == 2
+
+
+def test_dataset_string_stats_with_empty_block(ray_init):
+    """An empty block must not contribute numeric zeros to a string column
+    (review: the 0.0 sentinel made ds.sum('name') return 0.0)."""
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items(
+        [{"k": s} for s in ["b", "a", "c"]], parallelism=3
+    ).filter(lambda r: r["k"] != "a")
+    assert ds.sum("k") is None
+    assert ds.mean("k") is None
+    assert ds.min("k") == "b" and ds.max("k") == "c"
