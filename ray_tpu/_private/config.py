@@ -51,24 +51,43 @@ class ConfigRegistry:
         self._flags: Dict[str, _Flag] = {}
         self._overrides: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        # resolved-value memo: get() sits on per-task hot paths (submission,
+        # lease pools), and an os.environ miss costs a thrown KeyError every
+        # call. Invalidated by reset()/apply_system_config()/declare() — code
+        # that mutates RAY_TPU_* env at runtime must call reset() (the test
+        # fixture already does).
+        self._cache: Dict[str, Any] = {}
 
     def declare(self, name: str, default: Any, doc: str = "") -> None:
         self._flags[name] = _Flag(name, default, type(default), doc)
+        self._cache.pop(name, None)
 
     def get(self, name: str) -> Any:
+        try:
+            return self._cache[name]
+        except KeyError:
+            pass
         flag = self._flags[name]
+        # resolve AND cache under one lock: caching the env/default value
+        # outside it could race apply_system_config and pin a stale value
+        # over the override for the process lifetime
         with self._lock:
             if name in self._overrides:
-                return self._overrides[name]
-        env = os.environ.get(_ENV_PREFIX + name)
-        if env is not None:
-            try:
-                return _PARSERS[flag.type](env)
-            except (ValueError, KeyError):
-                raise ValueError(
-                    f"Bad value {env!r} for flag {name} (expects {flag.type.__name__})"
-                ) from None
-        return flag.default
+                value = self._overrides[name]
+            else:
+                env = os.environ.get(_ENV_PREFIX + name)
+                if env is not None:
+                    try:
+                        value = _PARSERS[flag.type](env)
+                    except (ValueError, KeyError):
+                        raise ValueError(
+                            f"Bad value {env!r} for flag {name} "
+                            f"(expects {flag.type.__name__})"
+                        ) from None
+                else:
+                    value = flag.default
+            self._cache[name] = value
+        return value
 
     def apply_system_config(self, system_config: Dict[str, Any]) -> None:
         for k, v in system_config.items():
@@ -83,6 +102,7 @@ class ConfigRegistry:
                 )
             with self._lock:
                 self._overrides[k] = v
+                self._cache.pop(k, None)
 
     def serialize_overrides(self) -> str:
         """Serialize overrides so spawned daemons/workers inherit them (the
@@ -96,6 +116,7 @@ class ConfigRegistry:
     def reset(self) -> None:
         with self._lock:
             self._overrides.clear()
+            self._cache.clear()
 
     def all_flags(self) -> Dict[str, _Flag]:
         return dict(self._flags)
@@ -107,7 +128,7 @@ _flag = GLOBAL_CONFIG.declare
 # --- core runtime ---
 _flag("object_store_memory_bytes", 512 * 1024 * 1024, "Per-node shm object store size.")
 _flag("inline_object_max_bytes", 100 * 1024, "Objects <= this ride RPC replies inline; larger go to the shm store (reference: plasma promotion threshold, core_worker store_provider).")
-_flag("worker_pool_prestart", 0, "Workers to prestart per node.")
+_flag("worker_pool_prestart", -1, "Workers to prestart per node; -1 = one per CPU, capped at 16 (reference: worker_pool.h prestarts num_cpus workers for the first job so a cold pool never serializes a parallel burst behind worker spawn).")
 _flag("worker_pool_max_idle", 4, "Idle workers cached per node before reaping.")
 _flag("worker_register_timeout_s", 30.0, "Seconds to wait for a spawned worker to register.")
 _flag("lease_spillback_max_hops", 8, "Max scheduler spillback hops for one lease request.")
@@ -139,6 +160,8 @@ _flag("max_lineage_reconstructions", 3, "Times one lost object may be recomputed
 _flag("max_pending_lease_requests", 16, "In-flight lease requests per scheduling key (reference: normal_task_submitter.h:57 LeaseRequestRateLimiter) — recycled leases serve queued submissions; fetchers only prime the pump.")
 _flag("worker_lease_idle_s", 0.5, "Cached worker leases idle past this are returned to the daemon (reference: normal_task_submitter lease pools + idle lease timeout).")
 _flag("lease_pool_max_idle", 16, "Max granted-but-idle leases cached per scheduling key before extras are returned immediately.")
+_flag("push_batch_max", 64, "Max task specs coalesced into one push_task_batch RPC to a leased worker (reference: normal_task_submitter.h:226 pipelined PushNormalTask — amortizes per-RPC framing and event-loop wakeups across queued same-shaped tasks).")
+_flag("push_feeders_per_key", 16, "Max concurrent lease-holding batch feeders per scheduling key; each feeder drains the key's ready queue onto one leased worker at a time.")
 _flag("device_object_transport", True, "Keep jax.Arrays HBM-resident through the object plane: same-process consumers get the original device array back (no h2d), others rebuild from host-staged bytes (reference: python/ray/experimental/rdt).")
 
 # --- chaos / fault injection (day 1, per SURVEY §4) ---

@@ -70,6 +70,21 @@ def set_core_worker(cw: Optional["CoreWorker"]) -> None:
     _current_core_worker = cw
 
 
+def compute_lease_key(resources: "ResourceSet", strategy) -> Optional[tuple]:
+    """Scheduling key: tasks of the same shape can reuse one lease
+    (reference: normal_task_submitter.h SchedulingKey lease pools).
+    None → never pool: SPREAD tasks must spread across nodes, and
+    reusing one granted worker would pin them to it."""
+    if strategy.kind == pb.STRATEGY_SPREAD:
+        return None
+    return (
+        tuple(sorted(resources.to_wire().items())),
+        tuple(sorted(
+            (k, str(v)) for k, v in strategy.to_wire().items()
+        )),
+    )
+
+
 class ObjectRef:
     """A reference to a (possibly not-yet-computed) remote object.
 
@@ -352,11 +367,16 @@ class ActorHandleState:
     actor_task_submitter.h:69 — ordered sequence numbers, address cache)."""
 
     __slots__ = ("actor_id", "seq", "address", "client", "state", "death_cause",
-                 "event", "creation_keepalive", "incarnation", "ever_alive")
+                 "event", "creation_keepalive", "incarnation", "ever_alive",
+                 "push_queue", "pump_running")
 
     def __init__(self, actor_id: bytes):
         self.actor_id = actor_id
         self.seq = 0
+        # push coalescing: (spec, future) entries drained by one pump task
+        # into push_task_batch RPCs (reference: pipelined actor PushTask)
+        self.push_queue: collections.deque = collections.deque()
+        self.pump_running = False
         # bumped on every ALIVE transition to a replacement worker; per-
         # incarnation seq numbering restarts at 1 (reference: restart epoch
         # in actor_task_submitter.h). The first ALIVE keeps incarnation 0 so
@@ -391,6 +411,10 @@ class CoreWorker:
     ):
         self.mode = mode
         self.loop = loop
+        # resolved lazily: the loop may not be running yet; compared by
+        # thread id because asyncio.get_running_loop() throws (expensively)
+        # on every non-loop-thread call
+        self._loop_thread_id: Optional[int] = None
         self.job_id = job_id
         self.worker_id = worker_id or WorkerID.from_random()
         self.node_id_hex = node_id_hex
@@ -438,6 +462,16 @@ class CoreWorker:
         # capacity behind the sweep period. Idle leases swept by
         # _lease_pool_sweep.
         self._lease_pools: Dict[tuple, dict] = {}
+        # cross-thread submission handoff: driver-thread .remote() appends
+        # here and wakes the loop once per burst, not once per task (each
+        # call_soon_threadsafe pays a socketpair write)
+        self._xthread_submits: collections.deque = collections.deque()
+        self._xthread_scheduled = False
+        # pipelined push batching (reference: normal_task_submitter.h:226):
+        # ready specs queue per scheduling key; feeders drain the queue in
+        # push_task_batch RPCs, one leased worker per feeder at a time
+        self._push_queues: Dict[tuple, collections.deque] = {}
+        self._push_feeders: Dict[tuple, int] = {}
         self._actor_states: Dict[bytes, ActorHandleState] = {}
         self._owned_actor_handles: Dict[bytes, int] = {}
         self._bg_futures: set = set()
@@ -574,10 +608,16 @@ class CoreWorker:
             fut.add_done_callback(self._bg_futures.discard)
 
     def _loop_running_here(self) -> bool:
-        try:
-            return asyncio.get_running_loop() is self.loop
-        except RuntimeError:
-            return False
+        tid = self._loop_thread_id
+        if tid is None:
+            try:
+                running = asyncio.get_running_loop() is self.loop
+            except RuntimeError:
+                return False
+            if running:
+                self._loop_thread_id = threading.get_ident()
+            return running
+        return tid == threading.get_ident()
 
     def run_sync(self, coro, timeout: Optional[float] = None):
         """Bridge a coroutine to sync callers (driver public API)."""
@@ -775,14 +815,28 @@ class CoreWorker:
                     raise
 
     async def _await_deadline(self, fut, deadline, ref):
-        if deadline is None:
+        if deadline is None or fut.done():
             await fut
             return
+        # leaner than asyncio.wait_for: one timer handle, no nested timeout
+        # context — this sits on the per-ref get() hot path. The future is
+        # per-caller (memory_store.wait_future hands out fresh ones), so
+        # cancelling it on timeout affects no other getter.
         remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            fut.cancel()
+            raise GetTimeoutError(
+                f"get() timed out waiting for {ref.hex()}")
+        timer = self.loop.call_later(remaining, fut.cancel)
         try:
-            await asyncio.wait_for(fut, max(0.0, remaining))
-        except asyncio.TimeoutError:
-            raise GetTimeoutError(f"get() timed out waiting for {ref.hex()}") from None
+            await fut
+        except asyncio.CancelledError:
+            if fut.cancelled() and time.monotonic() >= deadline - 0.001:
+                raise GetTimeoutError(
+                    f"get() timed out waiting for {ref.hex()}") from None
+            raise
+        finally:
+            timer.cancel()
 
     async def _read_store_object(self, ref: ObjectRef, location: dict, deadline) -> Any:
         if self.store is None:
@@ -1125,8 +1179,13 @@ class CoreWorker:
             # must still deliver a tombstone for its sequence slot (see
             # _submit_actor_with_retries)
             pass
-        else:
+        elif sub["atask"] is not None:
             sub["atask"].cancel()
+        else:
+            # fast-lane queued entry: no coroutine exists; resolve the
+            # returns now and let the feeder skip (and untrack) the entry
+            self._fail_task(spec, TaskCancelledError(
+                f"task {spec.name or spec.function_key} was cancelled"))
         return True
 
     # executor side: delegate to the task executor
@@ -1212,8 +1271,76 @@ class CoreWorker:
             out.append(entry)
         return out
 
-    async def submit_task(
+    def serialize_args_sync(self, args: tuple, kwargs: dict):
+        """Caller-thread arg serialization for the non-blocking submission
+        path: serialization errors raise HERE, at the .remote() call site
+        (matching the reference, where submit_task serializes synchronously
+        in the Cython seam before the async C++ pipeline takes over).
+
+        Returns (wire_args, pyrefs, pending_puts); pending_puts are
+        (ObjectID, SerializedObject) pairs whose store writes the loop-side
+        coroutine must complete before submitting — the ObjectRef/oid are
+        allocated here so the wire entry is final."""
+        out, pyrefs, pending = [], [], []
+        for kw_name, value in [
+            *((None, v) for v in args),
+            *kwargs.items(),
+        ]:
+            if isinstance(value, ObjectRef):
+                entry = {
+                    "ref": value.binary(),
+                    "owner": value.owner_address,
+                    "owner_worker_id": value._owner_worker_id,
+                }
+                pyrefs.append(value)
+            else:
+                sobj = ser.serialize(value)
+                if sobj.total_bytes > self._inline_max or sobj.contained_refs:
+                    with self._lock:
+                        self._put_index += 1
+                        oid = ObjectID.for_put(
+                            self.current_task_id, self._put_index)
+                    ref = ObjectRef(oid, self.address, self.worker_id.binary())
+                    pending.append((oid, sobj))
+                    entry = {
+                        "ref": ref.binary(),
+                        "owner": ref.owner_address,
+                        "owner_worker_id": ref._owner_worker_id,
+                    }
+                    pyrefs.append(ref)
+                else:
+                    entry = {"inline": sobj.to_bytes()}
+            if kw_name is not None:
+                entry["kw"] = kw_name
+            out.append(entry)
+        return out, pyrefs, pending
+
+    async def _complete_put(self, oid: ObjectID, sobj: "ser.SerializedObject"):
+        """Finish a caller-thread-allocated put (the write half of
+        put_object): resolve the memory-store future / write shm so
+        dependents and gets unblock."""
+        if sobj.total_bytes <= self._inline_max:
+            self.memory_store.put(oid.binary(), sobj.to_bytes(), META_NORMAL)
+        elif self.store is None:
+            await self._remote_put(oid, sobj)
+            self.memory_store.set_location(
+                oid.binary(),
+                {"daemon": self.daemon_address, "node_id": self.node_id_hex},
+            )
+        else:
+            view = await self._create_with_spill(oid, sobj.total_bytes)
+            sobj.write_into(view)
+            view.release()
+            self.store.seal(oid)
+            self.memory_store.set_location(
+                oid.binary(),
+                {"daemon": self.daemon_address, "node_id": self.node_id_hex,
+                 "local": True},
+            )
+
+    def submit_task_fast(
         self,
+        function_obj,
         function_key: str,
         args: tuple,
         kwargs: dict,
@@ -1224,13 +1351,20 @@ class CoreWorker:
         name: str = "",
         runtime_env: Optional[dict] = None,
         stream_backpressure: int = -1,
+        lease_key: Any = False,
     ):
-        from ray_tpu._private.runtime_env_mgr import prepare_runtime_env
+        """Non-blocking submission callable from ANY thread — the driver's
+        .remote() must never wait on a loop round trip (reference:
+        normal_task_submitter.h — submission is pipelined; ray_perf's async
+        suite measures exactly this). Serialization runs on the caller
+        thread (errors raise at the call site); everything needing the loop
+        (pending put writes, export, lease/push) continues asynchronously.
 
-        runtime_env = await prepare_runtime_env(runtime_env, self)
+        `resources`/`strategy` may be prebuilt (shared, never-mutated)
+        objects and `lease_key` their precomputed scheduling key — the
+        RemoteFunction caches all three across calls."""
         task_id = self.next_task_id()
-        wire_args = await self.serialize_args(args, kwargs)
-        pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
+        wire_args, pyrefs, pending = self.serialize_args_sync(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1238,7 +1372,10 @@ class CoreWorker:
             function_key=function_key,
             args=wire_args,
             num_returns=num_returns,
-            resources=ResourceSet(resources or {"CPU": 1.0}),
+            resources=(
+                resources if isinstance(resources, ResourceSet)
+                else ResourceSet(resources or {"CPU": 1.0})
+            ),
             strategy=strategy or SchedulingStrategy(),
             max_retries=(
                 max_retries if max_retries is not None
@@ -1256,69 +1393,97 @@ class CoreWorker:
         ]
         if spec.is_streaming:
             self._streams[task_id.binary()] = StreamState(task_id.binary())
-        atask = spawn(self._submit_with_retries(spec, pyrefs))
-        self._track_submission(spec, atask)
-        if spec.is_streaming:
-            return ObjectRefGenerator(self, task_id.binary())
-        return refs
 
-    def submit_task_nowait(
-        self,
-        function_obj,
-        function_key: str,
-        args: tuple,
-        kwargs: dict,
-        **opts,
-    ):
-        """Loop-thread-safe submission (called from inside async actors,
-        where run_sync would deadlock): allocate the task id and return refs
-        synchronously; export+serialize+submit continue in a spawned task.
-        Reference: Ray submission is async under the hood — .remote() never
-        blocks on the data plane."""
-        task_id = self.next_task_id()
-        num_returns = opts.get("num_returns", 1)
-        spec = TaskSpec(
-            task_id=task_id,
-            job_id=self.job_id,
-            kind=pb.TASK_KIND_NORMAL,
-            function_key=function_key,
-            args=[],
-            num_returns=num_returns,
-            resources=ResourceSet(opts.get("resources") or {"CPU": 1.0}),
-            strategy=opts.get("strategy") or SchedulingStrategy(),
-            max_retries=(
-                opts["max_retries"] if opts.get("max_retries") is not None
-                else GLOBAL_CONFIG.get("max_task_retries_default")
-            ),
-            owner_worker_id=self.worker_id.binary(),
-            owner_address=self.address,
-            name=opts.get("name", ""),
-            runtime_env=opts.get("runtime_env") or {},
-            stream_backpressure=opts.get("stream_backpressure", -1),
+        # FAST LANE: inline-only args, exported function, no env prep —
+        # nothing to await before delivery, so skip the per-task coroutine
+        # chain entirely; the push feeder handles replies AND retries from
+        # the submission entry (reference: the C++ submitter is exactly this
+        # shape — no per-task task, just queues and callbacks).
+        fast = (
+            not spec.is_streaming
+            and not pending
+            and not spec.runtime_env
+            and function_key in self._exported
+            and not any("ref" in a for a in wire_args)
         )
-        refs = [
-            ObjectRef(oid, self.address, self.worker_id.binary())
-            for oid in spec.return_ids()
-        ]
-        if spec.is_streaming:
-            self._streams[task_id.binary()] = StreamState(task_id.binary())
+        if fast:
+            key = lease_key if lease_key is not False else self._lease_key(spec)
+            fast = key is not None
+        if fast:
+            item = (spec, None, pyrefs)
+            if self._loop_running_here():
+                self._enqueue_fast(key, item)
+            else:
+                self._xthread_submits.append(("fast", key, item))
+                if not self._xthread_scheduled:
+                    self._xthread_scheduled = True
+                    self.loop.call_soon_threadsafe(self._drain_xthread_submits)
+            return refs
 
         async def finish():
             from ray_tpu._private.runtime_env_mgr import prepare_runtime_env
 
-            spec.runtime_env = await prepare_runtime_env(
-                spec.runtime_env, self) or {}
+            for oid, sobj in pending:
+                await self._complete_put(oid, sobj)
+            if spec.runtime_env:
+                spec.runtime_env = await prepare_runtime_env(
+                    spec.runtime_env, self) or {}
             await self.export_function(function_key, function_obj)
-            wire_args = await self.serialize_args(args, kwargs)
-            pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
-            spec.args = wire_args
             await self._submit_with_retries(spec, pyrefs)
 
-        atask = spawn(self._guard_submit(spec, finish()))
-        self._track_submission(spec, atask)
+        if self._loop_running_here():
+            atask = spawn(self._guard_submit(spec, finish()))
+            self._track_submission(spec, atask)
+        else:
+            # batched handoff, FIFO with subsequent cancel/get calls through
+            # the loop (their run_coroutine_threadsafe callbacks queue after
+            # the drain callback already scheduled for this burst)
+            self._xthread_submits.append(("coro", spec, finish()))
+            if not self._xthread_scheduled:
+                self._xthread_scheduled = True
+                self.loop.call_soon_threadsafe(self._drain_xthread_submits)
         if spec.is_streaming:
             return ObjectRefGenerator(self, task_id.binary())
         return refs
+
+    def _enqueue_fast(self, key: tuple, item: tuple):
+        spec = item[0]
+        if self._closed:
+            self._fail_task(spec, RayTpuError("core worker closed"))
+            return
+        tid = spec.task_id.binary()
+        entry = {
+            "state": "pending", "worker": "", "cancelled": False,
+            "atask": None, "spec": spec, "attempts": 0,
+            "keepalive": item[2],
+        }
+        self._submissions[tid] = entry
+        for oid in spec.return_ids():
+            self._return_to_task[oid.binary()] = tid
+        q = self._push_queues.get(key)
+        if q is None:
+            q = self._push_queues[key] = collections.deque()
+        q.append((spec, None))
+        self._ensure_push_feeders(key, spec)
+
+    def _drain_xthread_submits(self):
+        # reset BEFORE popping: a producer that observes the flag still True
+        # is guaranteed its append happens while this loop is still draining
+        self._xthread_scheduled = False
+        while self._xthread_submits:
+            kind, a, b = self._xthread_submits.popleft()
+            if kind == "fast":
+                self._enqueue_fast(a, b)
+            else:
+                self._spawn_tracked_submit(a, b)
+
+    def _spawn_tracked_submit(self, spec: TaskSpec, coro):
+        if self._closed:
+            coro.close()
+            self._fail_task(spec, RayTpuError("core worker closed"))
+            return
+        atask = spawn(self._guard_submit(spec, coro))
+        self._track_submission(spec, atask)
 
     def submit_actor_task_nowait(self, actor_id: bytes, method_name: str,
                                  args: tuple, kwargs: dict,
@@ -1493,18 +1658,7 @@ class CoreWorker:
         return owner_worker_id == self.worker_id.binary()
 
     def _lease_key(self, spec: TaskSpec) -> Optional[tuple]:
-        """Scheduling key: tasks of the same shape can reuse one lease
-        (reference: normal_task_submitter.h SchedulingKey lease pools).
-        None → never pool: SPREAD tasks must spread across nodes, and
-        reusing one granted worker would pin them to it."""
-        if spec.strategy.kind == pb.STRATEGY_SPREAD:
-            return None
-        return (
-            tuple(sorted(spec.resources.to_wire().items())),
-            tuple(sorted(
-                (k, str(v)) for k, v in spec.strategy.to_wire().items()
-            )),
-        )
+        return compute_lease_key(spec.resources, spec.strategy)
 
     def _pool_for(self, key: tuple) -> dict:
         pool = self._lease_pools.get(key)
@@ -1600,6 +1754,12 @@ class CoreWorker:
     async def _submit_once(self, spec: TaskSpec):
         await self._wait_args_ready(spec)
         key = self._lease_key(spec)
+        if key is not None and not spec.is_streaming:
+            # pipelined path: queue for a batch feeder (reference:
+            # normal_task_submitter.h:226 pipelined PushNormalTask) — many
+            # same-shaped tasks share one RPC to a leased worker
+            await self._submit_via_queue(key, spec)
+            return
         while True:
             if key is None:
                 lease = await self._acquire_lease(spec)
@@ -1648,6 +1808,176 @@ class CoreWorker:
                 self._lease_pool_put(key, lease)
             self._record_task_reply(spec, reply)
             return
+
+    async def _submit_via_queue(self, key: tuple, spec: TaskSpec):
+        """Enqueue a ready spec for batched delivery; completes (or raises
+        WorkerCrashedError into the caller's retry loop) when its batch's
+        reply lands. One future per task — the feeder owns leases and RPCs."""
+        q = self._push_queues.get(key)
+        if q is None:
+            q = self._push_queues[key] = collections.deque()
+        fut = self.loop.create_future()
+        q.append((spec, fut))
+        self._ensure_push_feeders(key, spec)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # the entry may still sit in the queue; feeders skip done futures
+            if not fut.done():
+                fut.cancel()
+            raise
+
+    def _ensure_push_feeders(self, key: tuple, spec: TaskSpec):
+        q = self._push_queues.get(key)
+        if not q:
+            return
+        active = self._push_feeders.get(key, 0)
+        # Every enqueue may add one feeder (up to the cap): existing feeders
+        # are busy awaiting an in-flight batch, and a newly queued task must
+        # be able to reach a DIFFERENT worker concurrently — otherwise one
+        # slow task head-of-line-blocks tasks that have idle capacity
+        # elsewhere. Surplus feeders exit as soon as the queue drains.
+        if active < GLOBAL_CONFIG.get("push_feeders_per_key"):
+            self._push_feeders[key] = active + 1
+            spawn(self._push_feeder(key, spec))
+
+    async def _push_feeder(self, key: tuple, template_spec: TaskSpec):
+        """Drain the key's ready queue: take a lease, ship up to
+        push_batch_max specs in ONE push_task_batch RPC, record replies,
+        recycle the lease, repeat. Stale cached leases retry the whole batch
+        transparently (not charged against task retries), exactly like the
+        single-push path."""
+        try:
+            while True:
+                q = self._push_queues.get(key)
+                if not q:
+                    return
+                lease = await self._pool_lease(key, template_spec)
+                cached = not lease.pop("fresh", False)
+                batch = []
+                # fair share: don't let one feeder swallow the whole queue
+                # into a single worker's (sequential) batch while sibling
+                # feeders could drain it onto other workers in parallel
+                maxb = max(1, min(
+                    GLOBAL_CONFIG.get("push_batch_max"),
+                    -(-len(q) // max(1, self._push_feeders.get(key, 1))),
+                ))
+                while q and len(batch) < maxb:
+                    spec, fut = q.popleft()
+                    if fut is not None and fut.done():
+                        continue  # cancelled while queued
+                    sub = self._submissions.get(spec.task_id.binary())
+                    if sub is not None and sub.get("cancelled"):
+                        if fut is None:
+                            # fast-lane entry: no coroutine resolves the
+                            # returns — do it here
+                            self._fail_task(spec, TaskCancelledError(
+                                f"task {spec.name or spec.function_key} "
+                                f"was cancelled"))
+                            self._untrack_submission(spec)
+                        else:
+                            fut.cancel()
+                        continue
+                    batch.append((spec, fut))
+                if not batch:
+                    self._lease_pool_put(key, lease)
+                    continue
+                worker_addr = lease["worker_address"]
+                for spec, fut in batch:
+                    sub = self._submissions.get(spec.task_id.binary())
+                    if sub is not None:
+                        sub["state"] = "running"
+                        sub["worker"] = worker_addr
+                try:
+                    client = await self._worker_client(worker_addr)
+                    reply = await client.call(
+                        "push_task_batch",
+                        {"specs": [s.to_wire() for s, _ in batch]},
+                        timeout=None,
+                    )
+                except (RpcError, ConnectionError) as e:
+                    self.schedule(self._return_lease_quiet(
+                        lease["daemon_address"], lease["lease_id"]))
+                    if cached:
+                        # stale cached lease (worker reaped between tasks):
+                        # requeue at the front and retry with another lease
+                        # rather than burning task retries
+                        self._drop_pooled_leases_from(lease["daemon_address"])
+                        for item in reversed(batch):
+                            q.appendleft(item)
+                        continue
+                    err = WorkerCrashedError(
+                        f"worker at {worker_addr} died mid-task: {e}")
+                    for spec, fut in batch:
+                        if fut is None:
+                            self._fast_lane_retry(key, q, spec, err)
+                        elif not fut.done():
+                            fut.set_exception(err)
+                    continue
+                except BaseException as e:
+                    # close()/feeder cancellation mid-push: don't strand the
+                    # lease or the waiting submissions
+                    self.schedule(self._return_lease_quiet(
+                        lease["daemon_address"], lease["lease_id"]))
+                    err = WorkerCrashedError(f"submission aborted: {e}")
+                    for spec, fut in batch:
+                        if fut is None:
+                            self._fail_task(spec, err)
+                            self._untrack_submission(spec)
+                        elif not fut.done():
+                            fut.set_exception(err)
+                    raise
+                self._lease_pool_put(key, lease)
+                for (spec, fut), r in zip(batch, reply["replies"]):
+                    try:
+                        self._record_task_reply(spec, r)
+                    except Exception as e:  # noqa: BLE001 — per-task failure
+                        if fut is None:
+                            self._fail_task(spec, e)
+                            self._untrack_submission(spec)
+                        elif not fut.done():
+                            fut.set_exception(e)
+                        continue
+                    if fut is None:
+                        sub = self._submissions.get(spec.task_id.binary())
+                        self._record_lineage(
+                            spec, sub["keepalive"] if sub else [])
+                        self._untrack_submission(spec)
+                    elif not fut.done():
+                        fut.set_result(None)
+        finally:
+            n = self._push_feeders.get(key, 1) - 1
+            if n <= 0:
+                self._push_feeders.pop(key, None)
+            else:
+                self._push_feeders[key] = n
+            # a task enqueued in the window after this feeder saw an empty
+            # queue must not wait forever
+            self._ensure_push_feeders(key, template_spec)
+
+    def _fast_lane_retry(self, key: tuple, q: collections.deque,
+                         spec: TaskSpec, err: Exception):
+        """Feeder-side retry bookkeeping for fast-lane submissions (no
+        per-task coroutine to re-run): requeue until the spec's retry budget
+        is spent, then fail the returns."""
+        sub = self._submissions.get(spec.task_id.binary())
+        if sub is None:
+            return
+        if sub.get("cancelled"):
+            self._fail_task(spec, TaskCancelledError(
+                f"task {spec.name or spec.function_key} was cancelled"))
+            self._untrack_submission(spec)
+            return
+        sub["attempts"] = sub.get("attempts", 0) + 1
+        if sub["attempts"] > spec.max_retries:
+            self._fail_task(spec, WorkerCrashedError(
+                f"task {spec.name or spec.function_key} failed after "
+                f"{spec.max_retries} retries: {err}"))
+            self._untrack_submission(spec)
+            return
+        sub["state"] = "pending"
+        sub["worker"] = ""
+        q.append((spec, None))
 
     def _drop_pooled_leases_from(self, daemon_address: str):
         """A worker from `daemon_address` just failed: every cached lease
@@ -2261,7 +2591,7 @@ class CoreWorker:
                         spec.cancelled = True  # flag set while waiting above
                     sub["state"] = "running"
                     sub["worker"] = st.address
-                reply = await client.call("push_task", {"spec": spec.to_wire()}, timeout=None)
+                reply = await self._actor_push(st, spec)
                 self._record_task_reply(spec, reply)
                 return
             except asyncio.CancelledError:
@@ -2295,6 +2625,69 @@ class CoreWorker:
                     )
                     return
                 await asyncio.sleep(min(0.2 * (2 ** attempt), 5.0))
+
+    async def _actor_push(self, st: ActorHandleState, spec: TaskSpec) -> dict:
+        """Coalesced actor-task delivery: enqueue and let one per-actor pump
+        ship batches over the connection (reference: pipelined PushTask on
+        the actor client). Delivery order may interleave across callers'
+        coroutines — the executor's sequence reorder buffer owns ordering."""
+        fut = self.loop.create_future()
+        st.push_queue.append((spec, fut))
+        if not st.pump_running:
+            st.pump_running = True
+            spawn(self._actor_push_pump(st))
+        return await fut
+
+    async def _actor_push_pump(self, st: ActorHandleState):
+        """Drain the queue into batches and ship them WITHOUT awaiting
+        replies between sends. An ordered actor may block one delivered
+        batch in its reorder buffer until a lower seq (still queued here)
+        arrives — a pump that awaited each reply before sending the next
+        batch would deadlock on exactly that. Sorting each drain by
+        (incarnation, seq) keeps lower seqs no later than higher ones."""
+        try:
+            while st.push_queue:
+                maxb = GLOBAL_CONFIG.get("push_batch_max")
+                drained = [
+                    item for item in (
+                        st.push_queue.popleft()
+                        for _ in range(len(st.push_queue))
+                    ) if not item[1].done()
+                ]
+                drained.sort(key=lambda it: (it[0].incarnation, it[0].seq_no))
+                for i in range(0, len(drained), maxb):
+                    spawn(self._actor_send_batch(st, drained[i:i + maxb]))
+                if not st.push_queue:
+                    return
+        finally:
+            st.pump_running = False
+            if st.push_queue:
+                # enqueued in the window after the loop saw empty
+                st.pump_running = True
+                spawn(self._actor_push_pump(st))
+
+    async def _actor_send_batch(self, st: ActorHandleState, batch: list):
+        client = st.client
+        try:
+            if client is None:
+                raise RpcConnectionLost("actor client not connected")
+            reply = await client.call(
+                "push_task_batch",
+                {"specs": [s.to_wire() for s, _ in batch]},
+                timeout=None,
+            )
+        except BaseException as e:  # noqa: BLE001 — per-call retry loops decide
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(
+                        e if isinstance(e, Exception)
+                        else RpcConnectionLost(f"push aborted: {e}"))
+            if not isinstance(e, Exception):
+                raise
+            return
+        for (_, fut), r in zip(batch, reply["replies"]):
+            if not fut.done():
+                fut.set_result(r)
 
     async def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         await self.control.call(
@@ -2333,6 +2726,14 @@ class CoreWorker:
         assert self.executor is not None, "push_task on a non-worker process"
         spec = TaskSpec.from_wire(payload["spec"])
         return await self.executor.execute(spec)
+
+    async def rpc_push_task_batch(self, conn_id: int, payload: dict) -> dict:
+        """Pipelined batch delivery (reference: back-to-back PushNormalTask
+        on one granted lease): tasks run sequentially — the lease grants one
+        worker — and the replies travel in one frame."""
+        assert self.executor is not None, "push_task_batch on a non-worker process"
+        specs = [TaskSpec.from_wire(w) for w in payload["specs"]]
+        return {"replies": await self.executor.execute_batch(specs)}
 
     async def resolve_arg(self, arg: dict) -> Any:
         if "inline" in arg:

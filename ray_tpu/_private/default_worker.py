@@ -58,13 +58,27 @@ def amain():
 
         def dump_tasks():
             # `kill -USR2 <pid>`: print every live coroutine's await stack to
-            # the worker log (hang forensics; faulthandler only sees threads)
-            import traceback
-
+            # the worker log (hang forensics; faulthandler only sees threads).
+            # Task.get_stack returns ONE frame for a suspended coroutine, so
+            # walk the cr_await chain for the full await stack.
             for t in asyncio.all_tasks(loop):
-                frames = t.get_stack(limit=8)
-                where = "".join(traceback.format_stack(frames[-1])) if frames else "  <no frame>\n"
-                logging.warning("TASK %s\n%s", t.get_name(), where)
+                lines = []
+                obj = t.get_coro()
+                depth = 0
+                while obj is not None and depth < 32:
+                    frame = getattr(obj, "cr_frame", None) or getattr(
+                        obj, "gi_frame", None) or getattr(obj, "ag_frame", None)
+                    if frame is not None:
+                        lines.append(
+                            f'  File "{frame.f_code.co_filename}", line '
+                            f"{frame.f_lineno}, in {frame.f_code.co_name}")
+                    obj = getattr(obj, "cr_await", None) or getattr(
+                        obj, "gi_yieldfrom", None) or getattr(
+                        obj, "ag_await", None)
+                    depth += 1
+                logging.warning(
+                    "TASK %s\n%s", t.get_name(),
+                    "\n".join(lines) or "  <no frame>")
 
         loop.add_signal_handler(signal.SIGUSR2, dump_tasks)
         await stop.wait()
@@ -83,10 +97,38 @@ def main():
     import faulthandler
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # perf forensics: RT_WORKER_PROFILE_DIR=<dir> cProfiles the worker's loop
+    # thread, dumping <dir>/worker_<pid>.pstats at exit (reference: the
+    # dashboard's on-demand py-spy profiling fills this role)
+    profile_dir = os.environ.get("RT_WORKER_PROFILE_DIR")
+    prof = None
+    if profile_dir:
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+        os.makedirs(profile_dir, exist_ok=True)
+        path = os.path.join(profile_dir, f"worker_{os.getpid()}.pstats")
+
+        def dump_profile(_sig, _frame):
+            # `kill -PROF <pid>`: snapshot the profile mid-run. Signal
+            # handlers run on the main (profiled) thread, keeping cProfile
+            # state consistent; the pool reaps workers with SIGKILL, so an
+            # at-exit-only dump would never run.
+            prof.disable()
+            prof.dump_stats(path)
+            prof.enable()
+
+        signal.signal(signal.SIGPROF, dump_profile)
     try:
         amain()
     except KeyboardInterrupt:
         pass
+    finally:
+        if prof is not None:
+            prof.disable()
+            prof.dump_stats(
+                os.path.join(profile_dir, f"worker_{os.getpid()}.pstats"))
 
 
 if __name__ == "__main__":

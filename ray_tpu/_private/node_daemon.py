@@ -28,6 +28,7 @@ import signal
 import subprocess
 import sys
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import protocol as pb
@@ -149,6 +150,10 @@ class NodeDaemon:
         # clients must not double-grant/double-create)
         self._lease_requests: Dict[bytes, asyncio.Task] = {}
         self._lease_key_by_id: Dict[bytes, bytes] = {}
+        # request_keys cancelled before their request_lease arrived (the
+        # dead connection's frame or a resend can land after the cancel):
+        # a tombstoned key is refused instead of queued-and-leaked
+        self._cancelled_lease_keys: "OrderedDict[bytes, float]" = OrderedDict()
         self._creating_actors: Dict[bytes, asyncio.Task] = {}
         # cluster view: node_id hex -> available ResourceSet
         self.cluster_view: Dict[str, ResourceSet] = {}
@@ -218,7 +223,11 @@ class NodeDaemon:
         if GLOBAL_CONFIG.get("object_spill_enabled"):
             os.makedirs(self.spill_dir, exist_ok=True)
             self._tasks.append(spawn(self._spill_loop()))
-        for _ in range(GLOBAL_CONFIG.get("worker_pool_prestart")):
+        prestart = GLOBAL_CONFIG.get("worker_pool_prestart")
+        if prestart < 0:
+            prestart = min(
+                16, int(self.total_resources.to_dict().get("CPU", 0)))
+        for _ in range(prestart):
             spawn(self._spawn_worker(job_id=b"", reserve=False))
         logger.info(
             "daemon %s up at %s store=%s resources=%s",
@@ -557,6 +566,10 @@ class NodeDaemon:
         key = payload.get("request_key")
         if key is None:
             return await self._request_lease_inner(payload)
+        if key in self._cancelled_lease_keys:
+            # cancelled before this (late/resent) frame arrived: refuse
+            # rather than queue a lease nobody will claim
+            return {"cancelled": True, "error": "lease request cancelled"}
         task = self._lease_requests.get(key)
         if task is None:
             task = spawn(self._request_lease_inner(payload))
@@ -832,6 +845,12 @@ class NodeDaemon:
         worker forever (reference: NormalTaskSubmitter cancels pending lease
         requests it abandons). Idempotent; unknown keys are a no-op."""
         key = payload.get("request_key")
+        if key is not None:
+            # tombstone first: a late/resent request_lease frame for this key
+            # must be refused even if it has not arrived yet
+            self._cancelled_lease_keys[key] = time.monotonic()
+            while len(self._cancelled_lease_keys) > 4096:
+                self._cancelled_lease_keys.popitem(last=False)
         task = self._lease_requests.get(key) if key is not None else None
         if task is None:
             return {"ok": True}

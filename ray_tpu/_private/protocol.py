@@ -219,11 +219,20 @@ class TaskSpec:
         return self.num_returns == NUM_RETURNS_STREAMING
 
     def return_ids(self) -> List[ObjectID]:
+        # memoized: blake2b-derived per return id, and callers (submission
+        # tracking, reply recording, lineage) ask several times per task
+        cached = getattr(self, "_return_ids", None)
+        if cached is not None:
+            return cached
         if self.is_streaming:
-            return []
-        return [
-            ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
-        ]
+            ids: List[ObjectID] = []
+        else:
+            ids = [
+                ObjectID.for_task_return(self.task_id, i)
+                for i in range(self.num_returns)
+            ]
+        object.__setattr__(self, "_return_ids", ids)
+        return ids
 
     def to_wire(self) -> dict:
         return {

@@ -211,6 +211,10 @@ def attention(cfg: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
         from ray_tpu.parallel.ring_attention import ring_attention_sharded
 
         return ring_attention_sharded(q, k, v, mesh, causal=True)
+    if cfg.attention_impl == "ulysses" and mesh is not None and mesh.shape["sp"] > 1:
+        from ray_tpu.parallel.ulysses import ulysses_attention_sharded
+
+        return ulysses_attention_sharded(q, k, v, mesh, causal=True)
     if cfg.attention_impl == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
 

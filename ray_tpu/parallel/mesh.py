@@ -4,10 +4,16 @@ This replaces the reference's orchestration-only parallelism (Ray places
 NCCL/DeepSpeed workers but delegates TP/PP/SP to them — SURVEY §2b) with
 in-framework GSPMD: a named `jax.sharding.Mesh` over ICI with axes
 
+    pp    — pipeline parallel (layer stages, ppermute activation hand-off)
     dp    — data parallel (gradient allreduce)
     fsdp  — fully-sharded data parallel (ZeRO-3-style param sharding)
     tp    — tensor parallel (megatron-style column/row sharding)
     sp    — sequence/context parallel (ring attention / Ulysses)
+
+`pp` is the OUTERMOST axis: stage hand-offs move one activation tensor per
+tick (the lowest-bandwidth traffic), so they get the slowest links — across
+slices/DCN on real pods — while tp/sp stay innermost on ICI (scaling-book
+axis-ordering recipe).
 
 Reference for the capability being replaced: python/ray/train/v2/jax/config.py
 (jax.distributed bootstrap), python/ray/llm/_internal/common/placement.py:47
@@ -23,7 +29,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("pp", "dp", "fsdp", "tp", "sp")
 
 
 @dataclass(frozen=True)
@@ -34,14 +40,15 @@ class MeshSpec:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    pp: int = 1
 
     @property
-    def shape(self) -> Tuple[int, int, int, int]:
-        return (self.dp, self.fsdp, self.tp, self.sp)
+    def shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.pp, self.dp, self.fsdp, self.tp, self.sp)
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.pp * self.dp * self.fsdp * self.tp * self.sp
 
     def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
         """Build a named Mesh.
@@ -116,6 +123,15 @@ def logical_to_sharding(tree_specs, mesh: Mesh):
 def constrain(x, mesh: Mesh, spec: P):
     """In-jit sharding constraint (the GSPMD annotation primitive)."""
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def to_varying(x, axes):
+    """Mark `x` as varying over manual mesh `axes` inside shard_map —
+    pcast on jax >= 0.9, pvary before (shared by ring_attention/pipeline)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axes), to="varying")
+    return jax.lax.pvary(x, tuple(axes))
 
 
 def host_local_mesh_info(mesh: Mesh) -> dict:

@@ -80,11 +80,10 @@ def ring_attention_sharded(
         # the shard_map inputs or the fori carry types mismatch
         varying = tuple(a for a in ("dp", "fsdp", "sp") if a in mesh.shape)
 
+        from ray_tpu.parallel.mesh import to_varying
+
         def _vary(x):
-            pcast = getattr(lax, "pcast", None)
-            if pcast is not None:
-                return pcast(x, varying, to="varying")
-            return lax.pvary(x, varying)  # pre-0.9 JAX
+            return to_varying(x, varying)
 
         o = _vary(jnp.zeros((b, sq, h, hd), jnp.float32))
         m = _vary(jnp.full((b, h, sq), -jnp.inf, jnp.float32))

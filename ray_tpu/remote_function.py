@@ -120,39 +120,47 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
-        from ray_tpu._private.protocol import NUM_RETURNS_STREAMING
-
         cw = get_core_worker()
+        cached = self.__dict__.get("_submit_cache")
+        if cached is None:
+            cached = self._build_submit_cache()
+        streaming, num_returns, call_opts = cached
+
+        # Non-blocking from every calling context (reference: .remote() never
+        # waits on the data plane): args serialize on this thread so
+        # serialization errors raise at the call site; the lease/push
+        # pipeline continues on the loop.
+        result = cw.submit_task_fast(
+            self._fn, self._function_key, args, kwargs, **call_opts
+        )
+        if streaming or num_returns == 1:
+            return result[0] if not streaming else result
+        return result
+
+    def _build_submit_cache(self):
+        """Options are constant per RemoteFunction — resolve them (and the
+        ResourceSet / strategy / lease key) once, not on every .remote()."""
+        from ray_tpu._private.core_worker import compute_lease_key
+        from ray_tpu._private.protocol import NUM_RETURNS_STREAMING, ResourceSet
+
         opts = self._options
         num_returns = opts.get("num_returns", 1)
         streaming = num_returns == "streaming"
+        resources = ResourceSet(build_resources(opts))
+        strategy = build_strategy(opts)
         call_opts = dict(
             num_returns=NUM_RETURNS_STREAMING if streaming else num_returns,
-            resources=build_resources(opts),
-            strategy=build_strategy(opts),
+            resources=resources,
+            strategy=strategy,
             max_retries=opts.get("max_retries"),
             name=self._function_name,
             runtime_env=opts.get("runtime_env"),
             stream_backpressure=opts.get("_generator_backpressure_num_objects", -1),
+            lease_key=compute_lease_key(resources, strategy),
         )
-
-        if cw._loop_running_here():
-            # called from inside an async actor: run_sync would deadlock the
-            # event loop — use the non-blocking submission path
-            result = cw.submit_task_nowait(
-                self._fn, self._function_key, args, kwargs, **call_opts
-            )
-        else:
-            async def submit():
-                await cw.export_function(self._function_key, self._fn)
-                return await cw.submit_task(
-                    self._function_key, args, kwargs, **call_opts
-                )
-
-            result = cw.run_sync(submit())
-        if streaming or num_returns == 1:
-            return result[0] if not streaming else result
-        return result
+        cached = (streaming, num_returns, call_opts)
+        self._submit_cache = cached
+        return cached
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -154,9 +154,13 @@ class RpcServer:
                 logger.exception("%s: handler %s failed", self.name, method)
             resp = [_ERR, req_id, method, f"{type(e).__name__}: {e}"]
         try:
-            async with writer._rt_write_lock:
-                writer.write(_pack(resp))
-                await writer.drain()
+            writer.write(_pack(resp))
+            # drain (serialized across dispatch tasks) only under
+            # backpressure; below the high-water mark asyncio flushes the
+            # buffered frames itself at the end of the loop iteration
+            if writer.transport.get_write_buffer_size() > 256 * 1024:
+                async with writer._rt_write_lock:
+                    await writer.drain()
         except (ConnectionError, RuntimeError) as e:
             logger.warning(
                 "%s: reply to %s (req %s) lost: %s", self.name, method, req_id, e
@@ -252,21 +256,32 @@ class RpcClient:
         if self._closed:
             raise RpcError(f"{self.name}: client closed")
         last_exc: Exception | None = None
+        loop = asyncio.get_running_loop()
         for attempt in range(self.retries + 1):
             req_id = None
+            timer = None
             try:
-                async with self._lock:
-                    await self._ensure_connected()
+                # lock-free fast path: the connection is usually live
+                if self._writer is None or self._writer.is_closing():
+                    async with self._lock:
+                        await self._ensure_connected()
                 req_id = next(self._req_counter)
-                fut = asyncio.get_running_loop().create_future()
+                fut = loop.create_future()
                 self._pending[req_id] = fut
-                async with self._write_lock:
-                    writer = self._writer
-                    if writer is None:
-                        raise RpcConnectionLost(f"{self.name}: reconnect pending")
-                    writer.write(_pack([_REQ, req_id, method, payload]))
-                    await writer.drain()
-                return await asyncio.wait_for(fut, timeout)
+                writer = self._writer
+                if writer is None:
+                    raise RpcConnectionLost(f"{self.name}: reconnect pending")
+                writer.write(_pack([_REQ, req_id, method, payload]))
+                # drain only under backpressure: asyncio coalesces buffered
+                # writes per loop iteration, and drain() is a no-op (but not
+                # a free one) below the high-water mark
+                if writer.transport.get_write_buffer_size() > 256 * 1024:
+                    async with self._write_lock:
+                        await writer.drain()
+                if timeout is not None:
+                    timer = loop.call_later(
+                        timeout, self._expire_pending, req_id)
+                return await fut
             except (
                 ConnectionError,
                 asyncio.TimeoutError,
@@ -280,14 +295,25 @@ class RpcClient:
                 )
                 if req_id is not None:
                     self._pending.pop(req_id, None)
-                if self._writer is not None:
+                # only a CONNECTION-level failure poisons the transport; a
+                # per-call timeout must not tear down a socket other calls
+                # are using
+                if not isinstance(e, asyncio.TimeoutError) and self._writer is not None:
                     self._writer.close()
                     self._writer = None
                 if attempt < self.retries:
                     await asyncio.sleep(self.retry_delay * (2**attempt))
+            finally:
+                if timer is not None:
+                    timer.cancel()
         raise RpcError(
             f"{self.name}: call {method} to {self.address} failed after retries"
         ) from last_exc
+
+    def _expire_pending(self, req_id: int):
+        fut = self._pending.pop(req_id, None)
+        if fut is not None and not fut.done():
+            fut.set_exception(asyncio.TimeoutError(f"{self.name}: call timed out"))
 
     async def close(self):
         self._closed = True

@@ -95,8 +95,18 @@ class TrainController:
             topology=self.topology,
             accelerator_type=self.accelerator_type,
         )
-        group.create(latest_checkpoint=self.ckpt.latest)
-        group.start_training(self.train_fn, self.train_config)
+        try:
+            group.create(latest_checkpoint=self.ckpt.latest)
+            group.start_training(self.train_fn, self.train_config)
+        except BaseException:
+            # a half-created group leaks its named sync actor, workers, and
+            # placement group — the retry then collides on the name / starves
+            # on resources and fails with a confusing actor-kill cause
+            try:
+                group.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            raise
         return group
 
     def _ingest_reports(self, statuses: List[WorkerStatus],

@@ -61,9 +61,14 @@ class TestConfig:
         assert config.get("lease_spillback_max_hops") == 8
         os.environ["RAY_TPU_lease_spillback_max_hops"] = "3"
         try:
+            # resolved values are memoized (flags sit on per-task hot paths;
+            # the reference likewise reads RAY_<name> once at startup) —
+            # runtime env mutation requires an explicit reset()
+            GLOBAL_CONFIG.reset()
             assert config.get("lease_spillback_max_hops") == 3
         finally:
             del os.environ["RAY_TPU_lease_spillback_max_hops"]
+            GLOBAL_CONFIG.reset()
 
     def test_system_config_wins_over_env(self):
         os.environ["RAY_TPU_worker_pool_max_idle"] = "9"

@@ -30,7 +30,7 @@ def test_mesh_spec():
     assert jax.device_count() == 8
     spec = MeshSpec(dp=2, fsdp=2, tp=2, sp=1)
     mesh = spec.build()
-    assert mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+    assert mesh.shape == {"pp": 1, "dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
     assert MeshSpec.for_devices(8, tp=2).num_devices == 8
 
 

@@ -84,10 +84,13 @@ def test_pool_lease_cancel_before_delivery_removes_waiter():
 
 class _DaemonStub:
     def __init__(self):
+        import collections
+
         from ray_tpu._private.node_daemon import NodeDaemon
 
         self._lease_requests = {}
         self._lease_key_by_id = {}
+        self._cancelled_lease_keys = collections.OrderedDict()
         self.released = []
         self.rpc_cancel_lease_request = (
             NodeDaemon.rpc_cancel_lease_request.__get__(self)
@@ -143,11 +146,16 @@ def test_cancel_lease_request_releases_late_grant():
     assert asyncio.run(scenario())
 
 
-def test_cancel_lease_request_unknown_key_noop():
+def test_cancel_lease_request_unknown_key_tombstones():
+    """Cancel of a not-yet-arrived request tombstones the key so a late
+    request_lease frame is refused instead of granting an unclaimable
+    lease (review finding on the original no-op behavior)."""
+
     async def scenario():
         stub = _DaemonStub()
         out = await stub.rpc_cancel_lease_request(0, {"request_key": b"nope"})
         assert out["ok"] and stub.released == []
+        assert b"nope" in stub._cancelled_lease_keys
         return True
 
     assert asyncio.run(scenario())
