@@ -93,6 +93,14 @@ class ActorRecord:
             "class_key": self.spec.function_key,
             "max_task_retries": self.spec.max_task_retries,
             "method_meta": self.spec.method_meta,
+            # concurrent actors (async / threaded / concurrency groups)
+            # overlap executions, so owners must not couple their replies
+            # into batched pushes (head-of-line blocking)
+            "concurrent": bool(
+                self.spec.is_async_actor
+                or self.spec.max_concurrency > 1
+                or self.spec.concurrency_groups
+            ),
         }
 
     def to_persist(self) -> dict:

@@ -362,13 +362,19 @@ class ObjectRefGenerator:
                 pass
 
 
+class _ActorRestartedWhileQueued(Exception):
+    """Raised out of _await_push_turn when the actor's incarnation advanced
+    while this spec was parked: it must be restamped, not pushed stale."""
+
+
 class ActorHandleState:
     """Caller-side per-actor submission state (reference:
     actor_task_submitter.h:69 — ordered sequence numbers, address cache)."""
 
     __slots__ = ("actor_id", "seq", "address", "client", "state", "death_cause",
                  "event", "creation_keepalive", "incarnation", "ever_alive",
-                 "push_queue", "pump_running")
+                 "push_queue", "pump_running", "push_next", "push_incarnation",
+                 "push_waiters", "concurrent")
 
     def __init__(self, actor_id: bytes):
         self.actor_id = actor_id
@@ -377,6 +383,18 @@ class ActorHandleState:
         # into push_task_batch RPCs (reference: pipelined actor PushTask)
         self.push_queue: collections.deque = collections.deque()
         self.pump_running = False
+        # in-order push release (reference: SequentialActorSubmitQueue sends
+        # in sequence order): seq k+1 is never handed to the pump before k
+        # was pushed or terminally failed, so the executor's reorder buffer
+        # only ever spans in-flight deliveries — an args-gated predecessor
+        # (upstream still computing in an actor DAG) can take arbitrarily
+        # long without tripping the executor's lost-predecessor timeout.
+        self.push_next = 1
+        self.push_incarnation = 0
+        self.push_waiters: Dict[int, asyncio.Future] = {}
+        # async/threaded/concurrency-group actor: executions overlap on the
+        # worker, so replies must not be coupled into batched pushes
+        self.concurrent = False
         # bumped on every ALIVE transition to a replacement worker; per-
         # incarnation seq numbering restarts at 1 (reference: restart epoch
         # in actor_task_submitter.h). The first ALIVE keeps incarnation 0 so
@@ -1490,11 +1508,14 @@ class CoreWorker:
                                  num_returns: int = 1,
                                  max_task_retries: int = 0,
                                  stream_backpressure: int = -1,
-                                 concurrency_group: str = ""):
+                                 concurrency_group: str = "",
+                                 concurrent: bool = False):
         """Loop-thread-safe actor submission: the sequence number is taken
         synchronously (ordering is decided here), arg serialization and
         delivery continue in a spawned task."""
         st = self._actor_state(actor_id)
+        if concurrent:
+            st.concurrent = True
         task_id = TaskID.for_actor_task(
             self.job_id, ActorID(actor_id), self.current_task_id, self._next_seq(st)
         )
@@ -2517,8 +2538,11 @@ class CoreWorker:
         max_task_retries: int = 0,
         stream_backpressure: int = -1,
         concurrency_group: str = "",
+        concurrent: bool = False,
     ):
         st = self._actor_state(actor_id)
+        if concurrent:
+            st.concurrent = True
         # serialize BEFORE taking the sequence number: a failed serialization
         # must not consume a slot (ordered actors stall on sequence holes)
         wire_args = await self.serialize_args(args, kwargs)
@@ -2560,6 +2584,40 @@ class CoreWorker:
 
     async def _submit_actor_with_retries(self, st: ActorHandleState, spec: TaskSpec,
                                          max_task_retries: int, keepalive):
+        try:
+            await self._submit_actor_with_retries_inner(
+                st, spec, max_task_retries, keepalive)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — e.g. ObjectLostError from args
+            # exceptions outside the inner loop's handled set: the caller's
+            # refs must resolve (not hang), and the executor's sequence slot
+            # must be tombstoned or later seqs eat the ordering-gap timeout
+            self._fail_task(spec, e if isinstance(e, RayTpuError)
+                            else RayTpuError(f"actor submit failed: {e}"))
+            spec.cancelled = True
+            self.schedule(self._push_tombstone_quiet(st, spec))
+        finally:
+            # catch-all: a spec that terminally failed BEFORE its push (args
+            # lost, cancellation, actor death) must still release its push
+            # turn or every later sequence number blocks forever
+            self._release_push_turn(st, spec)
+
+    async def _push_tombstone_quiet(self, st: ActorHandleState, spec: TaskSpec):
+        """Best-effort delivery of a cancelled tombstone so the executor's
+        sequence window advances past a terminally-failed spec."""
+        try:
+            await self.wait_actor_alive(st.actor_id, timeout=30)
+            if st.client is None:
+                st.client = RpcClient(st.address, name="to-actor", retries=0)
+                await st.client.connect()
+            await self._actor_push(st, spec)
+        except Exception:  # noqa: BLE001 — the gap timeout is the fallback
+            pass
+
+    async def _submit_actor_with_retries_inner(
+            self, st: ActorHandleState, spec: TaskSpec,
+            max_task_retries: int, keepalive):
         attempt = 0
         while True:
             sub = self._submissions.get(spec.task_id.binary())
@@ -2598,6 +2656,11 @@ class CoreWorker:
                 self._fail_task(spec, TaskCancelledError(
                     f"actor task {spec.method_name} was cancelled"))
                 raise
+            except _ActorRestartedWhileQueued:
+                # parked in the push queue across a restart: loop to restamp
+                # into the new incarnation (never delivered — does not
+                # consume a user retry; bounded by actual restarts)
+                continue
             except (ActorDiedError, ActorUnavailableError) as e:
                 self._fail_task(spec, e)
                 return
@@ -2626,13 +2689,90 @@ class CoreWorker:
                     return
                 await asyncio.sleep(min(0.2 * (2 ** attempt), 5.0))
 
+    async def _await_push_turn(self, st: ActorHandleState, spec: TaskSpec):
+        """Block until every lower sequence number of this incarnation has
+        been pushed (or terminally failed). Retried pushes (seq <= push_next)
+        pass straight through. A spec whose incarnation is now STALE (the
+        actor restarted while it was parked here) must NOT be pushed — it
+        would execute unordered on the fresh executor ahead of its restamped
+        predecessors — so it is bounced back to the retry loop for
+        restamping."""
+        if spec.seq_no < 0:
+            return
+        while True:
+            if spec.incarnation > st.push_incarnation:
+                # actor restarted: fresh incarnation numbers from 1
+                st.push_incarnation = spec.incarnation
+                st.push_next = 1
+                self._wake_push_waiters(st, wake_all=True)
+            if spec.incarnation < st.push_incarnation:
+                raise _ActorRestartedWhileQueued(
+                    f"incarnation {spec.incarnation} superseded by "
+                    f"{st.push_incarnation}")
+            if spec.seq_no <= st.push_next:
+                return
+            fut = self.loop.create_future()
+            st.push_waiters[spec.seq_no] = fut
+            try:
+                await fut
+            finally:
+                if st.push_waiters.get(spec.seq_no) is fut:
+                    st.push_waiters.pop(spec.seq_no, None)
+
+    def _release_push_turn(self, st: ActorHandleState, spec: TaskSpec):
+        """Idempotent: the push went out (or the spec terminally failed) —
+        let the next sequence number proceed. Handles an incarnation the
+        await path never saw (a spec restamped then failed before pushing):
+        dropping such a release would deadlock every later submission."""
+        if spec.seq_no < 0:
+            return
+        if spec.incarnation > st.push_incarnation:
+            st.push_incarnation = spec.incarnation
+            st.push_next = 1
+            self._wake_push_waiters(st, wake_all=True)
+        if spec.incarnation != st.push_incarnation:
+            return  # stale incarnation: its ordering domain is gone
+        if spec.seq_no + 1 > st.push_next:
+            st.push_next = spec.seq_no + 1
+            self._wake_push_waiters(st)
+
+    @staticmethod
+    def _wake_push_waiters(st: ActorHandleState, wake_all: bool = False):
+        """Wake exactly the waiters whose turn arrived (keyed by seq — a
+        broadcast would cost O(n^2) wakeups over a deep backlog)."""
+        if wake_all:
+            waiters, st.push_waiters = st.push_waiters, {}
+            for fut in waiters.values():
+                if not fut.done():
+                    fut.set_result(True)
+            return
+        ready = [s for s in st.push_waiters if s <= st.push_next]
+        for s in ready:
+            fut = st.push_waiters.pop(s)
+            if not fut.done():
+                fut.set_result(True)
+
     async def _actor_push(self, st: ActorHandleState, spec: TaskSpec) -> dict:
         """Coalesced actor-task delivery: enqueue and let one per-actor pump
         ship batches over the connection (reference: pipelined PushTask on
-        the actor client). Delivery order may interleave across callers'
-        coroutines — the executor's sequence reorder buffer owns ordering."""
+        the actor client). Pushes are RELEASED in sequence order (see
+        _await_push_turn); the executor's reorder buffer then only covers
+        in-flight wire/dispatch reordering.
+
+        CONCURRENT actors (async/threaded/concurrency groups) bypass the
+        pump entirely: their executions overlap on the worker, and a batched
+        reply would couple a fast method's completion to the slowest task in
+        its batch (head-of-line blocking across concurrency lanes)."""
+        if st.concurrent:
+            client = st.client
+            if client is None:
+                raise RpcConnectionLost("actor client not connected")
+            return await client.call(
+                "push_task", {"spec": spec.to_wire()}, timeout=None)
+        await self._await_push_turn(st, spec)
         fut = self.loop.create_future()
         st.push_queue.append((spec, fut))
+        self._release_push_turn(st, spec)
         if not st.pump_running:
             st.pump_running = True
             spawn(self._actor_push_pump(st))

@@ -172,19 +172,35 @@ class TaskExecutor:
     # ------------------------------------------------------------------
 
     async def execute_batch(self, specs) -> list:
-        replies = []
+        replies: list = [None] * len(specs)
+        # slow-path specs dispatch CONCURRENTLY (awaiting each inline would
+        # serialize async/threaded/concurrency-group actors that must
+        # overlap); plain sync tasks still serialize on the single executor
+        # thread, preserving the one-lease-one-task resource model
+        slow: list = []
         i = 0
         n = len(specs)
         while i < n:
-            group = []
+            group: list = []
             group_seq: Dict[bytes, int] = {}
+            start = i
             while i < n and await self._fast_prep(specs[i], group, group_seq):
                 i += 1
             if group:
-                replies.extend(await self._execute_fast_group(group))
+                for j, r in enumerate(await self._execute_fast_group(group)):
+                    replies[start + j] = r
             if i < n:
-                replies.append(await self.execute(specs[i]))
+                slow.append((i, asyncio.ensure_future(self.execute(specs[i]))))
                 i += 1
+        exc: Optional[BaseException] = None
+        for idx, task in slow:
+            try:
+                replies[idx] = await task
+            except BaseException as e:  # noqa: BLE001 — collect, drain rest
+                if exc is None:
+                    exc = e
+        if exc is not None:
+            raise exc
         return replies
 
     async def _fast_prep(self, spec: pb.TaskSpec, group: list,

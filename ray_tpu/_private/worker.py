@@ -347,6 +347,7 @@ def _handle_from_named_actor_reply(name: str, reply: dict) -> "Any":
         class_key=rec.get("class_key", ""),
         method_meta=rec.get("method_meta") or None,
         max_task_retries=rec.get("max_task_retries", 0),
+        concurrent=rec.get("concurrent", False),
     )
 
 

@@ -81,11 +81,15 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_key: str, method_meta: Optional[dict],
-                 max_task_retries: int = 0, _owned: bool = False):
+                 max_task_retries: int = 0, concurrent: bool = False,
+                 _owned: bool = False):
         self._actor_id = actor_id
         self._class_key = class_key
         self._method_meta = method_meta or {}
         self._max_task_retries = max_task_retries
+        # async/threaded/concurrency-group actor: executions overlap, so
+        # pushes bypass reply batching (see CoreWorker._actor_push)
+        self._concurrent = concurrent
         self._owned = _owned
         if _owned:
             get_core_worker().add_actor_handle_ref(actor_id.binary())
@@ -112,6 +116,7 @@ class ActorHandle:
                 num_returns=wire_returns,
                 max_task_retries=self._max_task_retries,
                 concurrency_group=concurrency_group,
+                concurrent=self._concurrent,
             )
         else:
             result = cw.run_sync(
@@ -120,6 +125,7 @@ class ActorHandle:
                     num_returns=wire_returns,
                     max_task_retries=self._max_task_retries,
                     concurrency_group=concurrency_group,
+                    concurrent=self._concurrent,
                 )
             )
         if streaming:
@@ -144,7 +150,8 @@ class ActorHandle:
     def __reduce__(self):
         return (
             ActorHandle,
-            (self._actor_id, self._class_key, self._method_meta, self._max_task_retries),
+            (self._actor_id, self._class_key, self._method_meta,
+             self._max_task_retries, self._concurrent),
         )
 
     def _actor_info(self) -> dict:
@@ -249,9 +256,12 @@ class ActorClass:
             actor_id = cw.run_sync(create())
         # Unnamed, non-detached actors are GC'd with the creator's last handle.
         owned = not opts.get("name") and opts.get("lifetime") != "detached"
+        concurrent = bool(
+            is_async or opts.get("max_concurrency", 0) > 1 or groups)
         return ActorHandle(
             actor_id, self._class_key, method_meta,
             max_task_retries=opts.get("max_task_retries", 0),
+            concurrent=concurrent,
             _owned=owned,
         )
 

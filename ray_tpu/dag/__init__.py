@@ -14,7 +14,14 @@ intra-node) and stage overlap comes from issuing every stage's task eagerly
 with chained refs — executions pipeline across actors because each actor's
 ordered queue starts stage N of call i while downstream actors still run
 call i-1. Device-to-device tensor movement belongs to jax.Arrays inside a
-sharded step, not to the graph plane."""
+sharded step, not to the graph plane.
+
+Pipeline-parallel TRAINING has two dedicated implementations on top of
+these primitives: ray_tpu.parallel.pipeline (in-jit GPipe over the "pp"
+mesh axis — ppermute hand-off, the TPU-native fast path) and
+ray_tpu.train.pipeline_actors (stage actors + 1F1B through this actor/
+object plane — the reference's compiled-DAG shape, for cross-process/
+cross-failure-domain stages)."""
 
 from __future__ import annotations
 

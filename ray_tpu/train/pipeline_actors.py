@@ -1,0 +1,250 @@
+"""Actor-plane pipeline parallelism — stage actors + 1F1B microbatch
+schedule over the object store.
+
+This is the reference-shaped PP path (reference:
+python/ray/dag/compiled_dag_node.py:813 — compiled actor DAGs exist to
+drive PP through preallocated channels;
+python/ray/experimental/channel/torch_tensor_accelerator_channel.py:1).
+Each pipeline stage is an actor owning a contiguous slice of layers (+ the
+embedding on the first stage, norm + LM head on the last), with jitted
+forward/backward closures. Activations and gradients hand off through the
+shared-memory object plane (host-staged v1; on one host the transfer is
+zero-copy shm). The driver submits ops in per-stage 1F1B order; because
+actor queues execute strictly in submission order and argument refs gate
+delivery, the classic one-forward-one-backward interleave — bounding live
+residuals per stage at (S - stage) instead of M — emerges from ordinary
+task ordering, no channel protocol needed.
+
+The in-jit SPMD pipeline (ray_tpu/parallel/pipeline.py, "pp" mesh axis +
+ppermute) is the TPU-native fast path; this actor version covers the
+reference's cross-process shape — stages can live in different processes,
+hosts, or failure domains, and compose with the scheduler (placement
+groups pin stages to nodes/slices).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+def _slice_layers(layers: Dict[str, Any], lo: int, hi: int) -> Dict[str, Any]:
+    return {k: v[lo:hi] for k, v in layers.items()}
+
+
+@ray_tpu.remote
+class PipelineStage:
+    """One pipeline stage: a contiguous block of decoder layers.
+
+    Stages initialize the FULL parameter tree from the same seed and keep
+    only their slice — bit-identical to a single-stage run's init, which is
+    what makes the loss-parity test exact (optimizer updates are
+    elementwise, so per-slice AdamW == sliced full-tree AdamW).
+    """
+
+    def __init__(self, cfg, stage_id: int, n_stages: int, seed: int = 0,
+                 learning_rate: float = 3e-4):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.llama import init_params, rms_norm, rope_tables, _layer
+
+        self.cfg = cfg
+        self.sid = stage_id
+        self.S = n_stages
+        self.first = stage_id == 0
+        self.last = stage_id == n_stages - 1
+        L = cfg.n_layers
+        assert L % n_stages == 0
+        per = L // n_stages
+        full = init_params(cfg, jax.random.key(seed))
+        params: Dict[str, Any] = {
+            "layers": _slice_layers(full["layers"], stage_id * per,
+                                    (stage_id + 1) * per),
+        }
+        if self.first:
+            params["tok_emb"] = full["tok_emb"]
+        if self.last:
+            params["norm"] = full["norm"]
+            params["lm_head"] = full["lm_head"]
+        self.params = params
+        self.tx = optax.adamw(learning_rate)
+        self.opt_state = self.tx.init(self.params)
+        self._residuals: Dict[int, Any] = {}  # mb_id -> vjp closure
+        self._grad_acc = None
+        self._n_acc = 0
+        dt = cfg.dtype
+
+        def stage_fwd(params, x, tokens):
+            """x: activations from the previous stage ((mb, s, d)) or None
+            for the first stage (embeds `tokens` itself). Returns activations
+            or, on the last stage, the microbatch's masked mean NLL."""
+            if self.first:
+                h = params["tok_emb"].astype(dt)[tokens]
+            else:
+                h = x.astype(dt)
+            positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            cos, sin = rope_tables(cfg, positions)
+            def body(carry, lp):
+                return _layer(cfg, None, carry, lp, cos, sin), None
+            h, _ = jax.lax.scan(body, h, params["layers"])
+            if not self.last:
+                return h
+            h = rms_norm(h, params["norm"], cfg.norm_eps)
+            logits = (h @ params["lm_head"].astype(dt)).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            tgt = tokens[:, 1:]
+            nll = -jnp.take_along_axis(
+                logp[:, :-1], tgt[..., None], axis=-1)[..., 0]
+            return nll.mean()
+
+        def stage_bwd(params, x, tokens, dy):
+            """Rematerialized backward: recompute the forward under vjp and
+            pull gradients (per-stage activation remat — the standard PP
+            memory/compute trade)."""
+            _, vjp = jax.vjp(lambda p, xx: stage_fwd(p, xx, tokens), params, x)
+            return vjp(dy)
+
+        self._jax = jax
+        self._jnp = jnp
+        # jit both halves: un-jitted vjp retraces on EVERY microbatch and a
+        # multi-second op can outlast the executor's ordering-gap timeout
+        self._jfwd = jax.jit(stage_fwd)
+        self._jbwd = jax.jit(stage_bwd)
+
+    # -- schedule ops ---------------------------------------------------
+
+    def forward(self, mb_id: int, x, tokens):
+        """Run this stage's forward for microbatch `mb_id`, saving the
+        (input, tokens) residuals for the rematerialized backward. Returns
+        activations (or the scalar loss on the last stage)."""
+        jax = self._jax
+        x = None if x is None else jax.device_put(np.asarray(x))
+        tokens = jax.device_put(np.asarray(tokens))
+        out = self._jfwd(self.params, x, tokens)
+        self._residuals[mb_id] = (x, tokens)
+        if self.last:
+            return float(out)
+        return np.asarray(out)
+
+    def backward(self, mb_id: int, dy=None):
+        """Backward for microbatch `mb_id`; `dy` is the activation gradient
+        from the next stage (None on the last stage — the loss seeds it).
+        Accumulates parameter grads; returns dx for the previous stage (or
+        None on the first)."""
+        jax = self._jax
+        jnp = self._jnp
+        x, tokens = self._residuals.pop(mb_id)
+        if self.last:
+            seed = jnp.float32(1.0)
+        else:
+            seed = jax.device_put(np.asarray(dy)).astype(self.cfg.dtype)
+        dparams, dx = self._jbwd(self.params, x, tokens, seed)
+        if self._grad_acc is None:
+            self._grad_acc = dparams
+        else:
+            self._grad_acc = jax.tree.map(
+                lambda a, b: a + b, self._grad_acc, dparams)
+        self._n_acc += 1
+        if self.first:
+            return None
+        return np.asarray(dx)
+
+    def apply_gradients(self):
+        """Average accumulated microbatch grads and take one AdamW step."""
+        import optax
+
+        jax = self._jax
+        assert self._grad_acc is not None and not self._residuals, (
+            "apply_gradients before all backwards completed")
+        grads = jax.tree.map(lambda g: g / self._n_acc, self._grad_acc)
+        updates, self.opt_state = self.tx.update(
+            grads, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        self._grad_acc = None
+        self._n_acc = 0
+        return True
+
+
+def _one_f_one_b_order(S: int, M: int, sid: int) -> List[tuple]:
+    """Per-stage op order implementing 1F1B: warmup of (S - sid) forwards,
+    then alternate backward/forward, then drain backwards."""
+    warmup = min(M, S - sid)
+    ops: List[tuple] = [("F", m) for m in range(warmup)]
+    nf, nb = warmup, 0
+    while nb < M:
+        ops.append(("B", nb))
+        nb += 1
+        if nf < M:
+            ops.append(("F", nf))
+            nf += 1
+    return ops
+
+
+class ActorPipeline:
+    """Driver-side handle: S stage actors + the 1F1B step schedule."""
+
+    def __init__(self, cfg, n_stages: int, n_microbatches: int,
+                 learning_rate: float = 3e-4, seed: int = 0,
+                 stage_options: Optional[List[dict]] = None):
+        self.S = n_stages
+        self.M = n_microbatches
+        self.stages = []
+        for s in range(n_stages):
+            klass = PipelineStage
+            if stage_options and stage_options[s]:
+                klass = PipelineStage.options(**stage_options[s])
+            self.stages.append(klass.remote(
+                cfg, s, n_stages, seed=seed, learning_rate=learning_rate))
+
+    def train_step(self, tokens: np.ndarray, timeout: float = 300.0) -> float:
+        """One synchronous optimizer step over `tokens` (B, seq); B % M == 0.
+        Returns the mean microbatch loss."""
+        B = tokens.shape[0]
+        assert B % self.M == 0
+        mbs = tokens.reshape(self.M, B // self.M, -1)
+        S, M = self.S, self.M
+
+        fwd_out: Dict[tuple, Any] = {}   # (sid, mb) -> activation/loss ref
+        bwd_out: Dict[tuple, Any] = {}   # (sid, mb) -> dx ref
+        # submit in per-stage 1F1B order; refs gate cross-stage dependencies
+        # and actor queues serialize per-stage execution in this exact order
+        pending: Dict[int, List[tuple]] = {
+            s: _one_f_one_b_order(S, M, s) for s in range(S)}
+        done: Dict[int, int] = {s: 0 for s in range(S)}
+        while any(done[s] < len(pending[s]) for s in range(S)):
+            progressed = False
+            for s in range(S):
+                while done[s] < len(pending[s]):
+                    op, m = pending[s][done[s]]
+                    if op == "F":
+                        x = None if s == 0 else fwd_out.get((s - 1, m))
+                        if s > 0 and x is None:
+                            break  # predecessor forward not yet submitted
+                        fwd_out[(s, m)] = self.stages[s].forward.remote(
+                            m, x, mbs[m])
+                    else:
+                        dy = None if s == S - 1 else bwd_out.get((s + 1, m))
+                        if s < S - 1 and dy is None:
+                            break  # successor backward not yet submitted
+                        bwd_out[(s, m)] = self.stages[s].backward.remote(m, dy)
+                    done[s] += 1
+                    progressed = True
+            assert progressed, "1F1B schedule wedged (cyclic dependency?)"
+        losses = ray_tpu.get(
+            [fwd_out[(S - 1, m)] for m in range(M)], timeout=timeout)
+        ray_tpu.get(
+            [st.apply_gradients.remote() for st in self.stages],
+            timeout=timeout)
+        return float(np.mean(losses))
+
+    def shutdown(self):
+        for st in self.stages:
+            try:
+                ray_tpu.kill(st)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
