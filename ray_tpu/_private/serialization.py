@@ -131,6 +131,13 @@ def serialize(value: Any) -> SerializedObject:
                 inband = pickle.dumps(
                     value, protocol=5, buffer_callback=buffer_callback
                 )
+            if b"__main__" in inband:
+                # plain pickle serialized a __main__-defined class/function
+                # BY REFERENCE — unimportable in worker processes (their
+                # __main__ is default_worker). cloudpickle serializes
+                # __main__ definitions by value; rare false positives (user
+                # bytes containing the literal) just take the slower path.
+                raise pickle.PicklingError("__main__ by-reference")
         except (pickle.PicklingError, AttributeError, TypeError):
             # lambdas / closures / local classes (e.g. Dataset UDFs riding as
             # task args): cloudpickle, same protocol-5 out-of-band buffers

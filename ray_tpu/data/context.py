@@ -1,0 +1,33 @@
+"""Execution context for ray_tpu.data (reference:
+python/ray/data/context.py DataContext — the knobs the streaming executor
+and resource manager read)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class DataContext:
+    """Per-driver data-execution settings.
+
+    streaming_block_window — max source blocks in flight end-to-end during
+    streaming consumption (iter_batches / iter_rows / take on an
+    unmaterialized dataset). The memory ceiling is roughly
+    window × max block size; consumed blocks free their shm copies before
+    new ones are admitted (reference: streaming_executor resource manager's
+    bounded operator memory).
+    """
+
+    streaming_block_window: int = 8
+    # advisory target for readers choosing block splits
+    target_max_block_size: int = 128 * 1024 * 1024
+
+    _current: "Optional[DataContext]" = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._current is None:
+            cls._current = DataContext()
+        return cls._current

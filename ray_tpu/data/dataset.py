@@ -58,7 +58,189 @@ def _run_chain(producer_or_block, ops: List[_Op]) -> Block:
     """The per-block fused task body: produce (or receive) the source block,
     then apply the whole op chain."""
     block = producer_or_block() if callable(producer_or_block) else producer_or_block
+    from ray_tpu._private.core_worker import ObjectRef
+
+    if isinstance(block, ObjectRef):
+        # closure-captured ref (union of materialized datasets): resolve
+        # in-task — only top-level args resolve automatically
+        import ray_tpu
+
+        block = ray_tpu.get(block, timeout=600)
     return _apply_ops(block, ops)
+
+
+# A pipeline stage: ("tasks", ops) — stateless fused segment, one task per
+# block; or ("actors", udf_factory, args, kwargs, concurrency) — stateful
+# map_batches through an actor pool (reference:
+# python/ray/data/_internal/execution/operators/actor_pool_map_operator.py:1).
+_Stage = Tuple
+
+
+def _stable_key_hash(v) -> int:
+    """Deterministic cross-process key hash for shuffles/joins. NOT hash():
+    str hashing is per-process randomized. Numeric keys canonicalize first
+    (1, 1.0, np.int64(1), True are dict-equal and must co-partition)."""
+    import hashlib as _hl
+
+    if hasattr(v, "item"):
+        v = v.item()
+    if isinstance(v, bool):
+        v = int(v)
+    if isinstance(v, float) and v.is_integer():
+        v = int(v)
+    d = _hl.blake2b(repr(v).encode(), digest_size=8).digest()
+    return int.from_bytes(d, "little")
+
+
+def _slice_row_range(lo: int, hi: int, block_starts, *blocks) -> Block:
+    """Rows [lo, hi) of a virtual concatenation, given each block's global
+    start offset (shared by repartition and zip alignment)."""
+    parts = []
+    for s, b in zip(block_starts, blocks):
+        n = block_num_rows(b)
+        a, z = max(lo, s), min(hi, s + n)
+        if z > a:
+            parts.append(block_slice(b, a - s, z - s))
+    return block_concat(parts) if parts else rows_to_block([])
+
+
+class _CallableWrapper:
+    """Adapts a plain function to the actor-pool UDF-class protocol."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, batch):
+        return self._fn(batch)
+
+    @staticmethod
+    def of(fn):
+        import functools
+
+        return functools.partial(_CallableWrapper, fn)
+
+
+class _ActorPool:
+    """Round-robin pool of map actors for one stateful stage."""
+
+    def __init__(self, udf_cls, fn_args, fn_kwargs, size: int):
+        import ray_tpu
+
+        @ray_tpu.remote
+        class _MapWorker:
+            def __init__(self, cls, args, kwargs):
+                self._fn = cls(*args, **kwargs)
+
+            def transform(self, block):
+                return self._fn(normalize_batch(block))
+
+        self._actors = [
+            _MapWorker.remote(udf_cls, list(fn_args), dict(fn_kwargs))
+            for _ in range(size)
+        ]
+        self._i = 0
+
+    def submit(self, block_ref):
+        a = self._actors[self._i % len(self._actors)]
+        self._i += 1
+        return a.transform.remote(block_ref)
+
+    def shutdown(self):
+        import ray_tpu
+
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+
+
+class _Pipeline:
+    """Executable form of a Dataset plan: source producers + stage list.
+    Submits ONE chained ref pipeline per source block; actor stages route
+    through their pool."""
+
+    def __init__(self, producers, stages: List[_Stage]):
+        from ray_tpu.remote_function import RemoteFunction
+
+        self.producers = producers
+        self.stages = stages
+        self._run = RemoteFunction(_run_chain)
+        self._pools: List[Optional[_ActorPool]] = []
+        for st in stages:
+            if st[0] == "actors":
+                _, cls, args, kwargs, size = st
+                self._pools.append(_ActorPool(cls, args, kwargs, size))
+            else:
+                self._pools.append(None)
+
+    def submit_block(self, producer):
+        """Chain the whole stage pipeline for one source block; returns the
+        final block ref. No barriers — downstream stages start as soon as
+        their input ref resolves."""
+        from ray_tpu._private.core_worker import ObjectRef
+
+        ref = producer
+        materialized = isinstance(ref, ObjectRef)
+        for st, pool in zip(self.stages, self._pools):
+            if st[0] == "tasks":
+                if st[1] or not materialized:
+                    ref = self._run.remote(ref, st[1])
+                    materialized = True
+            else:
+                if not materialized:
+                    # actor stage first: actors take BLOCKS, so a callable
+                    # source materializes through one producer task
+                    ref = self._run.remote(ref, [])
+                    materialized = True
+                ref = pool.submit(ref)
+        if not materialized:
+            ref = self._run.remote(ref, [])
+        return ref
+
+    def shutdown(self):
+        for p in self._pools:
+            if p is not None:
+                p.shutdown()
+
+
+class _StreamingExecutor:
+    """Bounded-memory pull-based execution (reference:
+    python/ray/data/_internal/execution/streaming_executor.py:106,423,499).
+
+    At most `window` source blocks are in flight end-to-end; the consumer's
+    pull releases a finished block's ref (freeing its shm copy via
+    ownership refcounting) before the next source block is admitted —
+    datasets far larger than the object store stream through a constant
+    footprint. Per-op concurrency = window for fused task segments plus the
+    actor-pool sizes of stateful stages; backpressure is the pull itself."""
+
+    def __init__(self, producers, stages: List[_Stage], window: int):
+        self.pipeline = _Pipeline(producers, stages)
+        self.window = max(1, window)
+
+    def __iter__(self) -> Iterator[Block]:
+        import collections
+
+        import ray_tpu
+
+        pending = collections.deque()  # in-order final refs
+        todo = list(self.pipeline.producers)
+        i = 0
+        try:
+            while todo or pending:
+                while i < len(todo) and len(pending) < self.window:
+                    pending.append(self.pipeline.submit_block(todo[i]))
+                    i += 1
+                if i >= len(todo):
+                    todo = []
+                if pending:
+                    ref = pending.popleft()
+                    block = ray_tpu.get(ref, timeout=600)
+                    del ref  # last local ref → owner frees the shm copy
+                    yield block
+        finally:
+            self.pipeline.shutdown()
 
 
 class Dataset:
@@ -71,20 +253,51 @@ class Dataset:
     """
 
     def __init__(self, producers: List[Any], ops: Optional[List[_Op]] = None,
-                 *, _refs: Optional[List[Any]] = None):
+                 *, _refs: Optional[List[Any]] = None,
+                 _pre_stages: Optional[List[_Stage]] = None):
         self._producers = producers
         self._ops: List[_Op] = list(ops or [])
+        # completed pipeline segments before the trailing fused chain
+        # (actor-pool stages split the chain)
+        self._pre_stages: List[_Stage] = list(_pre_stages or [])
         self._refs = _refs  # cached materialized block refs
+
+    def _stages(self) -> List[_Stage]:
+        stages = list(self._pre_stages)
+        if self._ops or not stages:
+            stages.append(("tasks", self._ops))
+        return stages
 
     # -- transforms (lazy) ---------------------------------------------
 
     def _chain(self, kind: str, fn: Callable) -> "Dataset":
-        base = self._refs if self._refs is not None else self._producers
-        ops = [] if self._refs is not None else self._ops
-        return Dataset(list(base), ops + [(kind, fn)])
+        if self._refs is not None:
+            return Dataset(list(self._refs), [(kind, fn)])
+        return Dataset(list(self._producers), self._ops + [(kind, fn)],
+                       _pre_stages=self._pre_stages)
 
-    def map_batches(self, fn: Callable[[Block], Block]) -> "Dataset":
-        """Apply fn to whole blocks in columnar {col: ndarray} form."""
+    def map_batches(self, fn: Any, *, concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None) -> "Dataset":
+        """Apply fn to whole blocks in columnar {col: ndarray} form.
+
+        A CLASS (or any callable with `concurrency=`) becomes a stateful
+        actor-pool stage: `concurrency` actors each construct the UDF once
+        (fn_constructor_args) and stream blocks through it — the reference's
+        ActorPoolMapOperator, for UDFs with expensive setup (model weights,
+        tokenizers)."""
+        if concurrency is not None or isinstance(fn, type):
+            base = self._refs if self._refs is not None else self._producers
+            pre = [] if self._refs is not None else self._pre_stages
+            ops = [] if self._refs is not None else self._ops
+            udf = fn if isinstance(fn, type) else _CallableWrapper.of(fn)
+            stage = ("actors", udf, tuple(fn_constructor_args),
+                     dict(fn_constructor_kwargs or {}), int(concurrency or 1))
+            return Dataset(
+                list(base), [],
+                _pre_stages=pre + [("tasks", ops), stage] if ops
+                else pre + [stage],
+            )
         return self._chain("map_batches", fn)
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
@@ -99,24 +312,48 @@ class Dataset:
     # -- execution ------------------------------------------------------
 
     def materialize(self) -> "Dataset":
-        """Execute the plan: one fused remote task per block. Returns a
-        Dataset backed by block ObjectRefs (repeat consumption is free)."""
+        """Execute the plan: one fused remote task per block (actor stages
+        route through their pools). Returns a Dataset backed by block
+        ObjectRefs (repeat consumption is free)."""
         if self._refs is not None:
             return self
         import ray_tpu
-        from ray_tpu.remote_function import RemoteFunction
-
-        run = RemoteFunction(_run_chain)
-        ops = self._ops
-        refs = []
         from ray_tpu._private.core_worker import ObjectRef
 
-        for p in self._producers:
-            if isinstance(p, ObjectRef) and not ops:
-                refs.append(p)
-            else:
-                refs.append(run.remote(p, ops))
+        stages = self._stages()
+        if len(stages) == 1 and stages[0] == ("tasks", []):
+            if all(isinstance(p, ObjectRef) for p in self._producers):
+                refs = list(self._producers)
+                return Dataset(refs, [], _refs=refs)
+        pipeline = _Pipeline(self._producers, stages)
+        refs = [pipeline.submit_block(p) for p in self._producers]
+        if any(pool is not None for pool in pipeline._pools):
+            # actor pools must outlive their in-flight blocks
+            ray_tpu.wait(refs, num_returns=len(refs), timeout=None)
+        pipeline.shutdown()
         return Dataset(refs, [], _refs=refs)
+
+    def iter_blocks(self, *, window: Optional[int] = None) -> Iterator[Block]:
+        """STREAMING consumption: pull blocks through the plan with at most
+        `window` source blocks in flight (bounded memory — see
+        _StreamingExecutor). Materialized datasets iterate their cached
+        refs.
+
+        Streaming deliberately does NOT cache results: repeat consumption
+        re-executes the plan (and re-creates actor pools). Call
+        materialize() first to pin block refs for repeated reads — the
+        aggregate/sort/shuffle paths do so internally via _block_refs."""
+        import ray_tpu
+
+        if self._refs is not None:
+            for ref in self._refs:
+                yield ray_tpu.get(ref, timeout=600)
+            return
+        if window is None:
+            from ray_tpu.data.context import DataContext
+
+            window = DataContext.get_current().streaming_block_window
+        yield from _StreamingExecutor(self._producers, self._stages(), window)
 
     def _block_refs(self) -> List[Any]:
         # cache the materialization on THIS dataset too: repeated consumers
@@ -140,11 +377,8 @@ class Dataset:
         )
 
     def take(self, limit: int = 20) -> List[Any]:
-        import ray_tpu
-
         out: List[Any] = []
-        for ref in self._block_refs():
-            block = ray_tpu.get(ref, timeout=600)
+        for block in self.iter_blocks():
             for row in block_rows(block):
                 out.append(row)
                 if len(out) >= limit:
@@ -155,10 +389,8 @@ class Dataset:
         return self.take(limit=2**62)
 
     def iter_rows(self) -> Iterator[Any]:
-        import ray_tpu
-
-        for ref in self._block_refs():
-            yield from block_rows(ray_tpu.get(ref, timeout=600))
+        for block in self.iter_blocks():
+            yield from block_rows(block)
 
     def iter_batches(
         self,
@@ -173,15 +405,12 @@ class Dataset:
         device_put=True moves each numpy batch onto the default JAX device
         before yielding — host→device transfer overlaps the consumer's step
         (the reference's iter_torch_batches prefetch, TPU-flavored).
-        """
-        import ray_tpu
 
-        # All block tasks were submitted at materialize() and compute in
-        # parallel; an in-order get() therefore always has `prefetch_blocks`+
-        # of work racing ahead of the consumer. (prefetch_blocks is accepted
-        # for API parity; the window is effectively the whole plan.)
-        del prefetch_blocks
-        refs = self._block_refs()
+        Unmaterialized datasets STREAM: at most `prefetch_blocks` source
+        blocks are in flight and consumed blocks free their shm copies
+        before more are admitted, so datasets larger than the object store
+        iterate in constant memory.
+        """
         carry: Optional[Block] = None
 
         def to_out(b: Block) -> Block:
@@ -191,8 +420,9 @@ class Dataset:
                 return {k: jax.device_put(v) for k, v in b.items()}
             return b
 
-        for ref in refs:
-            block = ray_tpu.get(ref, timeout=600)
+        for block in self.iter_blocks(
+                window=None if prefetch_blocks is None
+                else max(1, prefetch_blocks)):
             carry = block if carry is None else block_concat([carry, block])
             if batch_size is None:
                 yield to_out(carry)
@@ -240,16 +470,7 @@ class Dataset:
         starts = list(np.cumsum([0] + counts))  # global start offset per block
         total = starts[-1]
 
-        def _slice_rows(lo: int, hi: int, block_starts, *blocks):
-            parts = []
-            for s, b in zip(block_starts, blocks):
-                n = block_num_rows(b)
-                a, z = max(lo, s), min(hi, s + n)
-                if z > a:
-                    parts.append(block_slice(b, a - s, z - s))
-            return block_concat(parts) if parts else rows_to_block([])
-
-        run = RemoteFunction(_slice_rows)
+        run = RemoteFunction(_slice_row_range)
         new_refs = []
         for i in range(num_blocks):
             lo, hi = (total * i) // num_blocks, (total * (i + 1)) // num_blocks
@@ -379,6 +600,135 @@ class Dataset:
         hash-shuffle aggregate ops)."""
         return GroupedData(self, key)
 
+    # -- multi-dataset ops (reference: Dataset.union/zip/join) ----------
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (block-wise, no materialization): each
+        source block carries its own pending chain into the combined plan."""
+        import functools
+
+        def items(ds: "Dataset") -> List[Any]:
+            if ds._refs is not None:
+                return list(ds._refs)
+            stages = ds._stages()
+            if stages == [("tasks", [])]:
+                return list(ds._producers)
+            if all(s[0] == "tasks" for s in stages):
+                ops = [op for s in stages for op in s[1]]
+                return [functools.partial(_run_chain, p, ops)
+                        for p in ds._producers]
+            # actor stages can't ride a closure: materialize that branch
+            return list(ds.materialize()._refs)
+
+        combined: List[Any] = []
+        for ds in (self, *others):
+            combined.extend(items(ds))
+        return Dataset(combined, [])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two datasets with equal row counts (reference:
+        Dataset.zip): the other dataset is range-repartitioned to this one's
+        block boundaries, then each aligned pair merges columns in one task
+        (duplicate names get a _1 suffix)."""
+        import ray_tpu
+        from ray_tpu.remote_function import RemoteFunction
+
+        left = self._block_refs()
+        counts = self._block_row_counts(left)
+        right_all = other._block_refs()
+        r_counts = other._block_row_counts(right_all)
+        if sum(counts) != sum(r_counts):
+            raise ValueError(
+                f"zip needs equal row counts: {sum(counts)} vs {sum(r_counts)}")
+        r_starts = list(np.cumsum([0] + r_counts))
+
+        def _zip_blocks(a, b):
+            if not isinstance(a, dict) or not isinstance(b, dict):
+                return [
+                    (ra, rb) for ra, rb in zip(block_rows(a), block_rows(b))
+                ]
+            out = dict(a)
+            for k, v in b.items():
+                out[k if k not in out else f"{k}_1"] = v
+            return out
+
+        slicer = RemoteFunction(_slice_row_range)
+        zipper = RemoteFunction(_zip_blocks)
+        new_refs = []
+        lo = 0
+        for ref, n in zip(left, counts):
+            hi = lo + n
+            overlap = [
+                j for j in range(len(right_all))
+                if r_starts[j] < hi and r_starts[j] + r_counts[j] > lo
+            ]
+            aligned = slicer.remote(
+                lo, hi, [r_starts[j] for j in overlap],
+                *[right_all[j] for j in overlap])
+            new_refs.append(zipper.remote(ref, aligned))
+            lo = hi
+        return Dataset(new_refs, [], _refs=new_refs)
+
+    def join(self, other: "Dataset", on: str, *, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join on column `on` (reference: the data join
+        operator / hash_shuffle): both sides scatter rows by hash(key) into
+        k partitions (one task per block, k returns), then one task per
+        partition builds a hash table from the left rows and probes with the
+        right — O(N) movement, k-way parallel joins."""
+        if how not in ("inner", "left"):
+            raise ValueError(f"unsupported join type {how!r}")
+        from ray_tpu.remote_function import RemoteFunction
+
+        left = self._block_refs()
+        right = other._block_refs()
+        k = num_partitions or max(1, max(len(left), len(right)))
+
+        def _scatter(block, k):
+            rows = list(block_rows(block))
+            parts: List[List[Any]] = [[] for _ in range(k)]
+            for r in rows:
+                parts[_stable_key_hash(r[on]) % k].append(r)
+            return tuple(rows_to_block(p) for p in parts)
+
+        def _join_partition(n_left, *parts):
+            lrows = [r for b in parts[:n_left] for r in block_rows(b)]
+            rrows = [r for b in parts[n_left:] for r in block_rows(b)]
+            table: Dict[Any, List[Any]] = {}
+            for r in rrows:
+                table.setdefault(r[on], []).append(r)
+            out = []
+            for lr in lrows:
+                matches = table.get(lr[on])
+                if matches:
+                    for rr in matches:
+                        merged = dict(lr)
+                        for ck, cv in rr.items():
+                            if ck != on:
+                                merged[ck if ck not in merged
+                                       else f"{ck}_1"] = cv
+                        out.append(merged)
+                elif how == "left":
+                    out.append(dict(lr))
+            return rows_to_block(out)
+
+        scatter = RemoteFunction(_scatter).options(num_returns=k)
+        joiner = RemoteFunction(_join_partition)
+        lparts = [scatter.remote(r, k) for r in left]
+        rparts = [scatter.remote(r, k) for r in right]
+        if k == 1:
+            lparts = [[p] for p in lparts]
+            rparts = [[p] for p in rparts]
+        new_refs = [
+            joiner.remote(
+                len(lparts),
+                *[lp[i] for lp in lparts],
+                *[rp[i] for rp in rparts],
+            )
+            for i in range(k)
+        ]
+        return Dataset(new_refs, [], _refs=new_refs)
+
     # -- global aggregates (reference: Dataset.sum/min/max/mean/std) ----
 
     def _column_stats(self, col: str):
@@ -500,18 +850,10 @@ class GroupedData:
         k = len(refs)
 
         def _scatter(block, k):
-            import hashlib as _hl
-
-            def stable(x) -> int:
-                # NOT hash(): str hashing is per-process randomized, which
-                # would scatter equal keys to different partitions
-                x = x.item() if hasattr(x, "item") else x
-                d = _hl.blake2b(repr(x).encode(), digest_size=8).digest()
-                return int.from_bytes(d, "little")
-
             keys = (np.asarray(block[key]) if isinstance(block, dict)
                     else np.asarray([r[key] for r in block_rows(block)]))
-            assign = np.asarray([stable(x) % k for x in keys.tolist()])
+            assign = np.asarray(
+                [_stable_key_hash(x) % k for x in keys.tolist()])
             if isinstance(block, dict):
                 return tuple(
                     {c: np.asarray(v)[assign == i] for c, v in block.items()}

@@ -1,0 +1,152 @@
+"""Streaming execution, actor-pool map, and multi-dataset ops for
+ray_tpu.data (reference: streaming_executor.py:106, resource_manager.py,
+actor_pool_map_operator.py, Dataset.zip/union/join)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rdata
+from ray_tpu.data.datasource import from_items
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_streaming_bounded_memory(ray_init):
+    """Iterate a dataset ~5x larger than the store budget with a small
+    window: peak shm usage must stay far under the total dataset size
+    (the VERDICT's done-criterion for the streaming executor)."""
+    from ray_tpu._private.core_worker import get_core_worker
+
+    store = get_core_worker().store
+    heap = store.stats()["heap_size"]
+    n_blocks = 20
+    block_bytes = int(heap * 5 / n_blocks)  # dataset ≈ 5x heap
+    rows_per_block = 4
+    row_elems = block_bytes // (rows_per_block * 8)
+
+    ds = rdata.range(n_blocks * rows_per_block,
+                     parallelism=n_blocks).map_batches(
+        lambda b: {"x": np.ones((len(b["id"]), row_elems), np.float64)}
+    )
+
+    peak = 0
+    rows = 0
+    for batch in ds.iter_batches(batch_size=rows_per_block,
+                                 prefetch_blocks=2):
+        rows += len(batch["x"])
+        peak = max(peak, store.stats()["bytes_in_use"])
+        del batch
+    assert rows == n_blocks * rows_per_block
+    # window=2 + one block being consumed = 3 x (dataset/20) = 0.75 heap;
+    # the full dataset (5x heap) could never have fit at once
+    assert peak <= heap * 0.8, f"peak {peak} vs heap {heap}"
+    assert n_blocks * block_bytes > 4.5 * heap  # it really was >> the store
+
+
+def test_streaming_take_early_exit(ray_init):
+    calls = []
+
+    ds = rdata.range(400, parallelism=40)
+    out = ds.take(5)
+    assert [r["id"] for r in out] == list(range(5))
+
+
+def test_actor_pool_map_batches(ray_init):
+    """Stateful UDF through an actor pool: constructed once per actor,
+    reused across blocks."""
+
+    class AddOffset:
+        def __init__(self, offset):
+            self.offset = offset
+            self.calls = 0
+
+        def __call__(self, batch):
+            self.calls += 1
+            return {"id": batch["id"] + self.offset}
+
+    ds = rdata.range(64, parallelism=8).map_batches(
+        AddOffset, concurrency=2, fn_constructor_args=(100,))
+    got = sorted(r["id"] for r in ds.iter_rows())
+    assert got == [i + 100 for i in range(64)]
+
+
+def test_actor_pool_with_pre_and_post_ops(ray_init):
+    class Doubler:
+        def __call__(self, batch):
+            return {"id": batch["id"] * 2}
+
+    ds = (
+        rdata.range(16, parallelism=4)
+        .map(lambda r: {"id": r["id"] + 1})          # pre (tasks)
+        .map_batches(Doubler, concurrency=1)          # actor stage
+        .filter(lambda r: r["id"] > 10)               # post (tasks)
+    )
+    got = sorted(r["id"] for r in ds.iter_rows())
+    assert got == sorted((i + 1) * 2 for i in range(16) if (i + 1) * 2 > 10)
+
+
+def test_union(ray_init):
+    a = rdata.range(10, parallelism=2).map(lambda r: {"id": r["id"]})
+    b = rdata.range(5, parallelism=1).map(lambda r: {"id": r["id"] + 100})
+    u = a.union(b)
+    got = sorted(r["id"] for r in u.iter_rows())
+    assert got == sorted(list(range(10)) + [i + 100 for i in range(5)])
+    assert u.count() == 15
+
+
+def test_zip(ray_init):
+    a = from_items([{"x": i} for i in range(12)], parallelism=3)
+    b = from_items([{"y": i * 10} for i in range(12)], parallelism=4)
+    z = a.zip(b)
+    rows = z.take_all()
+    assert sorted((r["x"], r["y"]) for r in rows) == [
+        (i, i * 10) for i in range(12)
+    ]
+
+
+def test_zip_mismatched_counts_rejected(ray_init):
+    a = from_items([{"x": i} for i in range(4)])
+    b = from_items([{"y": i} for i in range(5)])
+    with pytest.raises(ValueError, match="equal row counts"):
+        a.zip(b)
+
+
+def test_hash_join_inner(ray_init):
+    users = from_items(
+        [{"uid": i, "name": f"u{i}"} for i in range(8)], parallelism=2)
+    orders = from_items(
+        [{"uid": i % 4, "amount": i * 10} for i in range(10)], parallelism=3)
+    j = users.join(orders, on="uid")
+    rows = j.take_all()
+    # every order matches exactly one user; uids 4..7 have no orders
+    assert len(rows) == 10
+    for r in rows:
+        assert r["name"] == f"u{r['uid']}"
+
+
+def test_hash_join_mixed_numeric_key_types(ray_init):
+    """int vs float vs np.int64 keys that compare equal must co-partition
+    (review: repr-based hashing split 1 and 1.0 into different partitions,
+    silently dropping matches)."""
+    left = from_items([{"k": 1, "a": "x"}, {"k": 2, "a": "y"}], parallelism=1)
+    right = from_items(
+        [{"k": 1.0, "b": 10}, {"k": np.int64(2), "b": 20}], parallelism=2)
+    rows = sorted(left.join(right, on="k").take_all(), key=lambda r: r["a"])
+    assert len(rows) == 2
+    assert rows[0]["b"] == 10 and rows[1]["b"] == 20
+
+
+def test_hash_join_left(ray_init):
+    left = from_items([{"k": i, "a": i} for i in range(4)], parallelism=2)
+    right = from_items([{"k": 0, "b": 7}, {"k": 2, "b": 9}], parallelism=1)
+    j = left.join(right, on="k", how="left")
+    rows = sorted(j.take_all(), key=lambda r: r["k"])
+    assert len(rows) == 4
+    assert rows[0].get("b") == 7 and rows[2].get("b") == 9
+    assert "b" not in rows[1] and "b" not in rows[3]

@@ -292,6 +292,42 @@ def test_dataset_min_max_string_column(ray_init):
     assert ds.min("v") == 0 and ds.max("v") == 2
 
 
+def test_main_module_class_arg_roundtrip():
+    """A class defined in the driver's __main__ must serialize BY VALUE so
+    workers (whose __main__ is default_worker) can unpickle it — plain
+    pickle serializes it by reference and the task fails with
+    AttributeError (found by the data actor-pool drive)."""
+    import subprocess
+    import sys
+
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = f"""
+import sys
+sys.path.insert(0, {repo_root!r})
+import ray_tpu
+
+class Payload:
+    def __init__(self, v):
+        self.v = v
+
+@ray_tpu.remote
+def unwrap(p):
+    return p.v * 2
+
+ray_tpu.init(num_cpus=2)
+assert ray_tpu.get(unwrap.remote(Payload(21)), timeout=60) == 42
+ray_tpu.shutdown()
+print("MAIN-CLASS-OK")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=180,
+    )
+    assert "MAIN-CLASS-OK" in out.stdout, out.stderr[-2000:]
+
+
 def test_dataset_string_stats_with_empty_block(ray_init):
     """An empty block must not contribute numeric zeros to a string column
     (review: the 0.0 sentinel made ds.sum('name') return 0.0)."""
