@@ -13,6 +13,11 @@ the XLA way (SURVEY §5 "Distributed communication backend"):
   reference's GLOO backend).
 - P2P send/recv ride the framework's RPC host plane out-of-band (matching the
   reference's semantics where only the two endpoints participate).
+- DEVICE-NATIVE inputs/outputs: a jax.Array argument never stages through the
+  host (the on-device local shard feeds the global array directly and the
+  replicated result returns as a single-device jax.Array that composes with
+  the caller's own jit), and an ObjectRef argument resolves through RDT — a
+  same-process HBM-resident object is consumed with zero copies.
 """
 
 from __future__ import annotations
@@ -163,20 +168,50 @@ class XlaCollectiveGroup:
     # collectives (jitted SPMD over the ranks axis)
     # ------------------------------------------------------------------
 
-    def _global_stack(self, x):
+    def _resolve_input(self, x):
+        """Accept numpy, jax.Array, or an ObjectRef of either (RDT: a
+        same-process HBM-resident ref resolves to the original device array
+        — no h2d). Returns (value, was_device_input)."""
+        from ray_tpu._private.core_worker import ObjectRef
+
+        if isinstance(x, ObjectRef):
+            import ray_tpu
+
+            x = ray_tpu.get(x)
+        import jax
+
+        return x, isinstance(x, jax.Array)
+
+    def _local_stack(self, x, device_in: bool):
+        """Local value → single-device (1, ...) array WITHOUT a host round
+        trip for device inputs (the r2 review flagged the unconditional
+        np.asarray: every 'ICI collective' paid h2d+d2h per call)."""
+        import jax
+
+        if device_in:
+            if x.is_fully_replicated or len(x.devices()) == 1:
+                local = x.addressable_data(0)
+            else:
+                local = jax.device_put(np.asarray(x), self._local_device)
+            if local.devices() != {self._local_device}:
+                local = jax.device_put(local, self._local_device)
+            return local[None]  # on-device reshape
+        x = np.asarray(x)
+        return jax.device_put(x[None], self._local_device)
+
+    def _global_stack(self, x, device_in: bool = False):
         """Local array → global (world, ...) array sharded over ranks."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        x = np.asarray(x)
-        local = jax.device_put(x[None], self._local_device)
+        local = self._local_stack(x, device_in)
         return jax.make_array_from_single_device_arrays(
-            (self.world_size, *x.shape),
+            (self.world_size, *local.shape[1:]),
             NamedSharding(self.mesh, P("ranks")),
             [local],
         )
 
-    def _run_replicated(self, key, fn, garr):
+    def _run_replicated(self, key, fn, garr, device_out: bool):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -187,41 +222,56 @@ class XlaCollectiveGroup:
             )
             self._jit_cache[key] = jitted
         out = jitted(garr)
+        if device_out:
+            # fully replicated → the local shard IS the full value; hand the
+            # caller a plain single-device jax.Array (composes with their
+            # own jit/mesh code), zero copies
+            return out.addressable_data(0)
         return np.asarray(out)
 
     def allreduce(self, x, op: str = ReduceOp.SUM):
+        x, dev = self._resolve_input(x)
         if self.world_size == 1:
-            return np.asarray(x)
+            return x if dev else np.asarray(x)
         reducer = _REDUCERS[op]
-        garr = self._global_stack(x)
+        garr = self._global_stack(x, dev)
         return self._run_replicated(
-            ("allreduce", op, garr.shape, str(garr.dtype)), reducer, garr
+            ("allreduce", op, garr.shape, str(garr.dtype)), reducer, garr, dev
         )
 
     def reduce(self, x, dst_rank: int = 0, op: str = ReduceOp.SUM):
+        # resolve ONCE (an ObjectRef would otherwise be fetched twice on
+        # non-dst ranks: inside allreduce and again for the passthrough)
+        x, dev = self._resolve_input(x)
         out = self.allreduce(x, op)
-        return out if self.rank == dst_rank else np.asarray(x)
+        if self.rank == dst_rank:
+            return out
+        return x if dev else np.asarray(x)
 
     def broadcast(self, x, src_rank: int = 0):
+        x, dev = self._resolve_input(x)
         if self.world_size == 1:
-            return np.asarray(x)
-        garr = self._global_stack(x)
+            return x if dev else np.asarray(x)
+        garr = self._global_stack(x, dev)
         return self._run_replicated(
             ("broadcast", src_rank, garr.shape, str(garr.dtype)),
-            lambda a: a[src_rank], garr,
+            lambda a: a[src_rank], garr, dev,
         )
 
     def allgather(self, x):
+        x, dev = self._resolve_input(x)
         if self.world_size == 1:
-            return np.asarray(x)[None]
-        garr = self._global_stack(x)
+            return x[None] if dev else np.asarray(x)[None]
+        garr = self._global_stack(x, dev)
         return self._run_replicated(
-            ("allgather", garr.shape, str(garr.dtype)), lambda a: a, garr
+            ("allgather", garr.shape, str(garr.dtype)), lambda a: a, garr, dev
         )
 
     def reducescatter(self, x, op: str = ReduceOp.SUM):
         """x: local (world, chunk...) contribution → this rank's reduced chunk."""
-        x = np.asarray(x)
+        x, dev = self._resolve_input(x)
+        if not dev:
+            x = np.asarray(x)  # lists/tuples were accepted before; keep it
         if x.shape[0] != self.world_size:
             raise ValueError(
                 f"reducescatter input leading dim must be world_size "
