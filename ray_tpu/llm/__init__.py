@@ -109,6 +109,62 @@ class LLMServer:
         }
 
 
+import ray_tpu as _rt
+
+
+@_rt.remote
+class LLMEngine:
+    """Async actor wrapping the continuous-batching paged-KV engine
+    (reference: the vLLM engine actor inside LLMServer —
+    vllm_engine.py:283). Many callers stream completions concurrently;
+    requests landing mid-decode join the running batch at the next step
+    boundary."""
+
+    def __init__(self, config: LLMConfig, engine_config=None):
+        from ray_tpu.llm._engine import EngineConfig, PagedEngine
+
+        self.config = config
+        self.tokenizer = ByteTokenizer()
+        cfg, params = config.build_model()
+        self.engine = PagedEngine(
+            cfg, params, engine_config or EngineConfig(), eos_id=EOS)
+        self._t0 = None
+
+    @_rt.method(num_returns="streaming")
+    async def completions_stream(self, prompt: str,
+                                 max_tokens: Optional[int] = None,
+                                 temperature: Optional[float] = None,
+                                 seed: Optional[int] = None):
+        """Stream token ids for one completion (text via the byte
+        tokenizer is a pure client-side decode). Per-call overrides fall
+        back to the LLMConfig, like the non-streaming LLMServer path."""
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+        ids = self.tokenizer.encode(prompt)
+        gen = self.engine.generate_stream(
+            ids,
+            max_tokens=(self.config.max_new_tokens
+                        if max_tokens is None else max_tokens),
+            temperature=(self.config.temperature
+                         if temperature is None else temperature),
+            seed=self.config.seed if seed is None else seed,
+        )
+        async for tok in gen:
+            yield int(tok)
+
+    async def stats(self) -> Dict[str, Any]:
+        s = self.engine.stats()
+        elapsed = max(time.monotonic() - (self._t0 or time.monotonic()),
+                      1e-9)
+        s["tokens_per_s"] = round(s["tokens_out"] / elapsed, 2)
+        return s
+
+
+def engine_actor_class():
+    """Back-compat accessor; the class is a plain module attribute now."""
+    return LLMEngine
+
+
 def build_openai_app(config: LLMConfig, *, deployment_name: str = "v1"):
     """Deploy the completions endpoint; returns the serve handle
     (reference: build_openai_app core/ingress/builder.py:213 — the HTTP
@@ -164,4 +220,5 @@ __all__ = [
     "LLMServer",
     "batch_completions",
     "build_openai_app",
+    "engine_actor_class",
 ]

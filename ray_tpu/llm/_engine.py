@@ -1,0 +1,454 @@
+"""Continuous-batching LLM engine with a paged KV cache — TPU-native.
+
+Reference capability: the vLLM engine the reference wraps
+(python/ray/llm/_internal/serve/engines/vllm/vllm_engine.py:283 — continuous
+batching, PagedAttention block tables, streaming). Rebuilt for XLA:
+
+- **Paged KV cache**: one shared pool of fixed-size KV blocks
+  ([layers, num_blocks, block_size, kv_heads, head_dim]); each decode slot
+  owns a block table (physical block ids). No per-sequence max-length
+  allocation, no fragmentation: finished sequences return their blocks to
+  the pool and a new request reuses them immediately.
+- **Static shapes for XLA**: the decode step is ONE jitted function over the
+  fixed slot count — inactive slots write to a reserved trash block and are
+  masked out — so admission/turnover never recompiles. Prefill jits per
+  pow-2 length bucket.
+- **Continuous batching**: an admission queue merges new requests into the
+  RUNNING decode batch between steps (prefill writes the prompt's KV into
+  freshly allocated blocks, then the slot joins the next decode step) —
+  no stop-the-world batch boundaries.
+- **Streaming**: tokens flow to callers through per-request async queues;
+  the engine runs as an async actor and `generate_stream` is an async
+  generator riding the framework's streaming-generator plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.models.llama import LlamaConfig, rms_norm, rope_tables
+
+__all__ = ["EngineConfig", "PagedEngine"]
+
+
+@dataclass
+class EngineConfig:
+    """Sizing knobs (reference: vLLM engine_kwargs max_num_seqs /
+    block_size / gpu_memory_utilization → num blocks)."""
+
+    max_num_seqs: int = 4          # decode batch slots
+    kv_block_size: int = 16        # tokens per KV block
+    num_kv_blocks: int = 64        # pool size (excl. the trash block)
+    max_model_len: int = 256       # prompt + generation cap per sequence
+
+
+# ---------------------------------------------------------------------------
+# jitted model steps (paged attention)
+# ---------------------------------------------------------------------------
+
+
+def _apply_rope_q(x, cos, sin):
+    import jax.numpy as jnp
+
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    # cos/sin [b, s, hd/2] → broadcast over heads
+    cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _make_decode_step(cfg: LlamaConfig, ecfg: EngineConfig):
+    """Build the jitted whole-batch single-token decode step."""
+    import jax
+    import jax.numpy as jnp
+
+    bs = ecfg.kv_block_size
+    max_blocks = -(-ecfg.max_model_len // bs)
+    Lmax = max_blocks * bs
+
+    def step(params, kc, vc, tables, lens, active, last_tok, keys, temps):
+        """kc/vc [L, NB, BS, KV, HD]; tables [B, max_blocks] int32;
+        lens/active/last_tok [B]; keys [B,2] uint32; temps [B].
+        Returns (next_tok [B], kc, vc)."""
+        dt = cfg.dtype
+        B = last_tok.shape[0]
+        hd = cfg.head_dim
+        h = params["tok_emb"].astype(dt)[last_tok][:, None]     # [B,1,D]
+        pos = lens[:, None]                                      # [B,1]
+        cos, sin = rope_tables(cfg, pos)
+        # inactive slots write into the reserved trash block 0
+        blk = jnp.clip(lens // bs, 0, max_blocks - 1)
+        phys = jnp.where(
+            active, tables[jnp.arange(B), blk], 0).astype(jnp.int32)
+        off = (lens % bs).astype(jnp.int32)
+
+        idx = jnp.arange(Lmax)
+        valid = (idx[None, :] <= lens[:, None]) & active[:, None]  # [B,Lmax]
+
+        def layer(carry, xs):
+            h = carry
+            p, kcl, vcl = xs
+            x = rms_norm(h, p["ln1"], cfg.norm_eps)
+            q = (x @ p["wq"].astype(dt)).reshape(B, 1, cfg.n_heads, hd)
+            k = (x @ p["wk"].astype(dt)).reshape(B, 1, cfg.n_kv_heads, hd)
+            v = (x @ p["wv"].astype(dt)).reshape(B, 1, cfg.n_kv_heads, hd)
+            q = _apply_rope_q(q, cos, sin).astype(dt)
+            k = _apply_rope_q(k, cos, sin).astype(dt)
+            kcl = kcl.at[phys, off].set(k[:, 0])
+            vcl = vcl.at[phys, off].set(v[:, 0])
+            # paged gather: [B, max_blocks, BS, KV, HD] → [B, Lmax, KV, HD]
+            k_all = kcl[tables].reshape(B, Lmax, cfg.n_kv_heads, hd)
+            v_all = vcl[tables].reshape(B, Lmax, cfg.n_kv_heads, hd)
+            if cfg.n_kv_heads != cfg.n_heads:
+                rep = cfg.n_heads // cfg.n_kv_heads
+                k_all = jnp.repeat(k_all, rep, axis=2)
+                v_all = jnp.repeat(v_all, rep, axis=2)
+            scale = 1.0 / math.sqrt(hd)
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k_all,
+                preferred_element_type=jnp.float32) * scale
+            logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+            o = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+            h = h + o.reshape(B, 1, -1) @ p["wo"].astype(dt)
+            x2 = rms_norm(h, p["ln2"], cfg.norm_eps)
+            gate = jax.nn.silu(x2 @ p["w1"].astype(dt))
+            up = x2 @ p["w3"].astype(dt)
+            h = h + (gate * up) @ p["w2"].astype(dt)
+            return h, (kcl, vcl)
+
+        h, (kc, vc) = jax.lax.scan(layer, h, (params["layers"], kc, vc))
+        h = rms_norm(h, params["norm"], cfg.norm_eps)
+        logits = (h[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+        def sample_one(key_data, lg, t):
+            key = jax.random.wrap_key_data(key_data.astype(jnp.uint32))
+            greedy = jnp.argmax(lg).astype(jnp.int32)
+            samp = jax.random.categorical(
+                key, lg / jnp.maximum(t, 1e-6)).astype(jnp.int32)
+            return jnp.where(t > 0, samp, greedy)
+
+        sampled = jax.vmap(sample_one)(keys, logits, temps)
+        return sampled, kc, vc
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+def _make_prefill(cfg: LlamaConfig, ecfg: EngineConfig):
+    """Jitted single-request prefill at a static padded length S: plain
+    causal attention over the prompt, KV scattered into the request's
+    blocks; returns (last_logits, kc, vc)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    bs = ecfg.kv_block_size
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+    def prefill(S, params, kc, vc, table, prompt, plen):
+        """prompt [S] right-padded; table [max_blocks]; plen scalar."""
+        dt = cfg.dtype
+        hd = cfg.head_dim
+        h = params["tok_emb"].astype(dt)[prompt][None]   # [1,S,D]
+        pos = jnp.arange(S, dtype=jnp.int32)[None]
+        cos, sin = rope_tables(cfg, pos)
+        idx = jnp.arange(S)
+        # scatter destinations; padded positions go to the trash block 0
+        in_range = idx < plen
+        phys = jnp.where(in_range, table[jnp.clip(idx // bs, 0,
+                                                  table.shape[0] - 1)], 0)
+        off = (idx % bs).astype(jnp.int32)
+        causal = (idx[None, :, None] >= idx[None, None, :]) & (
+            idx[None, None, :] < plen)  # [1,S,S] query x key validity
+
+        def layer(carry, xs):
+            h = carry
+            p, kcl, vcl = xs
+            x = rms_norm(h, p["ln1"], cfg.norm_eps)
+            q = (x @ p["wq"].astype(dt)).reshape(1, S, cfg.n_heads, hd)
+            k = (x @ p["wk"].astype(dt)).reshape(1, S, cfg.n_kv_heads, hd)
+            v = (x @ p["wv"].astype(dt)).reshape(1, S, cfg.n_kv_heads, hd)
+            q = _apply_rope_q(q, cos, sin).astype(dt)
+            k = _apply_rope_q(k, cos, sin).astype(dt)
+            kcl = kcl.at[phys, off].set(k[0])
+            vcl = vcl.at[phys, off].set(v[0])
+            kk, vv = k, v
+            if cfg.n_kv_heads != cfg.n_heads:
+                rep = cfg.n_heads // cfg.n_kv_heads
+                kk = jnp.repeat(kk, rep, axis=2)
+                vv = jnp.repeat(vv, rep, axis=2)
+            scale = 1.0 / math.sqrt(hd)
+            lg = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
+                            preferred_element_type=jnp.float32) * scale
+            lg = jnp.where(causal[:, None], lg, -1e30)
+            probs = jax.nn.softmax(lg, axis=-1).astype(dt)
+            o = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+            h = h + o.reshape(1, S, -1) @ p["wo"].astype(dt)
+            x2 = rms_norm(h, p["ln2"], cfg.norm_eps)
+            gate = jax.nn.silu(x2 @ p["w1"].astype(dt))
+            up = x2 @ p["w3"].astype(dt)
+            h = h + (gate * up) @ p["w2"].astype(dt)
+            return h, (kcl, vcl)
+
+        h, (kc, vc) = jax.lax.scan(layer, h, (params["layers"], kc, vc))
+        h = rms_norm(h, params["norm"], cfg.norm_eps)
+        last = h[0, jnp.clip(plen - 1, 0, S - 1)]
+        logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+        return logits, kc, vc
+
+    return prefill
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: List[int]
+    max_tokens: int
+    temperature: float
+    seed: int
+    queue: asyncio.Queue = None  # type: ignore[assignment]
+    slot: int = -1
+    produced: int = 0
+    admitted_mid_decode: bool = False
+
+
+class PagedEngine:
+    """The continuous-batching scheduler around the jitted steps.
+
+    Host-side state (block free list, slot table, request queues) is plain
+    Python owned by ONE engine loop task; device state (block pool, tables)
+    crosses in as arrays each step. Run it inside an async actor and call
+    `generate_stream` concurrently — requests arriving mid-decode are
+    admitted at the next step boundary."""
+
+    def __init__(self, cfg: LlamaConfig, params, ecfg: Optional[EngineConfig] = None,
+                 eos_id: Optional[int] = None):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.ecfg = ecfg or EngineConfig()
+        self.params = params
+        self.eos_id = eos_id
+        e = self.ecfg
+        self.bs = e.kv_block_size
+        self.max_blocks = -(-e.max_model_len // self.bs)
+        B = e.max_num_seqs
+        hd = cfg.head_dim
+        NB = e.num_kv_blocks + 1  # +1: block 0 is the trash block
+        self.kc = jnp.zeros((cfg.n_layers, NB, self.bs, cfg.n_kv_heads, hd),
+                            cfg.dtype)
+        self.vc = jnp.zeros_like(self.kc)
+        self.free_blocks = list(range(1, NB))
+        self.tables = np.zeros((B, self.max_blocks), np.int32)
+        self.lens = np.zeros((B,), np.int32)
+        self.active = np.zeros((B,), bool)
+        self.last_tok = np.zeros((B,), np.int32)
+        self.temps = np.zeros((B,), np.float32)
+        self.slot_req: List[Optional[_Request]] = [None] * B
+        self._decode = _make_decode_step(cfg, e)
+        self._prefill = _make_prefill(cfg, e)
+        self._pending: "asyncio.Queue[_Request]" = None  # type: ignore
+        self._loop_task = None
+        self._rid = 0
+        self._rngs = np.zeros((B, 2), np.uint32)
+        self.steps = 0
+        self.tokens_out = 0
+        self.mid_decode_admissions = 0
+
+    # -- admission ------------------------------------------------------
+
+    def _blocks_needed(self, req: _Request) -> int:
+        total = min(len(req.prompt) + req.max_tokens, self.ecfg.max_model_len)
+        return -(-total // self.bs)
+
+    def _try_admit(self, req: _Request) -> bool:
+        need = self._blocks_needed(req)
+        if len(self.free_blocks) < need:
+            return False
+        try:
+            slot = next(i for i, r in enumerate(self.slot_req) if r is None)
+        except StopIteration:
+            return False
+        blocks = [self.free_blocks.pop() for _ in range(need)]
+        row = np.zeros((self.max_blocks,), np.int32)
+        row[: len(blocks)] = blocks
+        self.tables[slot] = row
+        plen = len(req.prompt)
+        S = max(8, 1 << (plen - 1).bit_length())  # pow-2 bucket
+        import jax
+        import jax.numpy as jnp
+
+        prompt = np.zeros((S,), np.int32)
+        prompt[:plen] = req.prompt
+        logits, self.kc, self.vc = self._prefill(
+            S, self.params, self.kc, self.vc, jnp.asarray(row),
+            jnp.asarray(prompt), jnp.int32(plen))
+        key = jax.random.PRNGKey(req.seed * 1000003 + req.rid)
+        if req.temperature > 0:
+            tok = int(jax.random.categorical(
+                key, logits / max(req.temperature, 1e-6)))
+        else:
+            tok = int(np.argmax(np.asarray(logits)))
+        self._rngs[slot] = np.asarray(
+            jax.random.key_data(jax.random.fold_in(key, 7)), np.uint32)
+        self.slot_req[slot] = req
+        if req.admitted_mid_decode:
+            self.mid_decode_admissions += 1
+        req.slot = slot
+        self.lens[slot] = plen
+        self.active[slot] = True
+        self.last_tok[slot] = tok
+        self.temps[slot] = req.temperature
+        self._emit(req, tok)
+        return True
+
+    def _emit(self, req: _Request, tok: int):
+        req.produced += 1
+        self.tokens_out += 1
+        done = (
+            (self.eos_id is not None and tok == self.eos_id)
+            or req.produced >= req.max_tokens
+            or len(req.prompt) + req.produced >= self.ecfg.max_model_len
+        )
+        if self.eos_id is not None and tok == self.eos_id:
+            req.queue.put_nowait(None)
+        else:
+            req.queue.put_nowait(tok)
+            if done:
+                req.queue.put_nowait(None)
+        if done and req.slot >= 0:
+            self._release(req)
+
+    def _release(self, req: _Request):
+        slot = req.slot
+        need = self._blocks_needed(req)
+        self.free_blocks.extend(
+            int(b) for b in self.tables[slot][:need] if b != 0)
+        self.tables[slot] = 0
+        self.active[slot] = False
+        self.slot_req[slot] = None
+        req.slot = -1
+
+    # -- engine loop ----------------------------------------------------
+
+    async def _ensure_loop(self):
+        if self._pending is None:
+            self._pending = asyncio.Queue()
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._run_loop())
+
+    async def _run_loop(self):
+        import collections
+
+        import jax.numpy as jnp
+
+        waiting: "collections.deque[_Request]" = collections.deque()
+        while True:
+            mid_decode = bool(self.active.any())
+            while not self._pending.empty():
+                waiting.append(self._pending.get_nowait())
+            # admit in arrival order while slots + blocks allow — requests
+            # landing here while slots decode are the "admitted mid-decode"
+            # continuous-batching case
+            while waiting:
+                req = waiting[0]
+                if self._blocks_needed(req) > self.ecfg.num_kv_blocks:
+                    # can never fit even a drained pool: surface an ERROR,
+                    # not a silently empty completion
+                    waiting.popleft()
+                    req.queue.put_nowait(ValueError(
+                        f"request needs {self._blocks_needed(req)} KV "
+                        f"blocks but the pool has "
+                        f"{self.ecfg.num_kv_blocks}"))
+                    continue
+                req.admitted_mid_decode = mid_decode
+                try:
+                    ok = await asyncio.to_thread(self._try_admit, req)
+                except Exception as e:  # noqa: BLE001 — prefill failed
+                    waiting.popleft()
+                    req.queue.put_nowait(e)
+                    continue
+                if not ok:
+                    break  # head waits for blocks/slots to free
+                waiting.popleft()
+            if not self.active.any():
+                # idle: block until a request arrives
+                waiting.append(await self._pending.get())
+                continue
+            # one decode step for every active slot
+            step = self.steps
+
+            def run_step():
+                toks, self.kc, self.vc = self._decode(
+                    self.params, self.kc, self.vc,
+                    jnp.asarray(self.tables), jnp.asarray(self.lens),
+                    jnp.asarray(self.active), jnp.asarray(self.last_tok),
+                    jnp.asarray(self._rngs), jnp.asarray(self.temps))
+                return np.asarray(toks)
+
+            try:
+                toks = await asyncio.to_thread(run_step)
+            except Exception as e:  # noqa: BLE001 — decode step failed
+                # the device state is suspect: fail every in-flight and
+                # queued request (callers must never hang on a dead loop)
+                for slot, req in enumerate(list(self.slot_req)):
+                    if req is not None:
+                        req.queue.put_nowait(e)
+                        self._release(req)
+                while waiting:
+                    waiting.popleft().queue.put_nowait(e)
+                while not self._pending.empty():
+                    self._pending.get_nowait().queue.put_nowait(e)
+                raise
+            self.steps = step + 1
+            self._rngs[:, 1] += 1  # fresh fold per step
+            for slot, req in enumerate(list(self.slot_req)):
+                if req is None or not self.active[slot]:
+                    continue
+                self.lens[slot] += 1
+                tok = int(toks[slot])
+                self.last_tok[slot] = tok
+                self._emit(req, tok)
+            await asyncio.sleep(0)  # let admissions interleave
+
+    # -- public API -----------------------------------------------------
+
+    async def generate_stream(self, prompt_ids: List[int], *,
+                              max_tokens: int = 32,
+                              temperature: float = 0.0, seed: int = 0):
+        """Async generator of token ids. Engine-side failures raise into the
+        consumer (queue items: int token | None end | Exception)."""
+        if len(prompt_ids) + 1 > self.ecfg.max_model_len:
+            raise ValueError(
+                f"prompt of {len(prompt_ids)} tokens exceeds "
+                f"max_model_len={self.ecfg.max_model_len}")
+        await self._ensure_loop()
+        self._rid += 1
+        req = _Request(self._rid, list(prompt_ids), int(max_tokens),
+                       float(temperature), int(seed),
+                       queue=asyncio.Queue())
+        self._pending.put_nowait(req)
+        while True:
+            tok = await req.queue.get()
+            if tok is None:
+                return
+            if isinstance(tok, Exception):
+                raise tok
+            yield tok
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "steps": self.steps,
+            "tokens_out": self.tokens_out,
+            "free_blocks": len(self.free_blocks),
+            "active_slots": int(self.active.sum()),
+            "mid_decode_admissions": self.mid_decode_admissions,
+        }

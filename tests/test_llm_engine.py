@@ -1,0 +1,96 @@
+"""Continuous batching + paged KV engine (reference: vllm_engine.py:283):
+concurrent streaming completions with mid-decode admission, block reuse,
+and parity with the dense decoder."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import ray_tpu
+from ray_tpu.llm import EOS, LLMConfig, engine_actor_class
+from ray_tpu.llm._engine import EngineConfig, PagedEngine
+from ray_tpu.models.llama import LlamaConfig, init_params
+
+CFG = LlamaConfig(
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, max_seq_len=128, dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def test_paged_matches_dense_decode():
+    from ray_tpu.llm._generate import generate
+
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    prompts = [[1, 5, 9], [3, 3, 3, 7, 2], [42]]
+    dense = generate(CFG, params, prompts, max_new_tokens=8, temperature=0.0)
+    eng = PagedEngine(CFG, params, EngineConfig(
+        max_num_seqs=3, kv_block_size=4, num_kv_blocks=32, max_model_len=64))
+
+    async def run_one(p):
+        return [t async for t in eng.generate_stream(
+            p, max_tokens=8, temperature=0.0)]
+
+    async def main():
+        return await asyncio.gather(*[run_one(p) for p in prompts])
+
+    paged = asyncio.run(main())
+    assert paged == dense
+    # every block returned to the pool
+    assert eng.stats()["free_blocks"] == 32
+
+
+def test_block_reuse_across_waves():
+    """More sequences over time than the pool could ever hold at once."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    eng = PagedEngine(CFG, params, EngineConfig(
+        max_num_seqs=2, kv_block_size=4, num_kv_blocks=8, max_model_len=24))
+
+    async def run_one(i):
+        return [t async for t in eng.generate_stream(
+            [i % 100 + 1, i % 50], max_tokens=6, temperature=0.0)]
+
+    async def main():
+        return await asyncio.gather(*[run_one(i) for i in range(10)])
+
+    outs = asyncio.run(main())
+    assert len(outs) == 10 and all(len(o) == 6 for o in outs)
+    assert eng.stats()["free_blocks"] == 8
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_concurrent_streaming_mid_decode_admission(ray_init):
+    """The VERDICT done-criterion: N concurrent streaming completions with
+    at least one admitted mid-decode, tokens/s reported."""
+    LLMEngine = engine_actor_class()
+    config = LLMConfig(model="tiny", model_overrides=dict(
+        dtype=jnp.float32, param_dtype=jnp.float32))
+    eng = LLMEngine.remote(config, EngineConfig(
+        max_num_seqs=4, kv_block_size=8, num_kv_blocks=64, max_model_len=96))
+
+    # first request starts decoding alone...
+    g1 = eng.completions_stream.remote("hello world", max_tokens=40)
+    first_tokens = [ray_tpu.get(next(g1), timeout=120) for _ in range(3)]
+    assert len(first_tokens) == 3
+    # ...then three more arrive MID-decode and join the running batch
+    gens = [
+        eng.completions_stream.remote(f"prompt {i}", max_tokens=10)
+        for i in range(3)
+    ]
+    outs = []
+    for g in gens:
+        outs.append([ray_tpu.get(r, timeout=120) for r in g])
+    rest1 = [ray_tpu.get(r, timeout=120) for r in g1]
+    assert all(len(o) > 0 for o in outs)
+    assert len(first_tokens) + len(rest1) <= 40
+    stats = ray_tpu.get(eng.stats.remote(), timeout=60)
+    assert stats["mid_decode_admissions"] >= 1, stats
+    assert stats["tokens_per_s"] > 0, stats
+    print("engine stats:", stats)
+    ray_tpu.kill(eng)
