@@ -70,11 +70,13 @@ def set_core_worker(cw: Optional["CoreWorker"]) -> None:
     _current_core_worker = cw
 
 
-def compute_lease_key(resources: "ResourceSet", strategy) -> Optional[tuple]:
+def compute_lease_key(resources: "ResourceSet", strategy,
+                      env_key: str = "") -> Optional[tuple]:
     """Scheduling key: tasks of the same shape can reuse one lease
-    (reference: normal_task_submitter.h SchedulingKey lease pools).
-    None → never pool: SPREAD tasks must spread across nodes, and
-    reusing one granted worker would pin them to it."""
+    (reference: normal_task_submitter.h SchedulingKey lease pools —
+    including the runtime-env hash: an env-isolated worker must never
+    serve another env's tasks). None → never pool: SPREAD tasks must
+    spread across nodes, and reusing one granted worker would pin them."""
     if strategy.kind == pb.STRATEGY_SPREAD:
         return None
     return (
@@ -82,6 +84,7 @@ def compute_lease_key(resources: "ResourceSet", strategy) -> Optional[tuple]:
         tuple(sorted(
             (k, str(v)) for k, v in strategy.to_wire().items()
         )),
+        env_key,
     )
 
 
@@ -1679,7 +1682,9 @@ class CoreWorker:
         return owner_worker_id == self.worker_id.binary()
 
     def _lease_key(self, spec: TaskSpec) -> Optional[tuple]:
-        return compute_lease_key(spec.resources, spec.strategy)
+        return compute_lease_key(
+            spec.resources, spec.strategy,
+            (spec.runtime_env or {}).get("env_key", ""))
 
     def _pool_for(self, key: tuple) -> dict:
         pool = self._lease_pools.get(key)
@@ -1873,7 +1878,26 @@ class CoreWorker:
                 q = self._push_queues.get(key)
                 if not q:
                     return
-                lease = await self._pool_lease(key, template_spec)
+                try:
+                    lease = await self._pool_lease(key, template_spec)
+                except Exception as e:  # noqa: BLE001 — lease unobtainable
+                    # e.g. worker spawn failed (broken pip env): deliver the
+                    # failure to ONE queued task (mirroring _lease_fetch's
+                    # one-failure-one-waiter rule) instead of dying with the
+                    # queue stranded
+                    while q:
+                        spec, fut = q.popleft()
+                        if fut is None:
+                            sub = self._submissions.get(spec.task_id.binary())
+                            if sub is None:
+                                continue
+                            self._fail_task(spec, e)
+                            self._untrack_submission(spec)
+                            break
+                        if not fut.done():
+                            fut.set_exception(e)
+                            break
+                    continue
                 cached = not lease.pop("fresh", False)
                 batch = []
                 # fair share: don't let one feeder swallow the whole queue
@@ -2228,13 +2252,18 @@ class CoreWorker:
                     await asyncio.sleep(0.2)
                     continue
                 raise
-            inner = spawn(self._lease_call_with_deadline(client, {
+            payload = {
                 "resources": spec.resources.to_wire(),
                 "strategy": spec.strategy.to_wire(),
                 "job_id": self.job_id.binary(),
                 "hops": hops,
                 "request_key": request_key,
-            }))
+            }
+            if (spec.runtime_env or {}).get("env_key"):
+                # isolating env (pip venv / working_dir): the daemon must
+                # grant a worker built for exactly this env
+                payload["runtime_env"] = spec.runtime_env
+            inner = spawn(self._lease_call_with_deadline(client, payload))
             try:
                 reply = await asyncio.shield(inner)
             except asyncio.CancelledError:

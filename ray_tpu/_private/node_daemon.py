@@ -52,7 +52,7 @@ class WorkerHandle:
     __slots__ = (
         "worker_id", "proc", "state", "address", "pid", "job_id",
         "client", "lease_id", "actor_id", "ready_event", "idle_since",
-        "actor_resources", "actor_pg", "tpu_chips", "reserved",
+        "actor_resources", "actor_pg", "tpu_chips", "reserved", "env_key",
     )
 
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen, job_id: bytes):
@@ -62,6 +62,9 @@ class WorkerHandle:
         self.address = ""
         self.pid = proc.pid
         self.job_id = job_id
+        # runtime-env isolation key this worker was spawned for ("" = plain
+        # pooled worker; reference: worker_pool.h keys by runtime_env_hash)
+        self.env_key = ""
         self.client: Optional[RpcClient] = None
         self.lease_id: Optional[bytes] = None
         self.actor_id: Optional[bytes] = None
@@ -80,15 +83,20 @@ class WorkerHandle:
 
 
 class PendingLease:
-    __slots__ = ("spec_resources", "strategy", "job_id", "future", "hops")
+    __slots__ = ("spec_resources", "strategy", "job_id", "future", "hops",
+                 "runtime_env")
 
     def __init__(self, spec_resources: ResourceSet, strategy: pb.SchedulingStrategy,
-                 job_id: bytes, hops: int):
+                 job_id: bytes, hops: int,
+                 runtime_env: Optional[dict] = None):
         self.spec_resources = spec_resources
         self.strategy = strategy
         self.job_id = job_id
         self.future: asyncio.Future = asyncio.get_running_loop().create_future()
         self.hops = hops
+        # wire runtime env when it needs a dedicated worker (pip venv,
+        # working_dir); None for plain leases
+        self.runtime_env = runtime_env
 
 
 class NodeDaemon:
@@ -136,7 +144,9 @@ class NodeDaemon:
         self.control: Optional[RpcClient] = None
         # worker pool
         self.workers: Dict[bytes, WorkerHandle] = {}
-        self.idle_by_job: Dict[bytes, List[bytes]] = {}
+        # idle pool keyed by (job_id, env_key) — workers built for a
+        # pip/working_dir env serve only that env (worker_pool.h hash)
+        self.idle_by_job: Dict[Tuple[bytes, str], List[bytes]] = {}
         # leases
         self.leases: Dict[bytes, Tuple[bytes, ResourceSet, Optional[bytes]]] = {}
         #   lease_id -> (worker_id, resources, pg_id, bundle_index)
@@ -405,7 +415,9 @@ class NodeDaemon:
 
     async def _spawn_worker(self, job_id: bytes,
                             tpu_chips: Optional[List[int]] = None,
-                            reserve: bool = True) -> WorkerHandle:
+                            reserve: bool = True,
+                            env_key: str = "",
+                            runtime_env: Optional[dict] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         log_base = os.path.join(
             self.session_dir, "logs", f"worker-{worker_id.hex()[:12]}"
@@ -420,7 +432,17 @@ class NodeDaemon:
             RT_JOB_ID=job_id.hex(),
             RT_SESSION_DIR=self.session_dir,
             RT_CONFIG_JSON=GLOBAL_CONFIG.serialize_overrides(),
+            RT_ENV_KEY=env_key,
         )
+        # the framework itself must resolve from the env worker's (possibly
+        # venv) interpreter regardless of cwd
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        python_exe = sys.executable
+        cwd = None
+        if env_key and runtime_env:
+            python_exe, cwd = await self._build_worker_env(runtime_env)
         if tpu_chips:
             from ray_tpu.tpu.accelerator import TpuAcceleratorManager
 
@@ -431,8 +453,9 @@ class NodeDaemon:
             out = open(log_base + ".out", "ab")
             err = open(log_base + ".err", "ab")
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.default_worker"],
+                [python_exe, "-m", "ray_tpu._private.default_worker"],
                 env=env, stdout=out, stderr=err, start_new_session=True,
+                cwd=cwd,
             )
             out.close()
             err.close()
@@ -441,6 +464,7 @@ class NodeDaemon:
                 self._return_chips(tpu_chips)
             raise
         handle = WorkerHandle(worker_id, proc, job_id)
+        handle.env_key = env_key
         handle.reserved = reserve
         if tpu_chips:
             # from here on the chips travel with the handle; _forget_worker
@@ -460,6 +484,26 @@ class NodeDaemon:
             )
         return handle
 
+    async def _build_worker_env(self, runtime_env: dict):
+        """Materialize an isolating runtime env for a fresh worker: the
+        content-addressed venv (pip) and/or extracted working_dir. Returns
+        (python_exe, cwd). Runs BEFORE the register timeout starts."""
+        from ray_tpu._private.runtime_env_mgr import _fetch_extract, ensure_venv
+
+        cache_root = os.path.join(self.session_dir, "runtime_env_cache")
+        os.makedirs(cache_root, exist_ok=True)
+        python_exe = sys.executable
+        pip = runtime_env.get("pip")
+        if pip:
+            python_exe = await asyncio.to_thread(
+                ensure_venv, list(pip), cache_root)
+        cwd = None
+        wd_uri = runtime_env.get("working_dir_uri")
+        if wd_uri:
+            # duck-typed `cw`: _fetch_extract only uses .control.call
+            cwd = await _fetch_extract(wd_uri, self, cache_root)
+        return python_exe, cwd
+
     async def rpc_worker_ready(self, conn_id: int, payload: dict) -> dict:
         w = self.workers.get(payload["worker_id"])
         if w is None:
@@ -467,7 +511,8 @@ class NodeDaemon:
         w.address = payload["address"]
         w.state = W_IDLE
         if not w.reserved:
-            self.idle_by_job.setdefault(w.job_id, []).append(w.worker_id.binary())
+            self.idle_by_job.setdefault(
+                (w.job_id, w.env_key), []).append(w.worker_id.binary())
         w.ready_event.set()
         return {"ok": True}
 
@@ -484,7 +529,7 @@ class NodeDaemon:
 
     def _forget_worker(self, w: WorkerHandle):
         self.workers.pop(w.worker_id.binary(), None)
-        idle = self.idle_by_job.get(w.job_id, [])
+        idle = self.idle_by_job.get((w.job_id, w.env_key), [])
         if w.worker_id.binary() in idle:
             idle.remove(w.worker_id.binary())
         if w.actor_id is not None:
@@ -531,25 +576,30 @@ class NodeDaemon:
             except Exception:  # noqa: BLE001
                 logger.exception("failed to report actor death")
 
-    async def _get_idle_worker(self, job_id: bytes) -> WorkerHandle:
-        idle = self.idle_by_job.setdefault(job_id, [])
+    async def _get_idle_worker(
+            self, job_id: bytes, env_key: str = "",
+            runtime_env: Optional[dict] = None) -> WorkerHandle:
+        idle = self.idle_by_job.setdefault((job_id, env_key), [])
         while idle:
             wid = idle.pop()
             w = self.workers.get(wid)
             if w is not None and w.state == W_IDLE and w.proc.poll() is None:
                 return w
         # adopt a prestarted generic worker (spawned before any job existed)
-        generic = self.idle_by_job.get(b"", [])
-        while job_id != b"" and generic:
+        # — only for env-less leases: an env-keyed lease needs a worker
+        # built for that env (venv interpreter, working dir)
+        generic = self.idle_by_job.get((b"", ""), [])
+        while job_id != b"" and env_key == "" and generic:
             wid = generic.pop()
             w = self.workers.get(wid)
             if w is not None and w.state == W_IDLE and w.proc.poll() is None:
                 w.job_id = job_id
                 return w
-        return await self._spawn_worker(job_id)
+        return await self._spawn_worker(job_id, env_key=env_key,
+                                        runtime_env=runtime_env)
 
     def _drop_from_idle(self, w: WorkerHandle):
-        idle = self.idle_by_job.get(w.job_id, [])
+        idle = self.idle_by_job.get((w.job_id, w.env_key), [])
         if w.worker_id.binary() in idle:
             idle.remove(w.worker_id.binary())
 
@@ -600,6 +650,7 @@ class NodeDaemon:
         strategy = pb.SchedulingStrategy.from_wire(payload.get("strategy"))
         job_id = payload["job_id"]
         hops = payload.get("hops", 0)
+        runtime_env = payload.get("runtime_env") or None
         logger.debug("request_lease res=%s hops=%s", spec_res.to_dict(), hops)
 
         if strategy.kind == pb.STRATEGY_PLACEMENT_GROUP:
@@ -610,7 +661,8 @@ class NodeDaemon:
                 # a draining node turned away, or it can never undrain us.
                 self._note_infeasible(spec_res)
                 return {"retry": True, "draining": True}
-            return await self._grant_pg_lease(spec_res, strategy, job_id)
+            return await self._grant_pg_lease(spec_res, strategy, job_id,
+                                              runtime_env)
 
         # Cluster policy: pick the best node; spill if it isn't us.
         if not self._draining:
@@ -643,7 +695,7 @@ class NodeDaemon:
             self._note_infeasible(spec_res)
             return {"retry": True, "draining": True}
         # Local grant path: queue until available.
-        pending = PendingLease(spec_res, strategy, job_id, hops)
+        pending = PendingLease(spec_res, strategy, job_id, hops, runtime_env)
         self.pending.append(pending)
         self._try_schedule()
         return await pending.future
@@ -755,11 +807,17 @@ class NodeDaemon:
                 # chip-holding lease always gets a fresh worker bound to its
                 # granted chip ids (reference: tpu.py:42-55; workers holding
                 # devices are gang-bound, not pooled)
+                from ray_tpu._private.runtime_env_mgr import env_isolation_key
+
                 w = await self._spawn_worker(
-                    p.job_id, tpu_chips=self._alloc_chips(n_tpu)
+                    p.job_id, tpu_chips=self._alloc_chips(n_tpu),
+                    env_key=env_isolation_key(p.runtime_env),
+                    runtime_env=p.runtime_env,
                 )
             else:
-                w = await self._get_idle_worker(p.job_id)
+                renv = p.runtime_env
+                ekey = (renv or {}).get("env_key", "")
+                w = await self._get_idle_worker(p.job_id, ekey, renv)
         except Exception as e:  # noqa: BLE001
             if pg_id is None:
                 self.available = self.available + p.spec_resources
@@ -784,7 +842,8 @@ class NodeDaemon:
             self._release_lease(lease_id)
 
     async def _grant_pg_lease(self, res: ResourceSet, strategy: pb.SchedulingStrategy,
-                              job_id: bytes) -> dict:
+                              job_id: bytes,
+                              runtime_env: Optional[dict] = None) -> dict:
         pg_id = bytes.fromhex(strategy.placement_group_id)
         pg = self.pg_prepared.get(pg_id)
         if pg is None or pg["state"] != "committed":
@@ -795,7 +854,7 @@ class NodeDaemon:
         for i in indices:
             if i in free and res.is_subset_of(free[i]):
                 free[i] = free[i] - res
-                p = PendingLease(res, strategy, job_id, 0)
+                p = PendingLease(res, strategy, job_id, 0, runtime_env)
                 await self._grant(p, pg_id=pg_id, bundle_index=i)
                 reply = await p.future
                 if reply.get("granted"):
@@ -831,7 +890,8 @@ class NodeDaemon:
                 w.lease_id = None
                 w.reserved = False
                 w.idle_since = time.monotonic()
-                self.idle_by_job.setdefault(w.job_id, []).append(worker_id)
+                self.idle_by_job.setdefault(
+                    (w.job_id, w.env_key), []).append(worker_id)
         self._try_schedule()
 
     async def rpc_return_lease(self, conn_id: int, payload: dict) -> dict:
@@ -966,16 +1026,21 @@ class NodeDaemon:
                 self.available = self.available + spec.resources
 
         n_tpu = int(spec.resources.get("TPU"))
+        from ray_tpu._private.runtime_env_mgr import env_isolation_key
+
+        renv = spec.runtime_env or None
         try:
             w = await self._spawn_worker(
                 spec.job_id.binary(),
                 tpu_chips=self._alloc_chips(n_tpu) if n_tpu > 0 else None,
+                env_key=env_isolation_key(renv),
+                runtime_env=renv,
             )
         except Exception as e:  # noqa: BLE001
             refund()
             return {"ok": False, "error": f"worker spawn failed: {e}"}
         # dedicate this worker to the actor
-        idle = self.idle_by_job.get(w.job_id, [])
+        idle = self.idle_by_job.get((w.job_id, w.env_key), [])
         if w.worker_id.binary() in idle:
             idle.remove(w.worker_id.binary())
         w.state = W_ACTOR

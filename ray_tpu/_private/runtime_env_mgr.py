@@ -1,29 +1,117 @@
-"""Runtime environments: per-task/actor working_dir, py_modules, env_vars.
+"""Runtime environments: per-task/actor pip venvs, working_dir, py_modules,
+env_vars.
 
 Reference surface: python/ray/runtime_env/ + _private/runtime_env/
 (ARCHITECTURE.md — env built once per URI, cached, applied before user
-code; working_dir/py_modules are content-addressed zips). Here the packages
-travel through the control store's KV (the reference's GCS-backed package
-store for small URIs), and the per-node cache lives in the session dir.
+code; working_dir/py_modules are content-addressed zips; pip/conda envs
+built by the per-node agent and workers exec'd inside them; worker_pool.h
+keys cached workers by runtime-env hash). Here the packages travel through
+the control store's KV (the reference's GCS-backed package store for small
+URIs), and the per-node cache lives in the session dir.
 
-Deviation noted: the reference starts a FRESH worker per runtime-env hash
-(worker pool keyed by env). Here env_vars/py_modules apply per task on
-pooled workers; `working_dir` performs a process-wide chdir, so it is
-applied for actors (dedicated workers) and for tasks each time one runs —
-two tasks with different working_dirs sharing a pooled worker see the
-latest chdir between (not during) executions.
+ISOLATING env fields (`pip`, `working_dir`) contribute to an env key that
+workers are POOLED BY: the daemon grants such leases only to workers
+spawned for that exact env — a pip env's worker runs on the venv's own
+interpreter, and working_dir is chdir'd once at worker startup. Two tasks
+with conflicting deps or different working dirs therefore run concurrently
+on one node in different worker processes, and the old process-wide-chdir-
+on-pooled-workers hazard is gone. Venvs are content-addressed
+(venvs/<hash> under the session cache) and built once per node with
+--system-site-packages, so the framework and its baked deps resolve while
+installed packages shadow them.
 """
 
 from __future__ import annotations
 
 import hashlib
 import io
+import json
 import os
+import subprocess
 import sys
 import zipfile
 from typing import Any, Dict, List, Optional
 
 KV_NS = "runtime_env"
+
+# fields whose values require a dedicated worker process
+_ISOLATING_FIELDS = ("pip", "working_dir_uri")
+
+
+def env_isolation_key(runtime_env: Optional[Dict[str, Any]]) -> str:
+    """Stable key of the wire env's isolating fields; '' = any pooled
+    worker may run it (reference: worker_pool.h runtime_env_hash)."""
+    if not runtime_env:
+        return ""
+    parts = {k: runtime_env[k] for k in _ISOLATING_FIELDS if runtime_env.get(k)}
+    if not parts:
+        return ""
+    if "pip" in parts:
+        # order-insensitive, matching ensure_venv's cache key — reordered
+        # but identical specs must share one worker pool
+        parts["pip"] = sorted(parts["pip"])
+    blob = json.dumps(parts, sort_keys=True).encode()
+    return hashlib.blake2b(blob, digest_size=8).hexdigest()
+
+
+def ensure_venv(pip_spec: List[str], cache_root: str) -> str:
+    """Build (or reuse) a content-addressed venv for `pip_spec`; returns its
+    python executable. Concurrent builders serialize on an flock; the venv
+    is built IN PLACE (crashed half-builds are tolerated by `venv` and
+    rebuilt) and readers are gated by the .rt_ready marker written after a
+    successful pip install. --no-build-isolation keeps local-path installs
+    offline (the build env would otherwise fetch setuptools from the
+    index)."""
+    key = hashlib.blake2b(
+        json.dumps(sorted(pip_spec)).encode(), digest_size=8).hexdigest()
+    venv_dir = os.path.join(cache_root, "venvs", key)
+    python = os.path.join(venv_dir, "bin", "python")
+    ready = os.path.join(venv_dir, ".rt_ready")
+    if os.path.exists(ready):
+        return python
+    os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+    import fcntl
+
+    with open(venv_dir + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(ready):  # built while we waited
+                return python
+            # build in place under the lock (venv tolerates an existing dir
+            # from a crashed attempt); the .rt_ready marker gates readers
+            subprocess.run(
+                [sys.executable, "-m", "venv", venv_dir],
+                check=True, capture_output=True, timeout=300,
+            )
+            # NOT --system-site-packages: a venv created from inside a venv
+            # (this image's /opt/venv) chains to the BASE interpreter's
+            # site-packages, losing jax/setuptools/the framework's deps.
+            # Instead a .pth appends the PARENT interpreter's site dirs
+            # after the venv's own — installs shadow, everything resolves.
+            import glob as _glob
+
+            vsite = _glob.glob(os.path.join(
+                venv_dir, "lib", "python*", "site-packages"))[0]
+            parent_sites = [
+                p for p in sys.path
+                if p.endswith("site-packages") and os.path.isdir(p)
+            ]
+            with open(os.path.join(vsite, "_rt_parent.pth"), "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+            r = subprocess.run(
+                [python, "-m", "pip", "install",
+                 "--no-build-isolation", "--quiet",
+                 "--retries", "1", "--timeout", "10", *pip_spec],
+                capture_output=True, timeout=600, text=True,
+            )
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"pip install {pip_spec} failed:\n{r.stderr[-2000:]}")
+            with open(ready, "w") as f:
+                f.write("ok")
+            return python
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
 
 
 def _zip_dir_bytes(path: str) -> bytes:
@@ -98,6 +186,26 @@ async def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
                 raise ValueError(f"py_modules entry {m!r} is not a directory")
             uris.append(await upload(m) + ":" + os.path.basename(m.rstrip("/")))
         out["py_module_uris"] = uris
+    pip = out.get("pip")
+    if pip is not None:
+        if not isinstance(pip, (list, tuple)) or not all(
+                isinstance(p, str) for p in pip):
+            raise ValueError("runtime_env['pip'] must be a list of "
+                             "requirement strings / local paths")
+        # entries that LOOK like paths resolve against the DRIVER's cwd;
+        # make them absolute so the daemon-side pip sees the same files.
+        # Bare names stay requirement strings even if a same-named file
+        # happens to exist in the cwd.
+        def looks_like_path(p: str) -> bool:
+            return p.startswith((".", "/", "~")) or os.sep in p
+
+        out["pip"] = [
+            os.path.abspath(os.path.expanduser(p))
+            if looks_like_path(p) and os.path.exists(os.path.expanduser(p))
+            else p
+            for p in pip
+        ]
+    out["env_key"] = env_isolation_key(out)
     return out
 
 
@@ -124,9 +232,12 @@ async def _fetch_extract(uri: str, cw, cache_root: str) -> str:
     return dest
 
 
-async def setup_runtime_env(runtime_env: Optional[Dict[str, Any]], cw):
+async def setup_runtime_env(runtime_env: Optional[Dict[str, Any]], cw,
+                            dedicated: bool = False):
     """Executor side: apply env before user code runs (reference: the
-    runtime-env agent builds the env, the worker execs inside it)."""
+    runtime-env agent builds the env, the worker execs inside it).
+    `dedicated` = this process serves only this env (actor workers; task
+    workers are instead spawned with RT_ENV_KEY by the daemon)."""
     if not runtime_env:
         return
     env_vars = runtime_env.get("env_vars") or {}
@@ -160,6 +271,13 @@ async def setup_runtime_env(runtime_env: Optional[Dict[str, Any]], cw):
     wd_uri = runtime_env.get("working_dir_uri")
     if wd_uri:
         wd = await _fetch_extract(wd_uri, cw, cache_root)
-        os.chdir(wd)
         if wd not in sys.path:
             sys.path.insert(0, wd)
+        # chdir only on a worker DEDICATED to this env (spawned with the
+        # matching key, already chdir'd at startup — this is then a no-op
+        # after a crash-restart). On a shared worker a process-wide chdir
+        # would race concurrent tasks; sys.path covers imports instead.
+        if dedicated or (
+                os.environ.get("RT_ENV_KEY", "")
+                == runtime_env.get("env_key", "")):
+            os.chdir(wd)

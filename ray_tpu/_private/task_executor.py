@@ -423,7 +423,8 @@ class TaskExecutor:
         try:
             from ray_tpu._private.runtime_env_mgr import setup_runtime_env
 
-            await setup_runtime_env(spec.runtime_env, self.cw)
+            # actor workers are dedicated to this env for their lifetime
+            await setup_runtime_env(spec.runtime_env, self.cw, dedicated=True)
             cls = await self.cw.fetch_function(spec.function_key)
             args, kwargs = await self._resolve_args(spec.args)
             self.actor_spec = spec
