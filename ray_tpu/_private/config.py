@@ -153,6 +153,12 @@ _flag("object_spill_enabled", True, "Spill cold sealed objects to disk under sto
 _flag("object_spill_high_water", 0.7, "Store fullness fraction that triggers spilling.")
 _flag("object_spill_low_water", 0.5, "Spill until store fullness drops below this fraction.")
 _flag("object_spill_check_period_s", 0.25, "Spill loop poll period.")
+_flag("object_store_full_delay_s", 0.05, "Initial backoff between create retries while the object store is full (reference: plasma CreateRequestQueue retry cadence).")
+_flag("object_store_full_timeout_s", 30.0, "Total time a create waits for store capacity (spill + consumers freeing) before ObjectStoreFullError surfaces (reference: create_request_queue.h oom_grace_period).")
+_flag("memory_monitor_interval_s", 1.0, "Daemon memory-monitor poll period; <= 0 disables OOM worker killing (reference: memory_monitor.h).")
+_flag("memory_usage_threshold", 0.95, "Memory usage fraction above which the daemon kills a worker per interval (reference: RAY_memory_usage_threshold).")
+_flag("memory_limit_bytes", 0, "Memory budget for the OOM monitor; 0 = node total (psutil). When set, usage is measured as the sum of worker-tree RSS against this budget (testable), else system-wide usage fraction.")
+_flag("object_store_destructive_eviction", False, "Let a full store DESTROY LRU unpinned objects on create (cache semantics). Default off: full stores backpressure creators and rely on spilling — destroying a sole copy of an owned object is silent data loss (reference: plasma never evicts primary copies).")
 _flag("control_store_persist", False, "Persist control-store state (nodes/actors/PGs/KV/jobs) to a WAL+snapshot in the session dir; a restarted control store recovers it (reference: gcs redis/rocksdb store clients).")
 _flag("control_store_wal_compact_every", 512, "WAL records between snapshot compactions.")
 _flag("lineage_cache_max_tasks", 4096, "Completed task specs kept per owner for lineage reconstruction of lost shm objects (reference: task_manager lineage pinning).")

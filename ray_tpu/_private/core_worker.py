@@ -2930,13 +2930,39 @@ class CoreWorker:
 
     async def _create_with_spill(self, oid: ObjectID, size: int,
                                  meta: int = META_NORMAL) -> memoryview:
-        """create() with one retry after asking the daemon to spill — a burst
-        of seals can outrun the proactive spill loop."""
+        """create() with BACKPRESSURE: a full store asks the daemon to spill
+        and then retries with backoff until capacity appears (spilling,
+        eviction, or consumers releasing refs) or the grace period expires
+        (reference: plasma create_request_queue.h — creates queue under
+        memory pressure instead of failing immediately)."""
         try:
             return self.store.create(oid, size, meta)
         except ObjectStoreFullError:
-            await self.daemon.call("spill_now", {"need_bytes": size}, timeout=120)
-            return self.store.create(oid, size, meta)
+            pass
+        deadline = time.monotonic() + GLOBAL_CONFIG.get(
+            "object_store_full_timeout_s")
+        delay = GLOBAL_CONFIG.get("object_store_full_delay_s")
+        last_exc: Optional[Exception] = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            # a dead/hung daemon propagates (as before this backpressure
+            # existed) rather than masquerading as a full store; the call is
+            # bounded by the remaining grace so the deadline is honored
+            await self.daemon.call(
+                "spill_now", {"need_bytes": size},
+                timeout=max(1.0, remaining))
+            try:
+                return self.store.create(oid, size, meta)
+            except ObjectStoreFullError as e:
+                last_exc = e
+            await asyncio.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2, 1.0)
+        raise ObjectStoreFullError(
+            f"object store still full after "
+            f"{GLOBAL_CONFIG.get('object_store_full_timeout_s')}s waiting "
+            f"for capacity ({size} bytes needed): {last_exc}")
 
     async def store_return(self, oid: ObjectID, sobj: ser.SerializedObject,
                            meta: int = META_NORMAL) -> dict:

@@ -53,6 +53,7 @@ class WorkerHandle:
         "worker_id", "proc", "state", "address", "pid", "job_id",
         "client", "lease_id", "actor_id", "ready_event", "idle_since",
         "actor_resources", "actor_pg", "tpu_chips", "reserved", "env_key",
+        "spawn_ts",
     )
 
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen, job_id: bytes):
@@ -62,6 +63,7 @@ class WorkerHandle:
         self.address = ""
         self.pid = proc.pid
         self.job_id = job_id
+        self.spawn_ts = time.monotonic()  # OOM policy kills newest first
         # runtime-env isolation key this worker was spawned for ("" = plain
         # pooled worker; reference: worker_pool.h keys by runtime_env_hash)
         self.env_key = ""
@@ -239,6 +241,8 @@ class NodeDaemon:
                 16, int(self.total_resources.to_dict().get("CPU", 0)))
         for _ in range(prestart):
             spawn(self._spawn_worker(job_id=b"", reserve=False))
+        self._oom_kills = 0
+        self._tasks.append(spawn(self._memory_monitor_loop()))
         logger.info(
             "daemon %s up at %s store=%s resources=%s",
             self.node_id.hex()[:8], addr, self.store_name, self.total_resources.to_dict(),
@@ -1184,10 +1188,98 @@ class NodeDaemon:
                     spilled_bytes / 2**20, self.spill_dir, len(self.spilled),
                 )
 
+    # ------------------------------------------------------------------
+    # memory-pressure worker killing (reference:
+    # src/ray/raylet/worker_killing_policy_group_by_owner.h — group tasks
+    # by owner, kill the newest member of the largest group so retried
+    # work loses the least progress and no single owner is starved)
+    # ------------------------------------------------------------------
+
+    def _memory_usage_fraction(self, psutil) -> float:
+        limit = GLOBAL_CONFIG.get("memory_limit_bytes")
+        if limit <= 0:
+            return psutil.virtual_memory().percent / 100.0
+        total = 0
+        for w in self.workers.values():
+            if w.state == W_DEAD:
+                continue
+            try:
+                proc = psutil.Process(w.pid)
+                procs = [proc, *proc.children(recursive=True)]
+                for p in procs:
+                    mi = p.memory_info()
+                    # exclude shared pages: every worker maps the same shm
+                    # object store, and counting those pages once PER worker
+                    # would OOM-kill healthy readers of one big object
+                    total += max(0, mi.rss - getattr(mi, "shared", 0))
+            except psutil.Error:
+                continue
+        return total / limit
+
+    def _pick_oom_victim(self) -> Optional[WorkerHandle]:
+        """Group-by-owner, newest-first (reference policy): leased task
+        workers grouped by job; the largest group loses its newest member —
+        running tasks are where the memory is, so reaping them first is the
+        only selection that actually relieves pressure (idle workers hold
+        ~nothing and would shield a hog forever). Idle workers go only when
+        no task runs; actors are never OOM-killed (restart churn)."""
+        leased = [w for w in self.workers.values() if w.state == W_LEASED]
+        if leased:
+            groups: Dict[bytes, List[WorkerHandle]] = {}
+            for w in leased:
+                groups.setdefault(w.job_id, []).append(w)
+            biggest = max(groups.values(), key=len)
+            return max(biggest, key=lambda w: w.spawn_ts)
+        idle = [w for w in self.workers.values() if w.state == W_IDLE]
+        if idle:
+            return max(idle, key=lambda w: w.spawn_ts)
+        return None
+
+    async def _memory_monitor_loop(self):
+        period = GLOBAL_CONFIG.get("memory_monitor_interval_s")
+        if period <= 0:
+            return
+        try:
+            import psutil
+        except ImportError:
+            logger.warning("psutil unavailable; OOM monitor disabled")
+            return
+        self._oom_kills = 0
+        while not self._stopped:
+            await asyncio.sleep(period)
+            try:
+                frac = self._memory_usage_fraction(psutil)
+                if frac < GLOBAL_CONFIG.get("memory_usage_threshold"):
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                self._oom_kills += 1
+                logger.warning(
+                    "memory pressure %.0f%% >= threshold: OOM-killing "
+                    "worker %s (state=%s job=%s, newest of largest owner "
+                    "group; kill #%d)",
+                    frac * 100, victim.worker_id.hex()[:8], victim.state,
+                    victim.job_id.hex()[:8], self._oom_kills,
+                )
+                lease_id = victim.lease_id
+                self._kill_worker_proc(victim, "OOM: node memory pressure")
+                if lease_id is not None:
+                    # _forget_worker removed it from the reap loop's sight:
+                    # credit the lease's resources back ourselves or the
+                    # node's capacity shrinks with every OOM kill
+                    self._release_lease(lease_id)
+            except Exception:  # noqa: BLE001 — monitor must survive
+                logger.exception("memory monitor iteration failed")
+
     async def rpc_spill_now(self, conn_id: int, payload: dict) -> dict:
         """Synchronous spill request from a worker whose create() hit
         ObjectStoreFullError (reference: raylet triggers spilling when a
         plasma allocation stalls)."""
+        if not GLOBAL_CONFIG.get("object_spill_enabled"):
+            # spilling disabled: the creator's backpressure loop waits for
+            # consumers to free refs instead (no spill_dir even exists)
+            return {"ok": False, "disabled": True}
         need = payload.get("need_bytes", 0)
         st = self.store.stats()
         low = GLOBAL_CONFIG.get("object_spill_low_water")

@@ -81,12 +81,15 @@ class ShmObjectStore:
         self._lib = _Lib()
         self.name = name
         if allow_evict is None:
-            # With spilling on (default), a full store returns FULL and the
-            # daemon spills; in-store LRU eviction (which destroys data) only
-            # backstops spilling-disabled deployments.
+            # A full store returns FULL: the daemon spills (when enabled)
+            # and creators BACKPRESSURE until capacity appears (reference:
+            # plasma create_request_queue.h — primary copies are never
+            # destroyed; eviction deleting a sole copy would turn a full
+            # store into silent data loss). Destructive in-store LRU
+            # eviction is an explicit cache-semantics opt-in.
             from ray_tpu._private.config import GLOBAL_CONFIG
 
-            allow_evict = not GLOBAL_CONFIG.get("object_spill_enabled")
+            allow_evict = GLOBAL_CONFIG.get("object_store_destructive_eviction")
         self._allow_evict = 1 if allow_evict else 0
         if create:
             self._handle = self._lib.rt_store_create(name.encode(), size, capacity)
