@@ -2948,11 +2948,12 @@ class CoreWorker:
             if remaining <= 0:
                 break
             # a dead/hung daemon propagates (as before this backpressure
-            # existed) rather than masquerading as a full store; the call is
-            # bounded by the remaining grace so the deadline is honored
+            # existed) rather than masquerading as a full store. The fixed
+            # generous timeout lets a SLOW-but-working multi-GB spill finish
+            # (overrunning the grace period by at most one call is better
+            # than failing a create the spill was about to satisfy).
             await self.daemon.call(
-                "spill_now", {"need_bytes": size},
-                timeout=max(1.0, remaining))
+                "spill_now", {"need_bytes": size}, timeout=120)
             try:
                 return self.store.create(oid, size, meta)
             except ObjectStoreFullError as e:

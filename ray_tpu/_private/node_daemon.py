@@ -1244,7 +1244,6 @@ class NodeDaemon:
         except ImportError:
             logger.warning("psutil unavailable; OOM monitor disabled")
             return
-        self._oom_kills = 0
         while not self._stopped:
             await asyncio.sleep(period)
             try:

@@ -2,10 +2,14 @@
 learners (reference surface: rllib/algorithms/*, core/learner/*,
 env/env_runner_group.py)."""
 
+from ray_tpu.rllib import connectors
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, ReplayBuffer
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import PPOLearner, compute_gae
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner import VTraceLearner
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
-__all__ = ["EnvRunner", "IMPALA", "IMPALAConfig", "PPO", "PPOConfig", "PPOLearner", "VTraceLearner", "compute_gae"]
+__all__ = ["DQN", "DQNConfig", "DQNLearner", "EnvRunner", "IMPALA",
+           "IMPALAConfig", "PPO", "PPOConfig", "PPOLearner", "ReplayBuffer",
+           "VTraceLearner", "compute_gae", "connectors"]

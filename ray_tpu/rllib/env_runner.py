@@ -21,7 +21,10 @@ class EnvRunner:
 
     def __init__(self, env_name: str, *, seed: int = 0,
                  env_config: Optional[dict] = None,
-                 gamma: float = 0.99, gae_lambda: float = 0.95):
+                 gamma: float = 0.99, gae_lambda: float = 0.95,
+                 policy_kind: str = "categorical",
+                 env_to_module: Optional[Any] = None,
+                 module_to_env: Optional[Any] = None):
         import gymnasium as gym
 
         self.env = gym.make(env_name, **(env_config or {}))
@@ -30,6 +33,14 @@ class EnvRunner:
         self.gamma = gamma
         self.lam = gae_lambda
         self.weights = None
+        # "categorical" (actor-critic heads) or "epsilon_greedy" (Q head)
+        self.policy_kind = policy_kind
+        self.epsilon = 0.0
+        # connector pipelines (reference: ConnectorV2 env_to_module /
+        # module_to_env); processed observations are what both the policy
+        # AND the emitted batches see
+        self.env_to_module = env_to_module
+        self.module_to_env = module_to_env
         self._episode_return = 0.0
         self._completed_returns: List[float] = []
 
@@ -37,10 +48,33 @@ class EnvRunner:
         self.weights = weights
         return True
 
-    def _policy(self, obs: np.ndarray):
-        from ray_tpu.rllib.learner import policy_logits, value_fn
+    def set_exploration(self, epsilon: float) -> bool:
+        self.epsilon = float(epsilon)
+        return True
 
-        import jax.nn
+    def _preprocess(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        if self.env_to_module is None:
+            return obs
+        return self.env_to_module({"obs": obs[None]})["obs"][0]
+
+    def _postprocess_action(self, action):
+        if self.module_to_env is None:
+            return action
+        return self.module_to_env(
+            {"actions": np.asarray([action])})["actions"][0]
+
+    def _policy(self, obs: np.ndarray):
+        if self.policy_kind == "epsilon_greedy":
+            from ray_tpu.rllib.learner import mlp_apply
+
+            q = np.asarray(mlp_apply(self.weights["q"], obs[None]))[0]
+            if self.rng.random() < self.epsilon:
+                action = int(self.rng.integers(len(q)))
+            else:
+                action = int(np.argmax(q))
+            return action, 0.0, float(q[action])
+        from ray_tpu.rllib.learner import policy_logits, value_fn
 
         logits = np.asarray(policy_logits(self.weights, obs[None]))[0]
         logits = logits - logits.max()
@@ -58,7 +92,8 @@ class EnvRunner:
         from ray_tpu.rllib.learner import compute_gae, value_fn
 
         assert self.weights is not None, "set_weights before sample"
-        obs_buf = np.zeros((num_steps, *np.shape(self.obs)), dtype=np.float32)
+        probe = self._preprocess(self.obs)
+        obs_buf = np.zeros((num_steps, *probe.shape), dtype=np.float32)
         act_buf = np.zeros(num_steps, dtype=np.int32)
         logp_buf = np.zeros(num_steps, dtype=np.float32)
         rew_buf = np.zeros(num_steps, dtype=np.float32)
@@ -67,14 +102,19 @@ class EnvRunner:
         val_buf = np.zeros(num_steps, dtype=np.float32)
         next_val_buf = np.zeros(num_steps, dtype=np.float32)
 
-        def _value(obs) -> float:
-            return float(np.asarray(
-                value_fn(self.weights, np.asarray(obs, np.float32)[None]))[0])
+        def _value_p(pobs) -> float:
+            return float(np.asarray(value_fn(self.weights, pobs[None]))[0])
 
+        # preprocess each raw frame exactly ONCE and carry it forward:
+        # stateful connectors (NormalizeObs) advance running statistics per
+        # call, so re-preprocessing would make next_obs[t] != obs[t+1]
+        pobs = probe
         for t in range(num_steps):
-            action, logp, value = self._policy(np.asarray(self.obs, np.float32))
-            nxt, reward, terminated, truncated, _ = self.env.step(action)
-            obs_buf[t] = self.obs
+            action, logp, value = self._policy(pobs)
+            nxt, reward, terminated, truncated, _ = self.env.step(
+                self._postprocess_action(action))
+            pnxt = self._preprocess(nxt)
+            obs_buf[t] = pobs
             act_buf[t] = action
             logp_buf[t] = logp
             rew_buf[t] = reward
@@ -86,18 +126,20 @@ class EnvRunner:
                 # bootstrap from the TRUE successor: on truncation that is
                 # the pre-reset final observation, never the next episode's
                 # start (interior steps are backfilled from val_buf below)
-                next_val_buf[t] = 0.0 if terminated else _value(nxt)
+                next_val_buf[t] = 0.0 if terminated else _value_p(pnxt)
             self._episode_return += float(reward)
             if done:
                 self._completed_returns.append(self._episode_return)
                 self._episode_return = 0.0
                 self.obs, _ = self.env.reset()
+                pobs = self._preprocess(self.obs)
             else:
                 self.obs = nxt
+                pobs = pnxt
         interior = cut_buf[:-1] == 0.0
         next_val_buf[:-1][interior] = val_buf[1:][interior]
         if cut_buf[-1] == 0.0:
-            next_val_buf[-1] = _value(self.obs)
+            next_val_buf[-1] = _value_p(pobs)
         adv, ret = compute_gae(
             rew_buf, val_buf, next_val_buf, term_buf, cut_buf,
             self.gamma, self.lam)
@@ -112,18 +154,23 @@ class EnvRunner:
         off-policyness itself (reference: IMPALA env runners ship raw
         fragments; impala.py:526)."""
         assert self.weights is not None, "set_weights before sample"
-        obs_buf = np.zeros((num_steps, *np.shape(self.obs)), dtype=np.float32)
+        probe = self._preprocess(self.obs)
+        obs_buf = np.zeros((num_steps, *probe.shape), dtype=np.float32)
         next_obs_buf = np.zeros_like(obs_buf)
         act_buf = np.zeros(num_steps, dtype=np.int32)
         logp_buf = np.zeros(num_steps, dtype=np.float32)
         rew_buf = np.zeros(num_steps, dtype=np.float32)
         term_buf = np.zeros(num_steps, dtype=np.float32)
         cut_buf = np.zeros(num_steps, dtype=np.float32)
+        # single preprocess per frame, carried forward (see sample())
+        pobs = probe
         for t in range(num_steps):
-            action, logp, _ = self._policy(np.asarray(self.obs, np.float32))
-            nxt, reward, terminated, truncated, _ = self.env.step(action)
-            obs_buf[t] = self.obs
-            next_obs_buf[t] = nxt  # pre-reset successor on episode end
+            action, logp, _ = self._policy(pobs)
+            nxt, reward, terminated, truncated, _ = self.env.step(
+                self._postprocess_action(action))
+            pnxt = self._preprocess(nxt)
+            obs_buf[t] = pobs
+            next_obs_buf[t] = pnxt  # pre-reset successor on episode end
             act_buf[t] = action
             logp_buf[t] = logp
             rew_buf[t] = reward
@@ -135,8 +182,10 @@ class EnvRunner:
                 self._completed_returns.append(self._episode_return)
                 self._episode_return = 0.0
                 self.obs, _ = self.env.reset()
+                pobs = self._preprocess(self.obs)
             else:
                 self.obs = nxt
+                pobs = pnxt
         return {
             "obs": obs_buf, "next_obs": next_obs_buf, "actions": act_buf,
             "logp": logp_buf, "rewards": rew_buf, "terminated": term_buf,
