@@ -199,6 +199,8 @@ class ControlStore:
         self.metrics_by_worker: Dict[bytes, dict] = {}
         # per-node scheduling load from heartbeats (autoscaler demand)
         self.node_load: Dict[bytes, dict] = {}
+        # per-node physical stats from heartbeats (dashboard reporter)
+        self.node_stats: Dict[bytes, dict] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._stopped = False
         self._wal = None
@@ -388,6 +390,7 @@ class ControlStore:
         info.state = pb.NODE_DEAD
         self.node_available.pop(node_id, None)
         self.node_load.pop(node_id, None)
+        self.node_stats.pop(node_id, None)  # never serve a dead node's stats
         client = self._daemon_clients.pop(node_id, None)
         if client:
             await client.close()
@@ -452,6 +455,12 @@ class ControlStore:
         self.node_last_beat[node_id] = time.monotonic()
         if "available" in payload:
             self.node_available[node_id] = ResourceSet.from_wire(payload["available"])
+        if "stats" in payload:
+            # per-node psutil/store snapshot for the dashboard (reference:
+            # the reporter agent publishing node physical stats)
+            self.node_stats[node_id] = {
+                **payload["stats"], "ts": time.time(),
+            }
         # demand signal for the autoscaler (reference: raylets report load in
         # resource-view sync; GcsAutoscalerStateManager aggregates it)
         self.node_load[node_id] = {
@@ -519,6 +528,13 @@ class ControlStore:
 
     async def rpc_get_all_nodes(self, conn_id: int, payload) -> dict:
         return {"nodes": [n.to_wire() for n in self.nodes.values()]}
+
+    async def rpc_get_node_stats(self, conn_id: int, payload) -> dict:
+        """Per-node physical stats from heartbeats (reference: the reporter
+        agent's psutil samples surfaced via the dashboard head)."""
+        return {"stats": {
+            nid.hex(): stats for nid, stats in self.node_stats.items()
+        }}
 
     async def rpc_drain_node(self, conn_id: int, payload: dict) -> dict:
         node_id = payload["node_id"]

@@ -307,6 +307,10 @@ class NodeDaemon:
                     {
                         "node_id": self.node_id.binary(),
                         "available": self.available.to_wire(),
+                        # per-node physical stats for the dashboard/state API
+                        # (reference: the per-node dashboard agent's psutil
+                        # reporter, dashboard/modules/reporter/)
+                        "stats": self._node_stats(),
                         # scheduling load → autoscaler demand (reference:
                         # raylet resource-view sync carries load). Infeasible
                         # shapes count too: no live node can host them, but
@@ -1270,6 +1274,91 @@ class NodeDaemon:
                     self._release_lease(lease_id)
             except Exception:  # noqa: BLE001 — monitor must survive
                 logger.exception("memory monitor iteration failed")
+
+    def _node_stats(self) -> dict:
+        """psutil snapshot shipped with every heartbeat (reference: the
+        dashboard agent's reporter module samples cpu/mem/gpu per node)."""
+        out: dict = {
+            "workers": sum(1 for w in self.workers.values()
+                           if w.state != W_DEAD),
+            "workers_idle": sum(1 for w in self.workers.values()
+                                if w.state == W_IDLE),
+            "oom_kills": getattr(self, "_oom_kills", 0),
+        }
+        if self.store is not None:
+            st = self.store.stats()
+            out["store_bytes_in_use"] = st["bytes_in_use"]
+            out["store_heap_size"] = st["heap_size"]
+            out["store_num_objects"] = st["num_objects"]
+        try:
+            import psutil
+
+            out["cpu_percent"] = psutil.cpu_percent(interval=None)
+            vm = psutil.virtual_memory()
+            out["mem_percent"] = vm.percent
+            out["mem_total"] = vm.total
+            rss = 0
+            for w in self.workers.values():
+                if w.state == W_DEAD:
+                    continue
+                try:
+                    rss += psutil.Process(w.pid).memory_info().rss
+                except psutil.Error:
+                    continue
+            out["workers_rss"] = rss
+        except ImportError:
+            pass
+        return out
+
+    async def rpc_list_workers(self, conn_id: int, payload: dict) -> dict:
+        """Live workers on this node (the dashboard's per-node worker table;
+        reference: dashboard reporter's worker listing)."""
+        return {"workers": [
+            {
+                "worker_id": w.worker_id.hex(),
+                "pid": w.pid,
+                "state": w.state,
+                "job_id": w.job_id.hex(),
+                "env_key": w.env_key,
+                "actor_id": w.actor_id.hex() if w.actor_id else "",
+            }
+            for w in self.workers.values() if w.state != W_DEAD
+        ]}
+
+    async def rpc_profile_worker(self, conn_id: int, payload: dict) -> dict:
+        """On-demand stack sample of a live worker (reference: the
+        dashboard's py-spy/memray profiling,
+        dashboard/modules/reporter/profile_manager.py:60-102): SIGUSR1
+        dumps all thread stacks, SIGUSR2 dumps asyncio task await-chains —
+        both land in the worker's .err log, whose tail is returned."""
+        wid = payload["worker_id"]
+        if isinstance(wid, str):
+            wid = bytes.fromhex(wid)
+        w = self.workers.get(wid)
+        if w is None or w.state == W_DEAD or w.proc.poll() is not None:
+            return {"ok": False, "error": "worker not found or dead"}
+        kind = payload.get("kind", "threads")
+        sig = signal.SIGUSR2 if kind == "tasks" else signal.SIGUSR1
+        log_path = os.path.join(
+            self.session_dir, "logs",
+            f"worker-{w.worker_id.hex()[:12]}.err")
+        try:
+            before = os.path.getsize(log_path)
+        except OSError:
+            before = 0
+        try:
+            os.kill(w.pid, sig)
+        except ProcessLookupError:
+            return {"ok": False, "error": "worker died"}
+        await asyncio.sleep(0.4)  # dump is async-signal-driven
+        try:
+            with open(log_path, "rb") as f:
+                f.seek(before)
+                dump = f.read(256 * 1024).decode("utf-8", "replace")
+        except OSError as e:
+            return {"ok": False, "error": f"log unreadable: {e}"}
+        return {"ok": True, "worker_id": w.worker_id.hex(), "pid": w.pid,
+                "kind": kind, "dump": dump}
 
     async def rpc_spill_now(self, conn_id: int, payload: dict) -> dict:
         """Synchronous spill request from a worker whose create() hit

@@ -86,6 +86,10 @@ class DashboardActor:
         app.router.add_get("/api/task_summary", self._task_summary)
         app.router.add_get("/api/placement_groups", self._pgs)
         app.router.add_get("/api/cluster_load", self._cluster_load)
+        app.router.add_get("/api/node_stats", self._node_stats)
+        app.router.add_get("/api/workers", self._workers)
+        app.router.add_get("/api/profile", self._profile)
+        app.router.add_get("/api/jax_profile", self._jax_profile)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -109,6 +113,113 @@ class DashboardActor:
 
         return web.Response(text=_PAGE, content_type="text/html")
 
+    async def _resolve_node(self, node_hex: str) -> dict:
+        """Find a LIVE node by full id or unique prefix (>= 8 chars)."""
+        reply = await self._control("get_all_nodes")
+        matches = [
+            n for n in reply["nodes"]
+            if n["node_id"].hex() == node_hex
+            or (len(node_hex) >= 8 and n["node_id"].hex().startswith(node_hex))
+        ]
+        if not matches:
+            raise ValueError(f"unknown node {node_hex}")
+        if len(matches) > 1:
+            raise ValueError(f"ambiguous node prefix {node_hex}")
+        if matches[0]["state"] == "DEAD":
+            raise ValueError(f"node {node_hex} is dead")
+        return matches[0]
+
+    async def _daemon_call(self, node_hex: str, method: str, payload: dict):
+        """RPC a specific node's daemon (resolved through the control
+        store's node table)."""
+        from ray_tpu.runtime.rpc import RpcClient
+
+        n = await self._resolve_node(node_hex)
+        client = RpcClient(n["address"], name="dash->daemon", retries=1)
+        await client.connect()
+        try:
+            return await client.call(method, payload, timeout=60)
+        finally:
+            await client.close()
+
+    async def _node_stats(self, request):
+        """Per-node psutil/store stats sampled by daemons into the control
+        store (reference: dashboard reporter agents)."""
+        from aiohttp import web
+
+        reply = await self._control("get_node_stats")
+        return web.json_response(reply["stats"])
+
+    async def _workers(self, request):
+        """?node=<hex>: live workers on that node."""
+        from aiohttp import web
+
+        from ray_tpu.runtime.rpc import RpcError
+
+        node = request.query.get("node", "")
+        try:
+            reply = await self._daemon_call(node, "list_workers", {})
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except (RpcError, ConnectionError, OSError) as e:
+            return web.json_response(
+                {"error": f"daemon unreachable: {e}"}, status=502)
+        return web.json_response(reply["workers"])
+
+    async def _profile(self, request):
+        """?node=<hex>&worker=<hex>[&kind=threads|tasks]: on-demand stack
+        sample of a live worker (reference: the dashboard's py-spy
+        profiling endpoint, reporter/profile_manager.py:60-102)."""
+        from aiohttp import web
+
+        from ray_tpu.runtime.rpc import RpcError
+
+        node = request.query.get("node", "")
+        worker = request.query.get("worker", "")
+        kind = request.query.get("kind", "threads")
+        try:
+            bytes.fromhex(worker)
+        except ValueError:
+            return web.json_response(
+                {"error": f"bad worker id {worker!r}"}, status=400)
+        try:
+            reply = await self._daemon_call(
+                node, "profile_worker", {"worker_id": worker, "kind": kind})
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except (RpcError, ConnectionError, OSError) as e:
+            return web.json_response(
+                {"error": f"daemon unreachable: {e}"}, status=502)
+        status = 200 if reply.get("ok") else 400
+        return web.json_response(reply, status=status)
+
+    async def _jax_profile(self, request):
+        """?node=<hex>&duration=2[&logdir=...]: capture a JAX/XPlane trace
+        on that node via a pinned task (reference: the dashboard's JAX
+        profiler capture, reporter/jax_profile_manager.py:11). The trace
+        dir is created ON THE TARGET node (default: its temp dir)."""
+        from aiohttp import web
+
+        node = request.query.get("node", "")
+        try:
+            duration = float(request.query.get("duration", "2"))
+        except ValueError:
+            return web.json_response(
+                {"error": "duration must be a number"}, status=400)
+        logdir = request.query.get("logdir")
+        try:
+            n = await self._resolve_node(node)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        from ray_tpu._private.core_worker import get_core_worker
+        from ray_tpu.tpu.profiler import node_capture_task
+
+        cw = get_core_worker()
+        ref = node_capture_task(n["node_id"].hex()).remote(logdir, duration)
+        out_dir, files = await cw.get_async(ref, timeout=duration + 120)
+        return web.json_response(
+            {"node": n["node_id"].hex(), "logdir": out_dir, "files": files})
+
     async def _nodes(self, request):
         from aiohttp import web
 
@@ -117,7 +228,9 @@ class DashboardActor:
         reply = await self._control("get_all_nodes")
         return web.json_response([
             {
-                "node_id": NodeInfo.from_wire(n).node_id.hex()[:12],
+                # FULL hex: these ids feed /api/workers, /api/profile and
+                # /api/jax_profile, which resolve nodes by exact id
+                "node_id": NodeInfo.from_wire(n).node_id.hex(),
                 "state": n["state"],
                 "address": n["address"],
                 "resources": NodeInfo.from_wire(n).resources.to_dict(),

@@ -44,27 +44,40 @@ def capture_local(logdir: str, duration_s: float = 2.0,
 
 
 @ray_tpu.remote
-def _capture_task(logdir: str, duration_s: float) -> List[str]:
-    """Runs on the target node's worker: captures its JAX runtime trace."""
+def _capture_task(logdir: Optional[str], duration_s: float):
+    """Runs on the target node's worker: captures its JAX runtime trace.
+    logdir=None creates a temp dir ON THE TARGET (a dashboard-side path
+    would be meaningless on another node). Returns (logdir, files)."""
+    if logdir is None:
+        import tempfile
+
+        logdir = tempfile.mkdtemp(prefix="rt_jaxprof_")
     capture_local(logdir, duration_s)
     out = []
     for root, _dirs, files in os.walk(logdir):
         out.extend(os.path.join(root, f) for f in files)
-    return out
+    return logdir, out
 
 
-def capture_on_node(node_id_hex: str, logdir: str,
-                    duration_s: float = 2.0) -> List[str]:
-    """Capture a JAX profile on a specific node (reference: the dashboard
-    agent's per-node capture). Returns trace file paths on that node."""
+def node_capture_task(node_id_hex: str):
+    """The capture task pinned to `node_id_hex` (shared by capture_on_node
+    and the dashboard's /api/jax_profile)."""
     from ray_tpu._private.protocol import SchedulingStrategy
 
-    task = _capture_task.options(
+    return _capture_task.options(
         scheduling_strategy=SchedulingStrategy(
             kind="NODE_AFFINITY", node_id=node_id_hex, soft=False),
     )
-    return ray_tpu.get(task.remote(logdir, duration_s),
-                       timeout=duration_s + 120)
 
 
-__all__ = ["capture_local", "capture_on_node", "init_jax_profiler"]
+def capture_on_node(node_id_hex: str, logdir: Optional[str] = None,
+                    duration_s: float = 2.0) -> List[str]:
+    """Capture a JAX profile on a specific node (reference: the dashboard
+    agent's per-node capture). Returns trace file paths on that node."""
+    _dir, files = ray_tpu.get(
+        node_capture_task(node_id_hex).remote(logdir, duration_s),
+        timeout=duration_s + 120)
+    return files
+
+
+__all__ = ["capture_local", "capture_on_node", "init_jax_profiler", "node_capture_task"]

@@ -1,0 +1,89 @@
+"""Per-node metrics agent + on-demand profiling (reference: dashboard
+reporter module — psutil sampling, py-spy/memray profiling endpoints,
+JAX profiler capture)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    stop_dashboard()
+    ray_tpu.shutdown()
+
+
+def _node_hex():
+    from ray_tpu._private.core_worker import get_core_worker
+
+    return get_core_worker().node_id_hex
+
+
+def test_node_stats_flow_through_heartbeats(ray_init):
+    import httpx
+
+    url = start_dashboard(port=18266)
+    deadline = time.time() + 20
+    stats = {}
+    while time.time() < deadline:
+        stats = httpx.get(f"{url}/api/node_stats", timeout=30).json()
+        if stats:
+            break
+        time.sleep(0.5)
+    assert stats, "no node stats arrived via heartbeats"
+    node = stats[_node_hex()]
+    assert node["workers"] >= 1
+    assert "cpu_percent" in node and "mem_percent" in node
+    assert node["store_heap_size"] > 0
+
+
+def test_worker_listing_and_stack_profile(ray_init):
+    import httpx
+
+    url = start_dashboard(port=18266)
+
+    @ray_tpu.remote
+    def long_task():
+        time.sleep(8)
+        return 1
+
+    ref = long_task.remote()
+    time.sleep(1.0)
+    node = _node_hex()
+    workers = httpx.get(f"{url}/api/workers?node={node}", timeout=30).json()
+    assert workers and all("pid" in w for w in workers)
+    leased = [w for w in workers if w["state"] == "LEASED"]
+    assert leased, workers
+    # stack-sample the leased worker: the sleeping task frame must appear
+    prof = httpx.get(
+        f"{url}/api/profile?node={node}&worker={leased[0]['worker_id']}",
+        timeout=60,
+    ).json()
+    assert prof["ok"], prof
+    assert "Thread" in prof["dump"] or "File" in prof["dump"], prof["dump"]
+    # asyncio task await-chain dump
+    prof2 = httpx.get(
+        f"{url}/api/profile?node={node}&worker={leased[0]['worker_id']}"
+        f"&kind=tasks",
+        timeout=60,
+    ).json()
+    assert prof2["ok"], prof2
+    assert ray_tpu.get(ref, timeout=60) == 1
+
+
+def test_profile_unknown_worker_404s(ray_init):
+    import httpx
+
+    url = start_dashboard(port=18266)
+    out = httpx.get(
+        f"{url}/api/profile?node={_node_hex()}&worker={'0' * 28}",
+        timeout=30,
+    )
+    assert out.status_code == 400
+    out = httpx.get(f"{url}/api/workers?node=beef", timeout=30)
+    assert out.status_code == 404
