@@ -690,7 +690,7 @@ class NodeDaemon:
             if strategy.kind == pb.STRATEGY_NODE_AFFINITY and not strategy.soft:
                 return {"infeasible": True,
                         "error": f"node {choice} not available for hard affinity"}
-        if choice is None and not self._feasible_anywhere(spec_res):
+        if choice is None and not self._feasible_anywhere(spec_res, strategy):
             self._note_infeasible(spec_res)
             return {"infeasible": True}
         if self._draining:
@@ -708,6 +708,18 @@ class NodeDaemon:
         self._try_schedule()
         return await pending.future
 
+    @staticmethod
+    def _labels_match(labels: Optional[Dict[str, str]],
+                      selector: Optional[Dict[str, str]]) -> bool:
+        """One definition of label-selector matching for every scheduling
+        decision (choose/grant/spill/feasibility) — reference:
+        node_label_scheduling_policy.h."""
+        if not selector:
+            return True
+        if labels is None:
+            return False
+        return all(labels.get(k) == v for k, v in selector.items())
+
     def _choose_node(self, res: ResourceSet, strategy: pb.SchedulingStrategy,
                      exclude_self: bool = False) -> Optional[str]:
         """Hybrid pack/spread over the gossiped view (hybrid_scheduling_policy.h:50)."""
@@ -721,9 +733,14 @@ class NodeDaemon:
             if exclude_self and nid == my_hex:
                 continue
             info = self.peer_nodes.get(nid)
-            if strategy.label_selector and info is not None:
-                if not all(info.labels.get(k) == v
-                           for k, v in strategy.label_selector.items()):
+            if strategy.label_selector:
+                # reference: node_label_scheduling_policy.h:25 — plain
+                # tasks select nodes by label. SELF is checked against
+                # self.labels (it has no peer_nodes entry); peers with no
+                # info yet are skipped rather than matched blindly.
+                labels = (self.labels if nid == my_hex
+                          else info.labels if info is not None else None)
+                if not self._labels_match(labels, strategy.label_selector):
                     continue
             if res.is_subset_of(avail):
                 total = info.resources if info else self.total_resources
@@ -749,11 +766,17 @@ class NodeDaemon:
                 return my_hex
         return candidates[0][1]
 
-    def _feasible_anywhere(self, res: ResourceSet) -> bool:
-        if res.is_subset_of(self.total_resources):
+    def _feasible_anywhere(self, res: ResourceSet,
+                           strategy: Optional[pb.SchedulingStrategy] = None
+                           ) -> bool:
+        selector = strategy.label_selector if strategy is not None else None
+        if (self._labels_match(self.labels, selector)
+                and res.is_subset_of(self.total_resources)):
             return True
         for nid, info in self.peer_nodes.items():
-            if info.state == pb.NODE_ALIVE and res.is_subset_of(info.resources):
+            if (info.state == pb.NODE_ALIVE
+                    and self._labels_match(info.labels, selector)
+                    and res.is_subset_of(info.resources)):
                 return True
         return False
 
@@ -771,7 +794,9 @@ class NodeDaemon:
         for p in self.pending:
             if p.future.done():
                 continue
-            if p.spec_resources.is_subset_of(self.available):
+            local_ok = self._labels_match(
+                self.labels, p.strategy.label_selector)
+            if local_ok and p.spec_resources.is_subset_of(self.available):
                 self.available = self.available - p.spec_resources
                 spawn(self._grant(p, pg_id=None, bundle_index=-1))
                 continue
@@ -788,10 +813,8 @@ class NodeDaemon:
                     info = self.peer_nodes.get(nid)
                     if info is None or info.state != pb.NODE_ALIVE:
                         continue
-                    if p.strategy.label_selector and not all(
-                        info.labels.get(k) == v
-                        for k, v in p.strategy.label_selector.items()
-                    ):
+                    if not self._labels_match(
+                            info.labels, p.strategy.label_selector):
                         continue
                     if p.spec_resources.is_subset_of(avail):
                         target = nid

@@ -30,6 +30,17 @@ class DeploymentHandle:
         # counts survive replica-set changes and periodic refreshes — wiping
         # them would erase the power-of-two-choices load signal every 2 s
         self._inflight: Dict[bytes, int] = {}
+        # CROSS-handle load signal (reference: pow_2_router.py:27 queue-len
+        # cache): replicas are probed for their true in-flight count in the
+        # background; load = probed qlen + requests THIS handle sent since
+        # the probe (monotonic counter delta avoids double-counting our own
+        # already-reported requests). Without this, two busy handles each
+        # see only their own traffic and can pile onto one replica.
+        self._qlen_cache: Dict[bytes, tuple] = {}  # rid -> (qlen, sent_snap, ts)
+        self._sent: Dict[bytes, int] = {}
+        # rid -> probe start time; stale entries (>10s) are retried, so a
+        # probe lost to a closing core worker can't disable probing forever
+        self._probing: Dict[bytes, float] = {}
         # multiplexing: model id -> replica actor-id that loaded it last
         # (reference: multiplex-aware routing in pow_2_router.py)
         self._model_affinity: Dict[str, bytes] = {}
@@ -70,6 +81,12 @@ class DeploymentHandle:
             self._inflight = {
                 rid: n for rid, n in self._inflight.items() if rid in keep
             }
+            self._qlen_cache = {
+                rid: v for rid, v in self._qlen_cache.items() if rid in keep
+            }
+            self._sent = {
+                rid: n for rid, n in self._sent.items() if rid in keep
+            }
             self._last_refresh = time.monotonic()
 
     async def _refresh_async(self, force: bool = False):
@@ -104,8 +121,52 @@ class DeploymentHandle:
             timeout=30,
         ))
 
+    _QLEN_TTL_S = 1.0
+
+    def _load(self, rid: bytes) -> int:
+        """Replica load estimate: probed queue length + our sends since the
+        probe; falls back to handle-local in-flight when never probed."""
+        cached = self._qlen_cache.get(rid)
+        if cached is None:
+            return self._inflight.get(rid, 0)
+        qlen, sent_snap, _ts = cached
+        return qlen + max(0, self._sent.get(rid, 0) - sent_snap)
+
+    def _maybe_probe(self, rid: bytes, replica) -> None:
+        """Schedule a background queue_len probe when the cache entry is
+        stale — never on the request's critical path."""
+        from ray_tpu._private.core_worker import get_core_worker
+
+        now = time.monotonic()
+        cached = self._qlen_cache.get(rid)
+        if cached is not None and now - cached[2] < self._QLEN_TTL_S:
+            return
+        started = self._probing.get(rid)
+        if started is not None and now - started < 10.0:
+            return
+        self._probing[rid] = now
+
+        async def probe():
+            cw = get_core_worker()
+            try:
+                qlen = await cw.get_async(replica.queue_len.remote(),
+                                          timeout=10)
+                with self._lock:
+                    self._qlen_cache[rid] = (
+                        int(qlen), self._sent.get(rid, 0), time.monotonic())
+            except Exception:  # noqa: BLE001 — replica gone; refresh handles it
+                pass
+            finally:
+                self._probing.pop(rid, None)
+
+        try:
+            get_core_worker().schedule(probe())
+        except Exception:  # noqa: BLE001 — no core worker yet
+            self._probing.pop(rid, None)
+
     def _pick(self) -> tuple:
-        """Power-of-two-choices on local in-flight counts (router.py:556)."""
+        """Power-of-two-choices on probed queue lengths + local deltas
+        (reference: router.py:556 + request_router/pow_2_router.py:27)."""
         self._refresh()
         with self._lock:
             n = len(self._replicas)
@@ -114,14 +175,25 @@ class DeploymentHandle:
                     f"deployment {self.deployment_name!r} has no replicas")
             if n == 1:
                 i = 0
+                candidates = [(self._replicas[0]._actor_id.binary(),
+                               self._replicas[0])]
             else:
                 a, b = random.sample(range(n), 2)
-                load_a = self._inflight.get(self._replicas[a]._actor_id.binary(), 0)
-                load_b = self._inflight.get(self._replicas[b]._actor_id.binary(), 0)
-                i = a if load_a <= load_b else b
+                rid_a = self._replicas[a]._actor_id.binary()
+                rid_b = self._replicas[b]._actor_id.binary()
+                i = a if self._load(rid_a) <= self._load(rid_b) else b
+                candidates = [(rid_a, self._replicas[a]),
+                              (rid_b, self._replicas[b])]
             rid = self._replicas[i]._actor_id.binary()
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
-            return rid, self._replicas[i]
+            self._sent[rid] = self._sent.get(rid, 0) + 1
+            picked = self._replicas[i]
+        # probe BOTH sampled candidates: refreshing only the winner lets a
+        # stale-high entry starve a drained replica forever (it would never
+        # be picked, so never re-probed)
+        for crid, creplica in candidates:
+            self._maybe_probe(crid, creplica)
+        return rid, picked
 
     def _done(self, rid: bytes):
         with self._lock:
@@ -164,6 +236,9 @@ class _ModelRouter:
                 for r in h._replicas:
                     if r._actor_id.binary() == rid:
                         h._inflight[rid] = h._inflight.get(rid, 0) + 1
+                        # sticky sends must stay visible to _load()'s
+                        # probe-delta estimate like pow-2 sends
+                        h._sent[rid] = h._sent.get(rid, 0) + 1
                         return rid, r
         rid, replica = h._pick()
         with h._lock:

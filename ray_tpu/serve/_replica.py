@@ -105,6 +105,11 @@ class ServeReplica:
             "uptime_s": time.time() - self._started,
         }
 
+    async def queue_len(self) -> int:
+        """Current in-flight count for the routers' cross-handle load cache
+        (reference: pow_2_router.py:27 queue-length probes)."""
+        return self._ongoing
+
     async def health(self) -> bool:
         check = getattr(self._callable, "check_health", None)
         if check is not None:

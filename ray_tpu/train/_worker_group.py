@@ -185,8 +185,16 @@ class WorkerGroup:
     def create(self, latest_checkpoint: Optional[Checkpoint] = None):
         from ray_tpu.util.placement_group import placement_group
 
+        # unique name per ATTEMPT: a retry after a failed creation must not
+        # collide with (and bind to) the previous attempt's still-dying
+        # named actor — that surfaced as "actor failed to start:
+        # ray_tpu.kill" under full-suite load. Discovery is by handle (the
+        # workers receive it in start()); the name is only for debugging.
+        import os as _os
+
         self.sync_actor = SyncActor.options(
-            name=f"{self.run_name}-sync", namespace="_train"
+            name=f"{self.run_name}-sync-{_os.urandom(4).hex()}",
+            namespace="_train",
         ).remote()
 
         if self.use_tpu_slices:

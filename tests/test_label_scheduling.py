@@ -1,0 +1,55 @@
+"""Node-label scheduling for plain tasks (reference:
+src/ray/raylet/scheduling/policy/node_label_scheduling_policy.h:25 —
+labels existed for PGs/slices; tasks can now select on them too)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(initialize_head=True, head_resources={"CPU": 2},
+                head_labels={"zone": "a", "tier": "cpu"})
+    c.add_node(resources={"CPU": 2}, labels={"zone": "b", "tier": "accel"})
+    ray_tpu.init(address=c.address)
+    yield c
+    try:
+        ray_tpu.shutdown()
+    finally:
+        c.shutdown()
+
+
+@ray_tpu.remote
+def where():
+    from ray_tpu._private.core_worker import get_core_worker
+
+    return get_core_worker().node_id_hex
+
+
+def test_task_label_selector_targets_matching_node(cluster):
+    import ray_tpu as rt
+
+    zones = {}
+    for zone in ("a", "b"):
+        refs = [
+            where.options(label_selector={"zone": zone}).remote()
+            for _ in range(4)
+        ]
+        zones[zone] = set(rt.get(refs, timeout=120))
+        assert len(zones[zone]) == 1, (
+            f"zone {zone} tasks landed on multiple nodes: {zones[zone]}")
+    assert zones["a"] != zones["b"]
+    # combined selectors match too
+    both = rt.get(
+        where.options(label_selector={"zone": "b", "tier": "accel"}).remote(),
+        timeout=120)
+    assert {both} == zones["b"]
+
+
+def test_unmatchable_selector_reported_infeasible(cluster):
+    ref = where.options(label_selector={"zone": "nowhere"}).remote()
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(ref, timeout=4)  # queued as infeasible, never granted
+    ray_tpu.cancel(ref)

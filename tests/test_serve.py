@@ -290,3 +290,38 @@ def test_serve_multiplex(ray_init):
     all_loads = sum(ray_tpu.get(logs, timeout=30), [])
     assert all_loads.count("m1") == 1, all_loads
     serve.delete("MultiModel")
+
+
+def test_cross_handle_load_signal(ray_init):
+    """Two handles must converge on replica load via the probed queue-len
+    cache — handle-local counts alone would let a fresh handle pile onto
+    the replica another handle already saturated (reference:
+    request_router/pow_2_router.py:27 queue-len cache)."""
+
+    class Slow:
+        def __call__(self, delay):
+            time.sleep(delay)
+            import os
+
+            return os.getpid()
+
+    handle1 = serve.run(serve.Deployment(
+        Slow, "crosshandle", num_replicas=2))
+    warm = {ray_tpu.get(handle1.remote(0.0), timeout=60) for _ in range(16)}
+    assert len(warm) == 2
+    # saturate ONE replica via sticky multiplexed routing through handle1
+    sticky = handle1.options(multiplexed_model_id="pin")
+    busy_pid = ray_tpu.get(sticky.remote(0.0), timeout=60)
+    held = [sticky.remote(2.5) for _ in range(8)]
+    time.sleep(1.2)  # > probe TTL: probes observe the true queue lengths
+    # a FRESH handle (no local history) must skew away from the busy
+    # replica — with only handle-local counts it would split ~50/50
+    handle2 = serve.get_deployment_handle("crosshandle")
+    quick_pids = [
+        ray_tpu.get(handle2.remote(0.0), timeout=60) for _ in range(12)
+    ]
+    ray_tpu.get(held, timeout=120)
+    on_busy = sum(1 for p in quick_pids if p == busy_pid)
+    assert on_busy <= 4, (
+        f"fresh handle sent {on_busy}/12 requests to the saturated replica "
+        f"(busy={busy_pid}, picks={quick_pids})")
