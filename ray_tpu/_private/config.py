@@ -158,6 +158,9 @@ _flag("object_store_full_timeout_s", 30.0, "Total time a create waits for store 
 _flag("memory_monitor_interval_s", 1.0, "Daemon memory-monitor poll period; <= 0 disables OOM worker killing (reference: memory_monitor.h).")
 _flag("memory_usage_threshold", 0.95, "Memory usage fraction above which the daemon kills a worker per interval (reference: RAY_memory_usage_threshold).")
 _flag("memory_limit_bytes", 0, "Memory budget for the OOM monitor; 0 = node total (psutil). When set, usage is measured as the sum of worker-tree RSS against this budget (testable), else system-wide usage fraction.")
+_flag("usage_stats_enabled", True, "Record cluster metadata + library-usage tags in the control store KV and <session>/usage_stats.json (reference: RAY_USAGE_STATS_ENABLED). Zero egress: nothing leaves the cluster; set 0 to disable entirely.")
+_flag("resource_gossip_period_s", 0.5, "Peer-to-peer resource-view gossip period (reference: ray_syncer.h:91 bidi resource-view streams between raylets); 0 disables — the control-store heartbeat piggyback remains the baseline sync.")
+_flag("resource_gossip_fanout", 2, "Random peers contacted per gossip round.")
 _flag("object_store_destructive_eviction", False, "Let a full store DESTROY LRU unpinned objects on create (cache semantics). Default off: full stores backpressure creators and rely on spilling — destroying a sole copy of an owned object is silent data loss (reference: plasma never evicts primary copies).")
 _flag("control_store_persist", False, "Persist control-store state (nodes/actors/PGs/KV/jobs) to a WAL+snapshot in the session dir; a restarted control store recovers it (reference: gcs redis/rocksdb store clients).")
 _flag("control_store_wal_compact_every", 512, "WAL records between snapshot compactions.")

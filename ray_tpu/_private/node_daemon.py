@@ -169,6 +169,10 @@ class NodeDaemon:
         self._creating_actors: Dict[bytes, asyncio.Task] = {}
         # cluster view: node_id hex -> available ResourceSet
         self.cluster_view: Dict[str, ResourceSet] = {}
+        # per-origin gossip versions (reference: ray_syncer versioned
+        # snapshots); my own availability publishes under _my_view_seq
+        self._view_seq: Dict[str, int] = {}
+        self._my_view_seq = 0
         self.peer_nodes: Dict[str, NodeInfo] = {}
         self._peer_clients: Dict[str, RpcClient] = {}
         # placement groups: pg_id -> {"bundles": {idx: ResourceSet}, "state", "free": {idx: ResourceSet}}
@@ -243,6 +247,7 @@ class NodeDaemon:
             spawn(self._spawn_worker(job_id=b"", reserve=False))
         self._oom_kills = 0
         self._tasks.append(spawn(self._memory_monitor_loop()))
+        self._tasks.append(spawn(self._resource_gossip_loop()))
         logger.info(
             "daemon %s up at %s store=%s resources=%s",
             self.node_id.hex()[:8], addr, self.store_name, self.total_resources.to_dict(),
@@ -288,6 +293,93 @@ class NodeDaemon:
         else:
             self.peer_nodes.pop(hexid, None)
             self.cluster_view.pop(hexid, None)
+            self._view_seq.pop(hexid, None)
+
+    # ------------------------------------------------------------------
+    # peer resource-view gossip (reference: src/ray/ray_syncer/
+    # ray_syncer.h:91 — versioned resource-view snapshots exchanged
+    # directly between raylets, decoupling scheduling freshness from the
+    # control store's heartbeat cadence and surviving its brief outages)
+    # ------------------------------------------------------------------
+
+    def _gossip_entries(self) -> dict:
+        """Everything this node knows, keyed by origin: own availability at
+        its own (monotonic) version, plus relayed peer entries."""
+        self._my_view_seq += 1
+        entries = {
+            self.node_id.hex(): [self._my_view_seq, self.available.to_wire()]
+        }
+        for hexid, avail in self.cluster_view.items():
+            if hexid == self.node_id.hex():
+                continue
+            seq = self._view_seq.get(hexid)
+            if seq is not None:
+                entries[hexid] = [seq, avail.to_wire()]
+        return entries
+
+    def _merge_gossip(self, entries: dict) -> bool:
+        """Adopt entries with a newer per-origin version; returns whether
+        anything changed (→ re-run the scheduler)."""
+        changed = False
+        for hexid, (seq, wire) in entries.items():
+            if hexid == self.node_id.hex():
+                continue
+            if hexid not in self.peer_nodes:
+                continue  # unknown/dead origin: membership comes via pubsub
+            if seq > self._view_seq.get(hexid, -1):
+                self._view_seq[hexid] = seq
+                self.cluster_view[hexid] = ResourceSet.from_wire(wire)
+                changed = True
+        return changed
+
+    async def rpc_get_view(self, conn_id: int, payload: dict) -> dict:
+        """This daemon's current cluster resource view + gossip versions
+        (observability/debugging; reference: ray_syncer state dumps)."""
+        return {
+            "self": self.node_id.hex(),
+            "available": self.available.to_wire(),
+            "view": {h: a.to_wire() for h, a in self.cluster_view.items()},
+            "versions": dict(self._view_seq),
+        }
+
+    async def rpc_sync_view(self, conn_id: int, payload: dict) -> dict:
+        """Anti-entropy exchange: merge the sender's entries, reply with
+        ours (reference: RaySyncer bidi snapshot exchange)."""
+        if self._merge_gossip(payload.get("entries", {})):
+            self._try_schedule()
+        return {"entries": self._gossip_entries()}
+
+    async def _resource_gossip_loop(self):
+        period = GLOBAL_CONFIG.get("resource_gossip_period_s")
+        if period <= 0:
+            return
+        import random as _random
+
+        while not self._stopped:
+            await asyncio.sleep(period)
+            peers = [
+                info for hexid, info in self.peer_nodes.items()
+                if info.state == pb.NODE_ALIVE
+                and hexid != self.node_id.hex()
+            ]
+            if not peers:
+                continue
+            fanout = min(len(peers),
+                         GLOBAL_CONFIG.get("resource_gossip_fanout"))
+            for info in _random.sample(peers, fanout):
+                try:
+                    client = self._peer_clients.get(info.address)
+                    if client is None:
+                        client = RpcClient(info.address, name="daemon->peer")
+                        await client.connect()
+                        self._peer_clients[info.address] = client
+                    reply = await client.call(
+                        "sync_view", {"entries": self._gossip_entries()},
+                        timeout=period * 4)
+                    if self._merge_gossip(reply.get("entries", {})):
+                        self._try_schedule()
+                except Exception:  # noqa: BLE001 — peer down; heartbeat prunes
+                    continue
 
     async def _heartbeat_loop(self):
         period = GLOBAL_CONFIG.get("health_check_period_s")

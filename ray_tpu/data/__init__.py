@@ -31,3 +31,8 @@ __all__ = [
     "read_binary_files",
     "read_images",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("data")
+del _rlu

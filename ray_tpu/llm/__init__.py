@@ -222,3 +222,8 @@ __all__ = [
     "build_openai_app",
     "engine_actor_class",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("llm")
+del _rlu

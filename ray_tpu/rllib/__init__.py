@@ -13,3 +13,8 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig
 __all__ = ["DQN", "DQNConfig", "DQNLearner", "EnvRunner", "IMPALA",
            "IMPALAConfig", "PPO", "PPOConfig", "PPOLearner", "ReplayBuffer",
            "VTraceLearner", "compute_gae", "connectors"]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("rllib")
+del _rlu

@@ -174,3 +174,8 @@ __all__ = [
     "shutdown",
     "get_deployment_handle",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("serve")
+del _rlu

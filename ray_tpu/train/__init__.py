@@ -52,3 +52,8 @@ __all__ = [
     "get_context",
     "report",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("train")
+del _rlu

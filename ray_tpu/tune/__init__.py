@@ -260,3 +260,8 @@ __all__ = [
     "report",
     "uniform",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("tune")
+del _rlu

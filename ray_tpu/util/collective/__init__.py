@@ -27,3 +27,8 @@ __all__ = [
     "allreduce", "reduce", "broadcast", "allgather", "reducescatter",
     "send", "recv", "barrier", "Backend", "ReduceOp",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("collective")
+del _rlu

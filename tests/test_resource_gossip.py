@@ -1,0 +1,95 @@
+"""Peer-to-peer resource-view gossip (reference: ray_syncer.h:91 —
+resource views flow between daemons directly, not only through the control
+store's heartbeat piggyback)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _daemon_view(cw, address: str) -> dict:
+    async def call():
+        from ray_tpu.runtime.rpc import RpcClient
+
+        client = RpcClient(address, name="test->daemon")
+        await client.connect()
+        try:
+            return await client.call("get_view", {}, timeout=10)
+        finally:
+            await client.close()
+
+    return cw.run_sync(call())
+
+
+def test_gossip_propagates_availability_between_heartbeats():
+    """With heartbeat view-sync effectively off, every daemon's view of a
+    peer's CHANGING availability must still converge within a couple of
+    gossip rounds."""
+    c = Cluster(initialize_head=True, head_resources={"CPU": 2})
+    n2 = c.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=c.address, system_config={
+        "health_check_period_s": 30.0,
+        "health_check_timeout_s": 300.0,
+        "resource_gossip_period_s": 0.2,
+    })
+    try:
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        reply = cw.run_sync(cw.control.call("get_all_nodes", {}))
+        nodes = {n["node_id"].hex(): n["address"] for n in reply["nodes"]}
+        assert len(nodes) == 2
+        head_hex = cw.node_id_hex
+        (peer_hex, peer_addr), = [
+            (h, a) for h, a in nodes.items() if h != head_hex]
+
+        # occupy the HEAD's CPUs with a pinned actor; only gossip can tell
+        # the PEER daemon about the head's reduced availability
+        @ray_tpu.remote(num_cpus=2)
+        class Hog:
+            def ping(self):
+                return True
+
+        hog = Hog.options(
+            scheduling_strategy=f"node:{head_hex}").remote()
+        assert ray_tpu.get(hog.ping.remote(), timeout=60)
+
+        from ray_tpu._private.protocol import ResourceSet
+
+        def head_cpu(view):
+            wire = view["view"].get(head_hex)
+            if wire is None:
+                return None
+            return ResourceSet.from_wire(wire).get("CPU")
+
+        deadline = time.monotonic() + 8
+        seen = None
+        while time.monotonic() < deadline:
+            view = _daemon_view(cw, peer_addr)
+            seen = head_cpu(view)
+            if seen == 0:
+                break
+            time.sleep(0.2)
+        assert seen == 0, (
+            f"peer view of head never updated via gossip: {seen}")
+        # versions prove it arrived through the gossip plane
+        view = _daemon_view(cw, peer_addr)
+        assert view["versions"].get(head_hex, 0) > 0, view["versions"]
+
+        # and the reverse edge: freeing the head propagates back
+        ray_tpu.kill(hog)
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            view = _daemon_view(cw, peer_addr)
+            if head_cpu(view) == 2:
+                break
+            time.sleep(0.2)
+        assert head_cpu(view) == 2
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            c.shutdown()
