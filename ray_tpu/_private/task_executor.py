@@ -192,15 +192,31 @@ class TaskExecutor:
             if i < n:
                 slow.append((i, asyncio.ensure_future(self.execute(specs[i]))))
                 i += 1
-        exc: Optional[BaseException] = None
         for idx, task in slow:
             try:
                 replies[idx] = await task
-            except BaseException as e:  # noqa: BLE001 — collect, drain rest
-                if exc is None:
-                    exc = e
-        if exc is not None:
-            raise exc
+            except asyncio.CancelledError as e:
+                cur = asyncio.current_task()
+                if cur is not None and cur.cancelling():
+                    # THIS batch is being cancelled: don't abandon
+                    # already-dispatched siblings un-awaited (un-retrieved
+                    # exceptions warn at GC); reap them first
+                    for _, t in slow:
+                        t.cancel()
+                    await asyncio.gather(
+                        *[t for _, t in slow], return_exceptions=True)
+                    raise
+                # a sibling batch's duplicate delivery of this task was
+                # cancelled and the coalesced future propagated it — that is
+                # a per-task outcome, not cancellation of this batch
+                replies[idx] = self._error_reply(specs[idx], e)
+            except BaseException as e:  # noqa: BLE001 — isolate per task
+                # an internal slow-path failure must not invalidate sibling
+                # replies: the caller's feeder would treat the WHOLE batch as
+                # worker-crashed and re-execute already-completed normal
+                # tasks (side effects twice; advisor r3) — convert to a
+                # per-task error reply like the fast group does
+                replies[idx] = self._error_reply(specs[idx], e)
         return replies
 
     async def _fast_prep(self, spec: pb.TaskSpec, group: list,

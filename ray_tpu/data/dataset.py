@@ -712,21 +712,23 @@ class Dataset:
                     out.append(dict(lr))
             return rows_to_block(out)
 
-        scatter = RemoteFunction(_scatter).options(num_returns=k)
         joiner = RemoteFunction(_join_partition)
-        lparts = [scatter.remote(r, k) for r in left]
-        rparts = [scatter.remote(r, k) for r in right]
         if k == 1:
-            lparts = [[p] for p in lparts]
-            rparts = [[p] for p in rparts]
-        new_refs = [
-            joiner.remote(
-                len(lparts),
-                *[lp[i] for lp in lparts],
-                *[rp[i] for rp in rparts],
-            )
-            for i in range(k)
-        ]
+            # num_returns=1 .remote() stores the 1-tuple whole; skip the
+            # scatter and hand the raw block refs to the join task (advisor r3)
+            new_refs = [joiner.remote(len(left), *left, *right)]
+        else:
+            scatter = RemoteFunction(_scatter).options(num_returns=k)
+            lparts = [scatter.remote(r, k) for r in left]
+            rparts = [scatter.remote(r, k) for r in right]
+            new_refs = [
+                joiner.remote(
+                    len(lparts),
+                    *[lp[i] for lp in lparts],
+                    *[rp[i] for rp in rparts],
+                )
+                for i in range(k)
+            ]
         return Dataset(new_refs, [], _refs=new_refs)
 
     # -- global aggregates (reference: Dataset.sum/min/max/mean/std) ----

@@ -229,9 +229,6 @@ class PagedEngine:
 
     def __init__(self, cfg: LlamaConfig, params, ecfg: Optional[EngineConfig] = None,
                  eos_id: Optional[int] = None):
-        import jax
-        import jax.numpy as jnp
-
         self.cfg = cfg
         self.ecfg = ecfg or EngineConfig()
         self.params = params
@@ -240,18 +237,13 @@ class PagedEngine:
         self.bs = e.kv_block_size
         self.max_blocks = -(-e.max_model_len // self.bs)
         B = e.max_num_seqs
-        hd = cfg.head_dim
-        NB = e.num_kv_blocks + 1  # +1: block 0 is the trash block
-        self.kc = jnp.zeros((cfg.n_layers, NB, self.bs, cfg.n_kv_heads, hd),
-                            cfg.dtype)
-        self.vc = jnp.zeros_like(self.kc)
-        self.free_blocks = list(range(1, NB))
         self.tables = np.zeros((B, self.max_blocks), np.int32)
         self.lens = np.zeros((B,), np.int32)
         self.active = np.zeros((B,), bool)
         self.last_tok = np.zeros((B,), np.int32)
         self.temps = np.zeros((B,), np.float32)
         self.slot_req: List[Optional[_Request]] = [None] * B
+        self._alloc_device_state()
         self._decode = _make_decode_step(cfg, e)
         self._prefill = _make_prefill(cfg, e)
         self._pending: "asyncio.Queue[_Request]" = None  # type: ignore
@@ -261,6 +253,43 @@ class PagedEngine:
         self.steps = 0
         self.tokens_out = 0
         self.mid_decode_admissions = 0
+
+    # -- device-state recovery -----------------------------------------
+
+    def _device_state_invalid(self) -> bool:
+        try:
+            return bool(self.kc.is_deleted() or self.vc.is_deleted())
+        except AttributeError:
+            return False
+
+    def _alloc_device_state(self):
+        """Allocate the KV pool + free-block list (block 0 is the trash
+        block). Shared by __init__ and post-failure reset so the pool
+        layout can never diverge between the two."""
+        import jax.numpy as jnp
+
+        cfg, e = self.cfg, self.ecfg
+        NB = e.num_kv_blocks + 1
+        self.kc = jnp.zeros(
+            (cfg.n_layers, NB, self.bs, cfg.n_kv_heads, cfg.head_dim),
+            cfg.dtype)
+        self.vc = jnp.zeros_like(self.kc)
+        self.free_blocks = list(range(1, NB))
+
+    def _reset_device_state(self):
+        """Reallocate the KV pool and clear host bookkeeping. Needed when a
+        jitted step fails AFTER its donated kc/vc inputs were invalidated:
+        every in-flight sequence lost its cache, so the engine must start
+        from an empty pool rather than leave self.kc pointing at deleted
+        buffers (every later request would die with a confusing
+        'buffer donated/deleted' error; advisor r3)."""
+        self._alloc_device_state()
+        self.tables[:] = 0
+        self.lens[:] = 0
+        self.active[:] = False
+        self.last_tok[:] = 0
+        self.temps[:] = 0.0
+        self.slot_req = [None] * self.ecfg.max_num_seqs
 
     # -- admission ------------------------------------------------------
 
@@ -277,27 +306,37 @@ class PagedEngine:
         except StopIteration:
             return False
         blocks = [self.free_blocks.pop() for _ in range(need)]
-        row = np.zeros((self.max_blocks,), np.int32)
-        row[: len(blocks)] = blocks
-        self.tables[slot] = row
-        plen = len(req.prompt)
-        S = max(8, 1 << (plen - 1).bit_length())  # pow-2 bucket
-        import jax
-        import jax.numpy as jnp
+        try:
+            row = np.zeros((self.max_blocks,), np.int32)
+            row[: len(blocks)] = blocks
+            self.tables[slot] = row
+            plen = len(req.prompt)
+            S = max(8, 1 << (plen - 1).bit_length())  # pow-2 bucket
+            import jax
+            import jax.numpy as jnp
 
-        prompt = np.zeros((S,), np.int32)
-        prompt[:plen] = req.prompt
-        logits, self.kc, self.vc = self._prefill(
-            S, self.params, self.kc, self.vc, jnp.asarray(row),
-            jnp.asarray(prompt), jnp.int32(plen))
-        key = jax.random.PRNGKey(req.seed * 1000003 + req.rid)
-        if req.temperature > 0:
-            tok = int(jax.random.categorical(
-                key, logits / max(req.temperature, 1e-6)))
-        else:
-            tok = int(np.argmax(np.asarray(logits)))
-        self._rngs[slot] = np.asarray(
-            jax.random.key_data(jax.random.fold_in(key, 7)), np.uint32)
+            prompt = np.zeros((S,), np.int32)
+            prompt[:plen] = req.prompt
+            logits, self.kc, self.vc = self._prefill(
+                S, self.params, self.kc, self.vc, jnp.asarray(row),
+                jnp.asarray(prompt), jnp.int32(plen))
+            key = jax.random.PRNGKey(req.seed * 1000003 + req.rid)
+            if req.temperature > 0:
+                tok = int(jax.random.categorical(
+                    key, logits / max(req.temperature, 1e-6)))
+            else:
+                tok = int(np.argmax(np.asarray(logits)))
+            self._rngs[slot] = np.asarray(
+                jax.random.key_data(jax.random.fold_in(key, 7)), np.uint32)
+        except BaseException:
+            # any failure between the block pop and slot activation (prefill
+            # trace/compile error, XLA OOM in sampling) must hand the blocks
+            # back, or a few failing requests drain free_blocks and admission
+            # deadlocks; the donated-invalid case is rebuilt by the caller
+            # via _reset_device_state, which recreates free_blocks anyway
+            self.free_blocks.extend(blocks)
+            self.tables[slot] = 0
+            raise
         self.slot_req[slot] = req
         if req.admitted_mid_decode:
             self.mid_decode_admissions += 1
@@ -375,6 +414,13 @@ class PagedEngine:
                 except Exception as e:  # noqa: BLE001 — prefill failed
                     waiting.popleft()
                     req.queue.put_nowait(e)
+                    if self._device_state_invalid():
+                        # prefill donates kc/vc: a failure after donation
+                        # destroyed every in-flight sequence's cache
+                        for r in list(self.slot_req):
+                            if r is not None:
+                                r.queue.put_nowait(e)
+                        self._reset_device_state()
                     continue
                 if not ok:
                     break  # head waits for blocks/slots to free
@@ -407,6 +453,10 @@ class PagedEngine:
                     waiting.popleft().queue.put_nowait(e)
                 while not self._pending.empty():
                     self._pending.get_nowait().queue.put_nowait(e)
+                if self._device_state_invalid():
+                    # rebuild the donated pool so _ensure_loop's restart on
+                    # the next generate_stream starts from a clean engine
+                    self._reset_device_state()
                 raise
             self.steps = step + 1
             self._rngs[:, 1] += 1  # fresh fold per step

@@ -186,6 +186,11 @@ class RpcClient:
         self._closed = False
         self._connected_once = False
         self._reconnect_cbs: list = []
+        # A single per-call timeout must not tear down a socket other calls
+        # share, but a peer that stays connected and never replies (wedged
+        # process, half-open TCP) should eventually get a fresh transport.
+        self._consecutive_timeouts = 0
+        self.timeouts_before_reconnect = 3
 
     def on_reconnect(self, cb: Callable[[], Awaitable[None]]):
         """Register an async callback fired after every re-established
@@ -206,6 +211,7 @@ class RpcClient:
             host, port = self.address.rsplit(":", 1)
             self._reader, self._writer = await asyncio.open_connection(host, int(port))
         self._recv_task = spawn(self._recv_loop())
+        self._consecutive_timeouts = 0  # fresh transport, fresh verdict
         if self._connected_once:
             for cb in self._reconnect_cbs:
                 spawn(cb())
@@ -215,6 +221,10 @@ class RpcClient:
         try:
             while True:
                 frame = await _read_frame(self._reader)
+                # any inbound frame proves the peer is alive — short per-call
+                # timeouts on slow methods must not count toward a reconnect
+                # while other replies are flowing
+                self._consecutive_timeouts = 0
                 kind, req_id, method, payload = frame
                 if kind == _PUSH:
                     cb = self._subs.get(method)
@@ -281,7 +291,9 @@ class RpcClient:
                 if timeout is not None:
                     timer = loop.call_later(
                         timeout, self._expire_pending, req_id)
-                return await fut
+                result = await fut
+                self._consecutive_timeouts = 0
+                return result
             except (
                 ConnectionError,
                 asyncio.TimeoutError,
@@ -297,8 +309,16 @@ class RpcClient:
                     self._pending.pop(req_id, None)
                 # only a CONNECTION-level failure poisons the transport; a
                 # per-call timeout must not tear down a socket other calls
-                # are using
-                if not isinstance(e, asyncio.TimeoutError) and self._writer is not None:
+                # are using — unless timeouts keep coming back-to-back, which
+                # means the peer is wedged and only a reconnect can recover
+                if isinstance(e, asyncio.TimeoutError):
+                    self._consecutive_timeouts += 1
+                    if (self._consecutive_timeouts >= self.timeouts_before_reconnect
+                            and self._writer is not None):
+                        self._consecutive_timeouts = 0
+                        self._writer.close()
+                        self._writer = None
+                elif self._writer is not None:
                     self._writer.close()
                     self._writer = None
                 if attempt < self.retries:

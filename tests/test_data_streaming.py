@@ -142,6 +142,23 @@ def test_hash_join_mixed_numeric_key_types(ray_init):
     assert rows[0]["b"] == 10 and rows[1]["b"] == 20
 
 
+def test_hash_join_single_partition(ray_init):
+    """k==1 join (both sides single-block — the default for from_items under
+    1000 rows): the scatter must be skipped, not wrapped (advisor r3: the
+    num_returns=1 path stored a whole 1-tuple per block and _join_partition
+    crashed indexing dict-of-arrays 'rows')."""
+    left = from_items([{"k": i, "a": i * 2} for i in range(6)])
+    right = from_items([{"k": i % 3, "b": i * 10} for i in range(6)])
+    rows = left.join(right, on="k").take_all()
+    assert len(rows) == 6
+    for r in rows:
+        assert r["a"] == r["k"] * 2
+    # explicit num_partitions=1 hits the same path
+    rows2 = left.join(right, on="k", num_partitions=1).take_all()
+    assert sorted((r["k"], r["b"]) for r in rows2) == sorted(
+        (r["k"], r["b"]) for r in rows)
+
+
 def test_hash_join_left(ray_init):
     left = from_items([{"k": i, "a": i} for i in range(4)], parallelism=2)
     right = from_items([{"k": 0, "b": 7}, {"k": 2, "b": 9}], parallelism=1)
