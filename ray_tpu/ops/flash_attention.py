@@ -247,7 +247,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k):
+def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k,
+                   dkv_block_q=None, dkv_block_k=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -255,6 +256,8 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k):
     kvh = k.shape[1]
     rep = h // kvh
     scale = 1.0 / math.sqrt(hd)
+    dkv_block_q = dkv_block_q or block_q
+    dkv_block_k = dkv_block_k or block_k
 
     # delta[i] = Σ_d dO[i,d]·O[i,d] — cheap rowwise reduce, fused by XLA
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
@@ -291,25 +294,25 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k):
     # dk/dv per *query* head (grid over h), reduced over the GQA group after.
     dkv_kernel = functools.partial(
         _dkv_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, seq_len=s)
+        block_q=dkv_block_q, block_k=dkv_block_k, seq_len=s)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=(
             jax.ShapeDtypeStruct((b, h, s, hd), jnp.float32),
             jax.ShapeDtypeStruct((b, h, s, hd), jnp.float32),
         ),
-        grid=(b, h, s // block_k),
+        grid=(b, h, s // dkv_block_k),
         in_specs=[
             pl.BlockSpec((1, 1, s, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
             pl.BlockSpec((1, 1, s, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, 1, block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
         ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -329,40 +332,221 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k):
 
 
 # ---------------------------------------------------------------------------
+# accumulator-carrying chunk attention (the ring-attention hop primitive)
+# ---------------------------------------------------------------------------
+
+
+def _chunk_xla(q, k, v, o, m, l, causal):
+    """Online-softmax accumulation of one K/V chunk, XLA reference.
+
+    q: (b, h, sq, hd); k/v: (b, kvh, sk, hd); o: (b, h, sq, hd) fp32;
+    m/l: (b, h, sq, 1) fp32 running max / denominator.
+    `causal` masks with LOCAL positions (the diagonal ring hop, sq == sk);
+    off-diagonal hops are either fully unmasked or skipped by the caller.
+    """
+    b, h, sq, hd = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        logits = jnp.where((q_pos >= k_pos)[None, None], logits, NEG_INF)
+    block_max = jnp.max(logits, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, block_max)
+    corr = jnp.exp(m - new_m)
+    p = jnp.exp(logits - new_m)
+    new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    new_o = o * corr + pv
+    return new_o, new_m, new_l
+
+
+def _chunk_kernel(q_ref, k_ref, v_ref, oi_ref, mi_ref, li_ref,
+                  oo_ref, mo_ref, lo_ref, *, causal, scale,
+                  block_q, block_k, sk):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    qb = q_ref[0, 0].astype(jnp.float32) * scale           # (block_q, hd)
+
+    num_kb = (
+        pl.cdiv(qi * block_q + block_q, block_k) if causal
+        else sk // block_k
+    )
+
+    def body(j, carry):
+        o, m, l = carry
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        block_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, block_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        new_o = o * corr + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return new_o, new_m, new_l
+
+    o, m, l = lax.fori_loop(
+        0, jnp.asarray(num_kb, jnp.int32), body,
+        (oi_ref[0, 0], mi_ref[0, 0], li_ref[0, 0]))
+    oo_ref[0, 0] = o
+    mo_ref[0, 0] = m
+    lo_ref[0, 0] = l
+
+
+def _flash_chunk_tpu(q, k, v, o, m, l, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, hd = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    rep = h // kvh
+    scale = 1.0 / math.sqrt(hd)
+
+    kernel = functools.partial(
+        _chunk_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, sk=sk)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, sq, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ),
+        grid=(b, h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((1, 1, sk, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(2 * 2 * b * h * sq * sk * hd * (0.5 if causal else 1.0)),
+            bytes_accessed=(q.size + k.size + v.size + o.size)
+            * q.dtype.itemsize,
+            transcendentals=int(b * h * sq * sk * (0.5 if causal else 1.0)),
+        ),
+        interpret=_INTERPRET,
+    )(q, k, v, o, m, l)
+
+
+def _chunk_supported(q, k, block_q, block_k):
+    sq, hd = q.shape[2], q.shape[3]
+    sk = k.shape[2]
+    return (
+        jax.default_backend() == "tpu"
+        and sq % min(block_q, sq) == 0
+        and sk % min(block_k, sk) == 0
+        and hd % 128 == 0
+        and q.shape[1] % k.shape[1] == 0
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def flash_chunk_bhsd(q, k, v, o, m, l, causal=False,
+                     block_q: int = 512, block_k: int = 512):
+    """One online-softmax accumulation hop with carried (o, m, l) state.
+
+    The ring-attention primitive: forward runs the Pallas kernel (no (sq, sk)
+    materialization); backward recomputes the hop in XLA — with custom_vjp
+    the residuals are just the six inputs, so ring attention training stores
+    O(s·d) per hop instead of the O(s²/sp) probability blocks JAX autodiff
+    would save.
+    """
+    if _chunk_supported(q, k, block_q, block_k):
+        return _flash_chunk_tpu(q, k, v, o, m, l, causal,
+                                min(block_q, q.shape[2]),
+                                min(block_k, k.shape[2]))
+    return _chunk_xla(q, k, v, o, m, l, causal)
+
+
+def _chunk_fwd_rule(q, k, v, o, m, l, causal, block_q, block_k):
+    out = flash_chunk_bhsd(q, k, v, o, m, l, causal, block_q, block_k)
+    return out, (q, k, v, o, m, l)
+
+
+def _chunk_bwd_rule(causal, block_q, block_k, res, g):
+    q, k, v, o, m, l = res
+    _, vjp = jax.vjp(
+        lambda q, k, v, o, m, l: _chunk_xla(q, k, v, o, m, l, causal),
+        q, k, v, o, m, l)
+    return vjp(g)
+
+
+flash_chunk_bhsd.defvjp(_chunk_fwd_rule, _chunk_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
 # custom-vjp wiring (bhsd core)
 # ---------------------------------------------------------------------------
 
 
 def _supported_on_tpu(q, k, block_q, block_k):
+    # NOTE: the dkv kernel's causal start block `(ki*block_k)//block_q` is a
+    # floor and stays correct for ANY block_q/block_k combination (including
+    # the mismatched 512/256 long-context backward blocks), so no
+    # divisibility constraint between the two is required.
     b, h, s, hd = q.shape
     return (
         jax.default_backend() == "tpu"
         and s % block_q == 0
         and s % block_k == 0
-        and block_k % block_q == 0  # causal start-block math in dkv
         and hd % 128 == 0
         and h % k.shape[1] == 0
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bhsd(q, k, v, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k):
     if _supported_on_tpu(q, k, block_q, block_k):
         return _flash_fwd_tpu(q, k, v, causal, block_q, block_k)[0]
     return _xla_attention_bhsd(q, k, v, causal)
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, bwd_block_q,
+                    bwd_block_k):
     if _supported_on_tpu(q, k, block_q, block_k):
         o, lse = _flash_fwd_tpu(q, k, v, causal, block_q, block_k)
         return o, (q, k, v, o, lse)
     return _xla_attention_bhsd(q, k, v, causal), (q, k, v, None, None)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, res, g):
+def _flash_bwd_rule(causal, block_q, block_k, bwd_block_q, bwd_block_k,
+                    res, g):
     q, k, v, o, lse = res
     if o is not None:
-        return _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k)
+        # dq runs at the full forward block size; only dkv (which holds
+        # full-s q AND do in VMEM) needs the smaller long-context blocks
+        return _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k,
+                              dkv_block_q=bwd_block_q,
+                              dkv_block_k=bwd_block_k)
     _, vjp = jax.vjp(
         lambda q, k, v: _xla_attention_bhsd(q, k, v, causal), q, k, v)
     return vjp(g)
@@ -387,7 +571,14 @@ def flash_attention_bhsd(q, k, v, causal: bool = True,
     block_k = min(block_k, s)
     if block_k % block_q != 0:
         block_q = block_k = min(block_q, block_k)
-    return _flash_bhsd(q, k, v, causal, block_q, block_k)
+    # the dkv kernel holds full-s q/do in VMEM (double-buffered) plus
+    # (block_q, block_k) fp32 temps; 512-blocks overflow the 16MB scoped-vmem
+    # limit at s=8192 — shrink only the BACKWARD blocks there, the forward
+    # kernel stays at full MXU-friendly 512
+    bwd_block_q = block_q
+    bwd_block_k = min(block_k, 256) if s >= 8192 else block_k
+    return _flash_bhsd(q, k, v, causal, block_q, block_k, bwd_block_q,
+                       bwd_block_k)
 
 
 def flash_attention(q, k, v, causal: bool = True,

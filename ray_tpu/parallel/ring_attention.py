@@ -9,15 +9,24 @@ its ring neighbor with `lax.ppermute` — on TPU the permute rides neighboring
 ICI links, and XLA overlaps the collective with the block compute. Peak memory
 is O(seq/sp_size) per device, which is what makes million-token contexts fit.
 
-Causality is handled with global position masks: block (i→j) is fully
-computed, fully masked, or triangularly masked depending on the ring offset.
+Each hop is classified by ring offset:
+  * FULL — the K/V block is entirely in this shard's causal past: the hop
+    runs the Pallas flash-chunk kernel unmasked (ops.flash_attention
+    .flash_chunk_bhsd — no (sq, sk) score materialization on TPU);
+  * DIAG — the resident block: the kernel runs with the local causal mask;
+  * SKIP — entirely in the future: the hop is skipped outright (no FLOPs,
+    forward or backward), which halves causal ring-attention work vs.
+    computing fully-masked blocks.
+The chunk primitive's custom VJP recomputes the hop in the backward pass, so
+training STORES O(s·d) residuals per hop rather than the O((s/sp)²)
+probability blocks plain autodiff would save; the recompute itself is XLA
+and materializes one hop's (s/sp, s/sp) scores transiently during backward
+(a Pallas hop backward is the remaining step to remove that transient).
 """
 
 from __future__ import annotations
 
 import math
-from functools import partial
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -28,38 +37,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ray_tpu.parallel.mesh import BATCH_AXES
 
 
-def _block_accum(q, k, v, o, m, l, q_off, k_off, causal, scale):
-    """One blockwise attention accumulation step (online softmax).
-
-    q: (b, sq, h, hd)   k/v: (b, sk, kvh, hd)
-    o: (b, sq, h, hd) fp32; m/l: (b, h, sq) fp32 running max / denominator.
-    """
-    b, sq, h, hd = q.shape
-    kvh = k.shape[2]
-    if kvh != h:
-        rep = h // kvh
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        sk = k.shape[1]
-        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        k_pos = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        mask = q_pos >= k_pos
-        logits = jnp.where(mask[None, None], logits, -1e30)
-    block_max = jnp.max(logits, axis=-1)                 # (b, h, sq)
-    new_m = jnp.maximum(m, block_max)
-    correction = jnp.exp(m - new_m)                      # (b, h, sq)
-    p = jnp.exp(logits - new_m[..., None])               # (b, h, sq, sk)
-    new_l = l * correction + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-                    preferred_element_type=jnp.float32)
-    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
-    return new_o, new_m, new_l
-
-
 def ring_attention_sharded(
     q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, causal: bool = True
 ) -> jax.Array:
@@ -68,14 +45,19 @@ def ring_attention_sharded(
     q/k/v: (batch, seq, heads, head_dim) GLOBAL shapes; seq is sharded.
     Returns same shape/dtype as q.
     """
+    from ray_tpu.ops.flash_attention import flash_chunk_bhsd
+
     spec = P(BATCH_AXES, "sp", None, None)
     sp_size = mesh.shape["sp"]
-    scale = 1.0 / math.sqrt(q.shape[-1])
     out_dtype = q.dtype
 
     def local_fn(q, k, v):
         idx = lax.axis_index("sp")
-        b, sq, h, hd = q.shape
+        # bhsd layout into the kernel: head_dim rides the lane dimension
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        b, h, sq, hd = q.shape
         # fresh accumulators must carry the same varying-manual-axes type as
         # the shard_map inputs or the fori carry types mismatch
         varying = tuple(a for a in ("dp", "fsdp", "sp") if a in mesh.shape)
@@ -85,19 +67,34 @@ def ring_attention_sharded(
         def _vary(x):
             return to_varying(x, varying)
 
-        o = _vary(jnp.zeros((b, sq, h, hd), jnp.float32))
-        m = _vary(jnp.full((b, h, sq), -jnp.inf, jnp.float32))
-        l = _vary(jnp.zeros((b, h, sq), jnp.float32))
+        o = _vary(jnp.zeros((b, h, sq, hd), jnp.float32))
+        m = _vary(jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32))
+        l = _vary(jnp.zeros((b, h, sq, 1), jnp.float32))
         perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+
+        def hop_full(args):
+            o, m, l, k, v = args
+            return flash_chunk_bhsd(q, k, v, o, m, l, False)
+
+        def hop_diag(args):
+            o, m, l, k, v = args
+            return flash_chunk_bhsd(q, k, v, o, m, l, True)
+
+        def hop_skip(args):
+            o, m, l, _, _ = args
+            return o, m, l
 
         def step(i, carry):
             o, m, l, k, v = carry
             src = (idx - i) % sp_size  # ring position this K/V block came from
-            o, m, l = _block_accum(
-                q, k, v, o, m, l,
-                q_off=idx * sq, k_off=src * k.shape[1],
-                causal=causal, scale=scale,
-            )
+            if causal:
+                # 0 = FULL (block in the past), 1 = DIAG (resident block),
+                # 2 = SKIP (block in the future — no work at all)
+                branch = jnp.int32(2) - (src <= idx) - (src < idx)
+                o, m, l = lax.switch(
+                    branch, (hop_full, hop_diag, hop_skip), (o, m, l, k, v))
+            else:
+                o, m, l = hop_full((o, m, l, k, v))
             # rotate K/V around the ring (skipped after the final block)
             k, v = lax.cond(
                 i < sp_size - 1,
@@ -111,7 +108,10 @@ def ring_attention_sharded(
             return o, m, l, k, v
 
         o, m, l, _, _ = lax.fori_loop(0, sp_size, step, (o, m, l, k, v))
-        return (o / l.transpose(0, 2, 1)[..., None]).astype(out_dtype)
+        # SKIP hops leave masked rows' l at 0 only if a query attends to
+        # nothing — impossible under causal (the diagonal always contributes)
+        out = (o / l).astype(out_dtype)
+        return out.transpose(0, 2, 1, 3)
 
     return shard_map(
         local_fn, mesh=mesh,
