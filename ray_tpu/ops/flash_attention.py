@@ -504,6 +504,131 @@ flash_chunk_bhsd.defvjp(_chunk_fwd_rule, _chunk_bwd_rule)
 
 
 # ---------------------------------------------------------------------------
+# ring hop backward (used by ring attention's ring-level custom VJP)
+# ---------------------------------------------------------------------------
+
+
+def _hop_bwd_xla(q, k, v, g, lse, delta, causal):
+    """FA2-style backward for one ring hop, XLA fallback.
+
+    q/g: (b, h, sq, hd); k/v: (b, kvh, sk, hd); lse/delta: (b, h, sq, 1)
+    fp32 — the GLOBAL logsumexp / dO·O row sums saved by the ring forward.
+    Returns (dq, dk, dv) in fp32 with dk/dv at kvh heads.
+    """
+    b, h, sq, hd = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    rep = h // kvh
+    kr, vr = k, v
+    if rep != 1:
+        kr = jnp.repeat(k, rep, axis=1)
+        vr = jnp.repeat(v, rep, axis=1)
+    scale = 1.0 / math.sqrt(hd)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kr,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    p = jnp.exp(s - lse)
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, vr.astype(jnp.float32))
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kr.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32)) * scale
+    if rep != 1:
+        dk = dk.reshape(b, kvh, rep, sk, hd).sum(axis=2)
+        dv = dv.reshape(b, kvh, rep, sk, hd).sum(axis=2)
+    return dq, dk, dv
+
+
+def _hop_bwd_tpu(q, k, v, g, lse, delta, causal, block_q, block_k,
+                 dkv_block_q=None, dkv_block_k=None):
+    """Pallas hop backward: the dq/dkv kernels against one K/V block with
+    externally supplied (global) lse/delta — no (sq, sk) materialization."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, hd = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    rep = h // kvh
+    scale = 1.0 / math.sqrt(hd)
+    dkv_block_q = dkv_block_q or block_q
+    dkv_block_k = dkv_block_k or block_k
+
+    dq_kernel = functools.partial(
+        _dq_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, seq_len=sk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, hd), jnp.float32),
+        grid=(b, h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((1, 1, sk, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, hd),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_INTERPRET,
+    )(q, k, v, g, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel, causal=causal, scale=scale,
+        block_q=dkv_block_q, block_k=dkv_block_k, seq_len=sq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, sk, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk, hd), jnp.float32),
+        ),
+        grid=(b, h, sk // dkv_block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, sq, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_INTERPRET,
+    )(q, k, v, g, lse, delta)
+
+    if rep != 1:
+        dk = dk.reshape(b, kvh, rep, sk, hd).sum(axis=2)
+        dv = dv.reshape(b, kvh, rep, sk, hd).sum(axis=2)
+    return dq, dk, dv
+
+
+def flash_hop_bwd(q, k, v, g, lse, delta, causal,
+                  block_q: int = 512, block_k: int = 512):
+    """Backward of one ring-attention hop given global lse/delta rows."""
+    bq = min(block_q, q.shape[2])
+    bk = min(block_k, k.shape[2])
+    if _chunk_supported(q, k, bq, bk):
+        # same scoped-vmem guard as flash_attention_bhsd: the dkv kernel
+        # holds full-s q/do in VMEM, so its k tile shrinks at long per-shard
+        # sequence (the exact regime ring attention targets)
+        dkv_bk = min(bk, 256) if q.shape[2] >= 8192 else bk
+        return _hop_bwd_tpu(q, k, v, g, lse, delta, causal, bq, bk,
+                            dkv_block_q=bq, dkv_block_k=dkv_bk)
+    return _hop_bwd_xla(q, k, v, g, lse, delta, causal)
+
+
+# ---------------------------------------------------------------------------
 # custom-vjp wiring (bhsd core)
 # ---------------------------------------------------------------------------
 

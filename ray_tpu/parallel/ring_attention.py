@@ -17,15 +17,19 @@ Each hop is classified by ring offset:
   * SKIP — entirely in the future: the hop is skipped outright (no FLOPs,
     forward or backward), which halves causal ring-attention work vs.
     computing fully-masked blocks.
-The chunk primitive's custom VJP recomputes the hop in the backward pass, so
-training STORES O(s·d) residuals per hop rather than the O((s/sp)²)
-probability blocks plain autodiff would save; the recompute itself is XLA
-and materializes one hop's (s/sp, s/sp) scores transiently during backward
-(a Pallas hop backward is the remaining step to remove that transient).
+
+Differentiation is a RING-LEVEL custom VJP: the forward saves only
+(q, k, v, out, lse) per shard — O(s·d), never the O((s/sp)²) score blocks —
+and the backward runs a second ring pass in which dk/dv accumulators rotate
+together with their K/V blocks, each hop computed by the Pallas dq/dkv
+kernels against the globally-saved lse/delta rows
+(ops.flash_attention.flash_hop_bwd). No (sq, sk) tensor exists in either
+direction on TPU.
 """
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -37,6 +41,149 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ray_tpu.parallel.mesh import BATCH_AXES
 
 
+def _vary(x, varying):
+    from ray_tpu.parallel.mesh import to_varying
+
+    return to_varying(x, varying)
+
+
+def _ring_perm(sp_size):
+    return [(j, (j + 1) % sp_size) for j in range(sp_size)]
+
+
+def _dispatch_hop(causal, idx, i, sp_size, hop_full, hop_diag, hop_skip,
+                  args):
+    """The correctness-critical hop classification, shared by forward and
+    backward: 0 = FULL (K/V block in this shard's causal past), 1 = DIAG
+    (resident block, local causal mask), 2 = SKIP (future block — no work)."""
+    src = (idx - i) % sp_size  # ring position this K/V block came from
+    if not causal:
+        return hop_full(args)
+    branch = jnp.int32(2) - (src <= idx) - (src < idx)
+    return lax.switch(branch, (hop_full, hop_diag, hop_skip), args)
+
+
+def _ring_fwd_impl(q, k, v, static):
+    """Forward ring loop. q: (b, h, sq, hd); k/v: (b, kvh, sk, hd) local
+    shards inside shard_map. Returns (out, lse)."""
+    from ray_tpu.ops.flash_attention import flash_chunk_bhsd
+
+    sp_size, causal, varying = static
+    idx = lax.axis_index("sp")
+    b, h, sq, hd = q.shape
+    out_dtype = q.dtype
+
+    o = _vary(jnp.zeros((b, h, sq, hd), jnp.float32), varying)
+    m = _vary(jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32), varying)
+    l = _vary(jnp.zeros((b, h, sq, 1), jnp.float32), varying)
+    perm = _ring_perm(sp_size)
+
+    def hop_full(args):
+        o, m, l, k, v = args
+        return flash_chunk_bhsd(q, k, v, o, m, l, False)
+
+    def hop_diag(args):
+        o, m, l, k, v = args
+        return flash_chunk_bhsd(q, k, v, o, m, l, True)
+
+    def hop_skip(args):
+        o, m, l, _, _ = args
+        return o, m, l
+
+    def step(i, carry):
+        o, m, l, k, v = carry
+        o, m, l = _dispatch_hop(causal, idx, i, sp_size,
+                                hop_full, hop_diag, hop_skip, (o, m, l, k, v))
+        # rotate K/V around the ring (skipped after the final block)
+        k, v = lax.cond(
+            i < sp_size - 1,
+            lambda kv: (
+                lax.ppermute(kv[0], "sp", perm),
+                lax.ppermute(kv[1], "sp", perm),
+            ),
+            lambda kv: kv,
+            (k, v),
+        )
+        return o, m, l, k, v
+
+    o, m, l, _, _ = lax.fori_loop(0, sp_size, step, (o, m, l, k, v))
+    # under causal the diagonal always contributes, so l > 0 on every row
+    out = (o / l).astype(out_dtype)
+    lse = m + jnp.log(l)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ring_core(q, k, v, static):
+    out, _ = _ring_fwd_impl(q, k, v, static)
+    return out
+
+
+def _ring_core_fwd(q, k, v, static):
+    out, lse = _ring_fwd_impl(q, k, v, static)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_core_bwd(static, res, g):
+    """Second ring pass: dk/dv accumulators travel WITH their K/V blocks
+    (rotated every step, so after sp_size hops each block's gradient lands
+    back on its home shard); dq accumulates locally."""
+    from ray_tpu.ops.flash_attention import flash_hop_bwd
+
+    sp_size, causal, varying = static
+    q, k, v, out, lse = res
+    idx = lax.axis_index("sp")
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq0 = _vary(jnp.zeros(q.shape, jnp.float32), varying)
+    dk0 = _vary(jnp.zeros(k.shape, jnp.float32), varying)
+    dv0 = _vary(jnp.zeros(v.shape, jnp.float32), varying)
+    perm = _ring_perm(sp_size)
+
+    def hop(causal_flag):
+        def run(args):
+            dq, dk, dv, k, v = args
+            dq_p, dk_p, dv_p = flash_hop_bwd(
+                q, k, v, g, lse, delta, causal_flag)
+            return dq + dq_p, dk + dk_p, dv + dv_p
+        return run
+
+    hop_full, hop_diag = hop(False), hop(True)
+
+    def hop_skip(args):
+        dq, dk, dv, _, _ = args
+        return dq, dk, dv
+
+    def step(i, carry):
+        dq, dk, dv, k, v = carry
+        dq, dk, dv = _dispatch_hop(causal, idx, i, sp_size,
+                                   hop_full, hop_diag, hop_skip,
+                                   (dq, dk, dv, k, v))
+        # dk/dv rotate every step (including the last — after sp_size
+        # rotations each block's gradient is home again); k/v are never
+        # read after the final hop, so their last rotation is skipped
+        dk = lax.ppermute(dk, "sp", perm)
+        dv = lax.ppermute(dv, "sp", perm)
+        k, v = lax.cond(
+            i < sp_size - 1,
+            lambda kv: (
+                lax.ppermute(kv[0], "sp", perm),
+                lax.ppermute(kv[1], "sp", perm),
+            ),
+            lambda kv: kv,
+            (k, v),
+        )
+        return dq, dk, dv, k, v
+
+    dq, dk, dv, _, _ = lax.fori_loop(
+        0, sp_size, step, (dq0, dk0, dv0, k, v))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
 def ring_attention_sharded(
     q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, causal: bool = True
 ) -> jax.Array:
@@ -45,72 +192,16 @@ def ring_attention_sharded(
     q/k/v: (batch, seq, heads, head_dim) GLOBAL shapes; seq is sharded.
     Returns same shape/dtype as q.
     """
-    from ray_tpu.ops.flash_attention import flash_chunk_bhsd
-
     spec = P(BATCH_AXES, "sp", None, None)
     sp_size = mesh.shape["sp"]
-    out_dtype = q.dtype
+    varying = tuple(a for a in ("dp", "fsdp", "sp") if a in mesh.shape)
+    static = (sp_size, causal, varying)
 
     def local_fn(q, k, v):
-        idx = lax.axis_index("sp")
-        # bhsd layout into the kernel: head_dim rides the lane dimension
-        q = q.transpose(0, 2, 1, 3)
-        k = k.transpose(0, 2, 1, 3)
-        v = v.transpose(0, 2, 1, 3)
-        b, h, sq, hd = q.shape
-        # fresh accumulators must carry the same varying-manual-axes type as
-        # the shard_map inputs or the fori carry types mismatch
-        varying = tuple(a for a in ("dp", "fsdp", "sp") if a in mesh.shape)
-
-        from ray_tpu.parallel.mesh import to_varying
-
-        def _vary(x):
-            return to_varying(x, varying)
-
-        o = _vary(jnp.zeros((b, h, sq, hd), jnp.float32))
-        m = _vary(jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32))
-        l = _vary(jnp.zeros((b, h, sq, 1), jnp.float32))
-        perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
-
-        def hop_full(args):
-            o, m, l, k, v = args
-            return flash_chunk_bhsd(q, k, v, o, m, l, False)
-
-        def hop_diag(args):
-            o, m, l, k, v = args
-            return flash_chunk_bhsd(q, k, v, o, m, l, True)
-
-        def hop_skip(args):
-            o, m, l, _, _ = args
-            return o, m, l
-
-        def step(i, carry):
-            o, m, l, k, v = carry
-            src = (idx - i) % sp_size  # ring position this K/V block came from
-            if causal:
-                # 0 = FULL (block in the past), 1 = DIAG (resident block),
-                # 2 = SKIP (block in the future — no work at all)
-                branch = jnp.int32(2) - (src <= idx) - (src < idx)
-                o, m, l = lax.switch(
-                    branch, (hop_full, hop_diag, hop_skip), (o, m, l, k, v))
-            else:
-                o, m, l = hop_full((o, m, l, k, v))
-            # rotate K/V around the ring (skipped after the final block)
-            k, v = lax.cond(
-                i < sp_size - 1,
-                lambda kv: (
-                    lax.ppermute(kv[0], "sp", perm),
-                    lax.ppermute(kv[1], "sp", perm),
-                ),
-                lambda kv: kv,
-                (k, v),
-            )
-            return o, m, l, k, v
-
-        o, m, l, _, _ = lax.fori_loop(0, sp_size, step, (o, m, l, k, v))
-        # SKIP hops leave masked rows' l at 0 only if a query attends to
-        # nothing — impossible under causal (the diagonal always contributes)
-        out = (o / l).astype(out_dtype)
+        # bhsd layout into the kernels: head_dim rides the lane dimension
+        out = _ring_core(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), static)
         return out.transpose(0, 2, 1, 3)
 
     return shard_map(
