@@ -569,8 +569,18 @@ class TaskExecutor:
                 raise RuntimeError("actor instance not initialized")
             if tid in self._cancelled:
                 raise TaskCancelledError(f"actor task {spec.method_name} was cancelled")
-            method = getattr(self.actor_instance, spec.method_name)
             args, kwargs = await self._resolve_args(spec.args)
+            if spec.method_name == "__rt_call__":
+                # system method (reference: actor.__ray_call__): args[0] is
+                # a function executed as fn(actor_instance, *rest) inside
+                # the actor process — the compiled-DAG executor loop rides
+                # this without requiring methods on the user's class
+                import functools as _ft
+
+                method = _ft.partial(args[0], self.actor_instance)
+                args = tuple(args[1:])
+            else:
+                method = getattr(self.actor_instance, spec.method_name)
             self.cw.current_task_id = spec.task_id
             group = spec.concurrency_group
             declared = (self.actor_spec.concurrency_groups or {}

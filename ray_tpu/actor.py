@@ -133,7 +133,7 @@ class ActorHandle:
         return result[0] if num_returns == 1 else result
 
     def __getattr__(self, name: str):
-        if name.startswith("_"):
+        if name.startswith("_") and name != "__rt_call__":
             raise AttributeError(name)
         meta = self._method_meta.get(name)
         if isinstance(meta, int):  # legacy form: bare num_returns

@@ -25,21 +25,40 @@ cross-failure-domain stages)."""
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Dict, List
+
+_node_seq = itertools.count()
 
 
 class DAGNode:
-    """Base: a recipe for one task submission."""
+    """Base: a recipe for one task submission.
+
+    Nodes record their authoring order (`_created`): a compiled DAG executes
+    each actor's steps in authoring order, which is how schedules like 1F1B
+    are expressed — bind the ops in the per-actor order you want them to run
+    (the reference generates per-actor schedules the same way,
+    compiled_dag_node.py _build_execution_schedule)."""
+
+    def __new__(cls, *a, **k):
+        obj = super().__new__(cls)
+        obj._created = next(_node_seq)
+        return obj
 
     def execute(self, *args, **kwargs):
         """Submit the whole reachable graph once; returns ObjectRef(s)
         (reference: dag_node.py:369)."""
         return _execute_graph(self, args, kwargs)
 
-    def experimental_compile(self, max_in_flight: int = 8) -> "CompiledDAG":
-        """Freeze the topology for repeated pipelined execution
-        (reference: dag_node.py:283 → compiled_dag_node.py:813)."""
-        return CompiledDAG(self, max_in_flight=max_in_flight)
+    def experimental_compile(self, max_in_flight: int = 8,
+                             slot_size: int = 1 << 20):
+        """Freeze the topology for repeated pipelined execution through
+        preallocated shm channels + per-actor executor loops (reference:
+        dag_node.py:283 → compiled_dag_node.py:813). See dag/_compiled.py."""
+        from ray_tpu.dag._compiled import CompiledDAG as _RealCompiledDAG
+
+        return _RealCompiledDAG(self, max_in_flight=max_in_flight,
+                                slot_size=slot_size)
 
     # -- authoring sugar -------------------------------------------------
 
@@ -149,41 +168,12 @@ def _execute_graph(root: DAGNode, args: tuple, kwargs: dict):
     return _resolve(root, memo, input_value)
 
 
-class CompiledDAG:
-    """Repeat-execution facade over a frozen DAG (reference:
-    compiled_dag_node.py:813). Executions pipeline: every stage's task is
-    submitted eagerly with chained refs, and up to `max_in_flight`
-    executions run concurrently across the stage actors before execute()
-    applies backpressure (the reference bounds in-flight executions the
-    same way via its channel buffers)."""
-
-    def __init__(self, root: DAGNode, max_in_flight: int = 8):
-        self.root = root
-        self.max_in_flight = max_in_flight
-        self._in_flight: List[Any] = []
-        self._torn_down = False
-
-    def execute(self, *args, **kwargs):
-        import ray_tpu
-
-        if self._torn_down:
-            raise RuntimeError("CompiledDAG is torn down")
-        while len(self._in_flight) >= self.max_in_flight:
-            oldest = self._in_flight.pop(0)
-            refs = oldest if isinstance(oldest, list) else [oldest]
-            ray_tpu.wait(refs, num_returns=len(refs), timeout=300)
-        out = _execute_graph(self.root, args, kwargs)
-        self._in_flight.append(out)
-        return out
-
-    def teardown(self):
-        self._torn_down = True
-        self._in_flight.clear()
-
+from ray_tpu.dag._compiled import CompiledDAG, CompiledDAGRef  # noqa: E402
 
 __all__ = [
     "ClassMethodNode",
     "CompiledDAG",
+    "CompiledDAGRef",
     "DAGNode",
     "FunctionNode",
     "InputNode",

@@ -1,0 +1,541 @@
+"""Compiled DAG execution: static schedules + preallocated shm channels.
+
+Reference surface: python/ray/dag/compiled_dag_node.py:813 (CompiledDAG —
+static actor schedules, per-actor executors, preallocated channels),
+experimental/channel/shared_memory_channel.py (the channel plane),
+collective_node.py:23 (_CollectiveOperation in graphs).
+
+Redesign for this framework:
+  * compile() resolves the DAG ONCE into per-actor static schedules
+    (topologically ordered steps), with one SPSC shm channel per cross-actor
+    edge (ray_tpu/experimental/channel.py — native atomics, no RPC).
+  * each actor runs an executor LOOP delivered through the `__rt_call__`
+    system method: read input channels, run the bound method in-process,
+    write output channels. A graph hop costs serialize + memcpy + atomic
+    publish — the task scheduler, lease plane, and reply plumbing are out
+    of the hot path entirely.
+  * same-actor edges pass values in-process (no channel, no copy).
+  * channel capacity is the pipeline depth: execute() keeps submitting
+    while channels have room, so consecutive executions overlap across
+    stage actors (aDAG pipelining); a full entry channel is backpressure.
+  * collective nodes (dag/collective.py) compile into reduce+broadcast
+    steps over the same channel plane (host tensors; device tensors take
+    the XLA collective path inside jitted steps instead).
+
+Constraints (v1, matching the reference's single-node channel mode): all
+actors in one compiled DAG must live on the same node as the driver (the
+channel plane is the node's shm segment); methods must be synchronous.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+_STOP = "__rt_dag_stop__"
+_dag_counter = itertools.count(1)
+
+
+@dataclass
+class _Step:
+    idx: int
+    method: str = ""
+    # each source: ("chan", edge) | ("local", idx) | ("input",) |
+    #              ("input_attr", key) | ("const", value)
+    arg_sources: List[Tuple] = field(default_factory=list)
+    kwarg_sources: Dict[str, Tuple] = field(default_factory=dict)
+    out_edges: List[str] = field(default_factory=list)
+    # collective steps ("reduce root" / "leaf"):
+    kind: str = "method"          # "method" | "coll_root" | "coll_leaf"
+    coll_op: str = "sum"
+    coll_in_edges: List[str] = field(default_factory=list)   # root: leaf→root
+    coll_out_edges: List[str] = field(default_factory=list)  # root: root→leaf
+    coll_src: Optional[Tuple] = None   # this actor's own contribution source
+
+
+@dataclass
+class _ActorPlan:
+    dag_id: str
+    store_name: str
+    steps: List[_Step] = field(default_factory=list)
+    nslots: int = 8
+    slot_size: int = 1 << 20
+
+
+def _reduce_vals(op: str, vals: List[Any]):
+    import numpy as np
+
+    if op == "sum":
+        out = vals[0]
+        for v in vals[1:]:
+            out = out + v
+        return out
+    if op == "max":
+        return np.maximum.reduce(vals)
+    if op == "min":
+        return np.minimum.reduce(vals)
+    raise ValueError(f"unknown collective op {op!r}")
+
+
+def _open_channels(plan: _ActorPlan, edges: List[str], creator: bool):
+    from ray_tpu._private.core_worker import get_core_worker
+    from ray_tpu.experimental.channel import ShmChannel, channel_object_id
+
+    store = get_core_worker().store
+    if store is None:
+        raise RuntimeError("compiled DAGs need a node-local shm store")
+    chans = {}
+    for e in edges:
+        chans[e] = ShmChannel(
+            store, channel_object_id(plan.dag_id, e), creator=creator,
+            nslots=plan.nslots, slot_size=plan.slot_size)
+    return chans
+
+
+def _plan_edges(plan: _ActorPlan) -> Tuple[List[str], List[str]]:
+    ins, outs = [], []
+    for s in plan.steps:
+        for src in list(s.arg_sources) + list(s.kwarg_sources.values()):
+            if src[0] == "chan":
+                ins.append(src[1])
+            if src[0] in ("input", "input_attr"):
+                ins.append(f"driver->{s.idx}")
+        if s.coll_src is not None and s.coll_src[0] == "chan":
+            ins.append(s.coll_src[1])
+        ins.extend(s.coll_in_edges)
+        outs.extend(s.out_edges)
+        outs.extend(s.coll_out_edges)
+    # dedupe, stable
+    return list(dict.fromkeys(ins)), list(dict.fromkeys(outs))
+
+
+@dataclass
+class _DagError:
+    """An execution-scoped error flowing through the channel plane: poisons
+    one execution's downstream values, not the pipeline."""
+
+    pickled: bytes
+
+    def raise_(self):
+        import pickle
+
+        raise pickle.loads(self.pickled)
+
+
+def _write_val(chan, value):
+    """Channel write that degrades an oversized payload into a (small)
+    _DagError instead of killing the executor loop."""
+    try:
+        chan.write(value, timeout=None)
+    except ValueError as exc:
+        import pickle
+
+        chan.write(_DagError(pickle.dumps(exc)), timeout=None)
+
+
+def _actor_loop(instance, plan: _ActorPlan):
+    """Runs INSIDE the actor via __rt_call__ for the compiled DAG's
+    lifetime. Returns per-loop stats at teardown."""
+    in_edges, out_edges = _plan_edges(plan)
+    in_chans = _open_channels(plan, in_edges, creator=False)
+    out_chans = _open_channels(plan, out_edges, creator=False)
+    executions = 0
+    t_busy = 0.0
+
+    def read(edge):
+        return in_chans[edge].read(timeout=None)
+
+    try:
+        while True:
+            local_vals: Dict[int, Any] = {}
+            chan_cache: Dict[str, Any] = {}
+            stop = False
+
+            def fetch(src, step_idx):
+                nonlocal stop
+                kind = src[0]
+                if kind == "const":
+                    return src[1]
+                if kind == "local":
+                    return local_vals[src[1]]
+                if kind == "chan":
+                    edge = src[1]
+                    if edge not in chan_cache:
+                        chan_cache[edge] = read(edge)
+                    v = chan_cache[edge]
+                    if isinstance(v, str) and v == _STOP:
+                        stop = True
+                    return v
+                if kind in ("input", "input_attr"):
+                    edge = f"driver->{step_idx}"
+                    if edge not in chan_cache:
+                        chan_cache[edge] = read(edge)
+                    v = chan_cache[edge]
+                    if isinstance(v, str) and v == _STOP:
+                        stop = True
+                        return v
+                    if kind == "input_attr":
+                        return v[src[1]] if isinstance(v, dict) else getattr(v, src[1])
+                    return v
+                raise ValueError(f"bad source {src}")
+
+            for step in plan.steps:
+                if step.kind == "method":
+                    args = [fetch(s, step.idx) for s in step.arg_sources]
+                    if stop:
+                        break
+                    kwargs = {k: fetch(s, step.idx)
+                              for k, s in step.kwarg_sources.items()}
+                    if stop:
+                        break
+                    poisoned = next(
+                        (a for a in list(args) + list(kwargs.values())
+                         if isinstance(a, _DagError)), None)
+                    if poisoned is not None:
+                        out = poisoned
+                    else:
+                        t0 = time.perf_counter()
+                        try:
+                            out = getattr(instance, step.method)(
+                                *args, **kwargs)
+                        except Exception as exc:  # noqa: BLE001
+                            import pickle
+
+                            try:
+                                out = _DagError(pickle.dumps(exc))
+                            except Exception:  # noqa: BLE001
+                                out = _DagError(pickle.dumps(
+                                    RuntimeError(repr(exc))))
+                        t_busy += time.perf_counter() - t0
+                    local_vals[step.idx] = out
+                    for e in step.out_edges:
+                        _write_val(out_chans[e], out)
+                else:
+                    own = fetch(step.coll_src, step.idx)
+                    if stop:
+                        break
+                    if step.kind == "coll_root":
+                        vals = [own] + [read(e) for e in step.coll_in_edges]
+                        # a poisoned contribution poisons THIS execution's
+                        # reduced value for everyone, not the pipeline
+                        err = next((v for v in vals
+                                    if isinstance(v, _DagError)), None)
+                        red = err if err is not None else _reduce_vals(
+                            step.coll_op, vals)
+                        for e in step.coll_out_edges:
+                            _write_val(out_chans[e], red)
+                    else:  # leaf: send own, receive reduced
+                        _write_val(out_chans[step.coll_out_edges[0]], own)
+                        red = read(step.coll_in_edges[0])
+                    local_vals[step.idx] = red
+                    for e in step.out_edges:
+                        _write_val(out_chans[e], red)
+            if stop:
+                # propagate the sentinel downstream so every loop unwinds
+                for step in plan.steps:
+                    for e in step.out_edges + step.coll_out_edges:
+                        try:
+                            out_chans[e].write(_STOP, timeout=5)
+                        except Exception:  # noqa: BLE001 — already torn down
+                            pass
+                break
+            executions += 1
+    finally:
+        for ch in list(in_chans.values()) + list(out_chans.values()):
+            ch.unpin()
+    return {"executions": executions, "busy_s": round(t_busy, 6)}
+
+
+# ---------------------------------------------------------------------------
+# driver side: compile + execute
+# ---------------------------------------------------------------------------
+
+
+class CompiledDAGRef:
+    """Result handle for one compiled execution (reference:
+    compiled_dag_node.py CompiledDAGRef). Results must be consumed in
+    submission order — the channel plane is ordered."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._value = None
+        self._done = False
+
+    def get(self, timeout: Optional[float] = 300.0):
+        if self._done:
+            return self._value
+        self._value = self._dag._collect(self._seq, timeout)
+        self._done = True
+        return self._value
+
+
+class CompiledDAG:
+    """A frozen actor DAG with preallocated shm channels and per-actor
+    executor loops (reference: compiled_dag_node.py:813)."""
+
+    def __init__(self, root, max_in_flight: int = 8,
+                 slot_size: int = 1 << 20):
+        from ray_tpu.dag import (ClassMethodNode, DAGNode, InputAttributeNode,
+                                 InputNode, MultiOutputNode)
+        from ray_tpu.dag.collective import CollectiveOutputNode
+
+        self.dag_id = f"cdag{next(_dag_counter)}_{id(root) & 0xffffff:x}"
+        self._nslots = max_in_flight
+        self._slot_size = slot_size
+        self._torn_down = False
+        self._seq_submitted = 0
+        self._seq_collected = 0
+
+        targets = root.outputs if isinstance(root, MultiOutputNode) else [root]
+        self._multi = isinstance(root, MultiOutputNode)
+
+        # -- topo order ------------------------------------------------
+        order: List[Any] = []
+        seen: Dict[int, int] = {}
+
+        def visit(n):
+            if not isinstance(n, DAGNode) or isinstance(
+                    n, (InputNode, InputAttributeNode)):
+                return
+            if id(n) in seen:
+                return
+            if isinstance(n, ClassMethodNode):
+                for a in list(n.args) + list(n.kwargs.values()):
+                    visit(a)
+            elif isinstance(n, CollectiveOutputNode):
+                for src in n.operation.nodes:
+                    visit(src)
+                # lower EVERY participant, consumed or not: the root blocks
+                # on all leaf contributions, so an unplanned sibling would
+                # deadlock the collective at runtime
+                for sib in n.operation.outputs:
+                    if sib is not n and id(sib) not in seen:
+                        seen[id(sib)] = len(order)
+                        order.append(sib)
+            else:
+                raise TypeError(
+                    f"compiled DAGs support actor methods and collective "
+                    f"nodes, not {type(n).__name__}")
+            seen[id(n)] = len(order)
+            order.append(n)
+
+        for t in targets:
+            visit(t)
+        if not order:
+            raise ValueError("compiled DAG has no actor-method nodes")
+
+        # -- per-actor plans -------------------------------------------
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        if cw.store is None:
+            raise RuntimeError("compiled DAGs need a node-local shm store")
+        store_name = cw.store_name
+        self._actors: Dict[str, Any] = {}
+        plans: Dict[str, _ActorPlan] = {}
+        steps: Dict[int, _Step] = {}
+        self._entry_nodes: List[int] = []
+
+        def actor_key(handle):
+            key = handle._actor_id.hex()
+            self._actors[key] = handle
+            if key not in plans:
+                plans[key] = _ActorPlan(
+                    dag_id=self.dag_id, store_name=store_name,
+                    nslots=self._nslots, slot_size=self._slot_size)
+            return key
+
+        def node_actor(n):
+            if isinstance(n, ClassMethodNode):
+                return actor_key(n.handle)
+            return actor_key(n.operation.nodes[n.index].handle)
+
+        def source_for(consumer_idx, consumer_actor, value):
+            from ray_tpu.dag import DAGNode as _DN
+
+            if isinstance(value, InputNode):
+                if consumer_idx not in self._entry_nodes:
+                    self._entry_nodes.append(consumer_idx)
+                return ("input",)
+            if isinstance(value, InputAttributeNode):
+                if consumer_idx not in self._entry_nodes:
+                    self._entry_nodes.append(consumer_idx)
+                return ("input_attr", value.key)
+            if isinstance(value, _DN):
+                pidx = seen[id(value)]
+                pactor = node_actor(value)
+                if pactor == consumer_actor:
+                    return ("local", pidx)
+                edge = f"{pidx}->{consumer_idx}"
+                if edge not in steps[pidx].out_edges:
+                    # a consumer using the same producer in two argument
+                    # positions still reads the channel once per execution
+                    steps[pidx].out_edges.append(edge)
+                return ("chan", edge)
+            return ("const", value)
+
+        coll_lowered: Dict[int, Dict[int, int]] = {}  # op id → index → step idx
+
+        for n in order:
+            idx = seen[id(n)]
+            akey = node_actor(n)
+            if isinstance(n, CollectiveOutputNode):
+                op = n.operation
+                if id(op) not in coll_lowered:
+                    # participants must sit on distinct actors
+                    actors = [actor_key(x.handle) for x in op.nodes]
+                    if len(set(actors)) != len(actors):
+                        raise ValueError(
+                            "collective participants must be distinct actors")
+                    coll_lowered[id(op)] = {}
+                cid = f"c{seen[id(op.outputs[0])]}"
+                i = n.index
+                st = _Step(idx=idx, kind="coll_root" if i == 0 else "coll_leaf",
+                           coll_op=op.op)
+                src_node = op.nodes[i]
+                st.coll_src = ("local", seen[id(src_node)]) \
+                    if node_actor(src_node) == akey else None
+                if st.coll_src is None:
+                    raise ValueError(
+                        "collective input must be a node on the same actor")
+                if i == 0:
+                    st.coll_in_edges = [
+                        f"{cid}:{j}->root" for j in range(1, len(op.nodes))]
+                    st.coll_out_edges = [
+                        f"{cid}:root->{j}" for j in range(1, len(op.nodes))]
+                else:
+                    st.coll_out_edges = [f"{cid}:{i}->root"]
+                    st.coll_in_edges = [f"{cid}:root->{i}"]
+                steps[idx] = st
+                plans[akey].steps.append(st)
+                coll_lowered[id(op)][i] = idx
+                continue
+            st = _Step(idx=idx, method=n.method_name)
+            st.arg_sources = [source_for(idx, akey, a) for a in n.args]
+            st.kwarg_sources = {
+                k: source_for(idx, akey, v) for k, v in n.kwargs.items()}
+            steps[idx] = st
+            plans[akey].steps.append(st)
+
+        # Per-actor execution order = AUTHORING order (stable for plain
+        # chains, and how interleaved schedules like 1F1B are expressed —
+        # bind ops in the order each actor should run them). Cross-actor
+        # ordering still flows from the channel dependencies.
+        created = {seen[id(n)]: getattr(n, "_created", seen[id(n)])
+                   for n in order}
+        for plan in plans.values():
+            plan.steps.sort(key=lambda s: created[s.idx])
+
+        # targets stream to the driver
+        self._out_edges: List[str] = []
+        for t in targets:
+            tidx = seen[id(t)]
+            edge = f"{tidx}->driver"
+            steps[tidx].out_edges.append(edge)
+            self._out_edges.append(edge)
+        self._entry_edges = [f"driver->{i}" for i in self._entry_nodes]
+        if not self._entry_edges:
+            raise ValueError(
+                "compiled DAG must consume InputNode (every execution is "
+                "driven through the entry channels)")
+
+        # -- create ALL channels up front (driver is the creator) -------
+        from ray_tpu.experimental.channel import ShmChannel, channel_object_id
+
+        all_edges: List[str] = []
+        for plan in plans.values():
+            ins, outs = _plan_edges(plan)
+            all_edges.extend(ins)
+            all_edges.extend(outs)
+        all_edges.extend(self._entry_edges)
+        all_edges.extend(self._out_edges)
+        all_edges = list(dict.fromkeys(all_edges))
+        self._channels: Dict[str, ShmChannel] = {}
+        for e in all_edges:
+            self._channels[e] = ShmChannel(
+                cw.store, channel_object_id(self.dag_id, e), creator=True,
+                nslots=self._nslots, slot_size=self._slot_size)
+
+        # -- launch the per-actor executor loops ------------------------
+        self._loop_refs = [
+            self._actors[key].__rt_call__.remote(_actor_loop, plan)
+            for key, plan in plans.items()
+        ]
+
+    # -- runtime --------------------------------------------------------
+
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("CompiledDAG is torn down")
+        if self._seq_submitted - self._seq_collected >= self._nslots:
+            # every edge ring holds nslots items; admitting more in-flight
+            # executions than that could block this writer forever while
+            # the driver is the one who must drain the output channels
+            # (reference: CompiledDAG max_buffered_results raises the same
+            # way rather than deadlocking)
+            raise RuntimeError(
+                f"{self._nslots} executions already in flight; call get() "
+                f"on earlier results first (pipeline depth = max_in_flight)")
+        if kwargs:
+            if args:
+                raise ValueError("pass the input positionally OR by keyword")
+            value = dict(kwargs)
+        else:
+            value = args[0] if args else None
+        from ray_tpu._private import serialization as ser
+
+        # serialize ONCE; entry channels share the byte payload
+        payload = ser.serialize(value).to_bytes()
+        for e in self._entry_edges:
+            # a full entry channel IS the pipeline backpressure
+            self._channels[e].write_bytes(payload, timeout=300)
+        self._seq_submitted += 1
+        return CompiledDAGRef(self, self._seq_submitted)
+
+    def _collect(self, seq: int, timeout: Optional[float]):
+        if seq != self._seq_collected + 1:
+            raise RuntimeError(
+                f"compiled DAG results must be consumed in submission order "
+                f"(next is #{self._seq_collected + 1}, asked for #{seq})")
+        # drain EVERY output edge before raising: a partial read would
+        # shift all later executions' values on the non-drained edges
+        outs = []
+        first_err: Optional[_DagError] = None
+        for e in self._out_edges:
+            v = self._channels[e].read(timeout=timeout)
+            if isinstance(v, _DagError) and first_err is None:
+                first_err = v
+            outs.append(v)
+        self._seq_collected = seq
+        if first_err is not None:
+            first_err.raise_()
+        return outs if self._multi else outs[0]
+
+    def teardown(self) -> List[dict]:
+        """Stop the executor loops; returns per-actor loop stats."""
+        if self._torn_down:
+            return []
+        self._torn_down = True
+        import logging
+
+        import ray_tpu
+
+        for e in self._entry_edges:
+            try:
+                self._channels[e].write(_STOP, timeout=30)
+            except Exception:  # noqa: BLE001 — loop may already be dead
+                pass
+        stats: List[dict] = []
+        try:
+            stats = ray_tpu.get(self._loop_refs, timeout=60)
+        except Exception as exc:  # noqa: BLE001 — never leak pinned channels
+            logging.getLogger(__name__).warning(
+                "compiled DAG %s: executor loops did not stop cleanly (%s); "
+                "kill the stage actors to reclaim them", self.dag_id, exc)
+        finally:
+            for ch in self._channels.values():
+                ch.unpin()
+        return stats

@@ -1,0 +1,207 @@
+"""Preallocated shared-memory channels — the compiled-graph data plane.
+
+Reference surface: python/ray/experimental/channel/shared_memory_channel.py
+(mutable-plasma channels preallocated per compiled-DAG edge) +
+experimental_mutable_object_manager.h. Redesign: a channel is ONE sealed
+object in the node's serverless shm store holding a native SPSC ring
+(ray_tpu/native/shm_channel.cc); producer and consumer map the same segment
+and synchronize through C++ atomics — a hop costs one serialize + memcpy +
+atomic publish, with no RPC, task submission, scheduling, or allocation.
+
+Ring capacity doubles as pipeline backpressure: `write` blocks when the
+consumer is `nslots` executions behind, exactly how the reference bounds
+in-flight compiled-DAG executions via its channel buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import time
+from typing import Any, Optional
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.native.build import lib_path
+
+_POLL_MIN = 20e-6   # 20µs floor: a hop is sub-ms, don't oversleep
+_POLL_MAX = 2e-3
+
+
+class _Lib:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            lib = ctypes.CDLL(lib_path("shm_channel"))
+            lib.rt_chan_required_size.restype = ctypes.c_uint64
+            lib.rt_chan_required_size.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+            lib.rt_chan_init.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+            lib.rt_chan_validate.argtypes = [ctypes.c_void_p]
+            lib.rt_chan_reserve.restype = ctypes.c_int64
+            lib.rt_chan_reserve.argtypes = [ctypes.c_void_p]
+            lib.rt_chan_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.rt_chan_acquire.restype = ctypes.c_int64
+            lib.rt_chan_acquire.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+            lib.rt_chan_release.argtypes = [ctypes.c_void_p]
+            lib.rt_chan_close.argtypes = [ctypes.c_void_p]
+            lib.rt_chan_readable.restype = ctypes.c_uint64
+            lib.rt_chan_readable.argtypes = [ctypes.c_void_p]
+            cls._instance = lib
+        return cls._instance
+
+
+def channel_object_id(dag_id: str, edge: str) -> ObjectID:
+    import hashlib
+
+    digest = hashlib.sha256(f"rtchan:{dag_id}:{edge}".encode()).digest()
+    return ObjectID(digest[:24])
+
+
+class ShmChannel:
+    """One compiled-DAG edge. Create once (creator=True), then open from any
+    process on the node that shares the store.
+
+    Channel state mutates after seal BY DESIGN — these are the framework's
+    mutable objects (reference: experimental_mutable_object_manager.h); the
+    seal only publishes the region. All mutation goes through the native
+    SPSC ring ops against the store's writable mapping; the object stays
+    pinned by this handle's get() refcount so the LRU can never evict a
+    live channel."""
+
+    def __init__(self, store, oid: ObjectID, *, creator: bool = False,
+                 nslots: int = 8, slot_size: int = 1 << 20):
+        self._lib = _Lib()
+        self._lib.rt_chan_slot_size.restype = ctypes.c_uint64
+        self._lib.rt_chan_slot_size.argtypes = [ctypes.c_void_p]
+        self._store = store
+        self.oid = oid
+        size = self._lib.rt_chan_required_size(nslots, slot_size)
+        if creator:
+            store.create(oid, size)
+            store.seal(oid)
+            self._chan_off, chan_size = self._pin()
+            self._base = self._map_addr() + self._chan_off
+            rc = self._lib.rt_chan_init(self._base, chan_size, nslots,
+                                        slot_size)
+            if rc != 0:
+                raise RuntimeError(f"channel init failed rc={rc}")
+            self.slot_size = slot_size
+        else:
+            got = store.get_blocking(oid, timeout=30)
+            if got is None:
+                raise TimeoutError(f"channel object {oid} never appeared")
+            view, _ = got
+            view.release()
+            # get_blocking pinned the object once; keep that pin for life
+            self._chan_off, _ = self._query_offset()
+            self._base = self._map_addr() + self._chan_off
+            if self._lib.rt_chan_validate(self._base) != 0:
+                raise RuntimeError(f"object {oid} is not a channel")
+            self.slot_size = self._lib.rt_chan_slot_size(self._base)
+
+    def _map_addr(self) -> int:
+        return ctypes.addressof(
+            ctypes.c_uint8.from_buffer(self._store._map))
+
+    def _query_offset(self):
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        meta = ctypes.c_uint64()
+        rc = self._store._lib.rt_object_get(
+            self._store._handle, self.oid.binary(), ctypes.byref(off),
+            ctypes.byref(size), ctypes.byref(meta))
+        if rc != 0:
+            raise RuntimeError("channel object vanished")
+        # rt_object_get pinned it again; drop the extra pin (the original
+        # one from __init__ stays)
+        self._store._lib.rt_object_release(self._store._handle,
+                                           self.oid.binary())
+        return off.value, size.value
+
+    def _pin(self):
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        meta = ctypes.c_uint64()
+        rc = self._store._lib.rt_object_get(
+            self._store._handle, self.oid.binary(), ctypes.byref(off),
+            ctypes.byref(size), ctypes.byref(meta))
+        if rc != 0:
+            raise RuntimeError("channel object vanished after create")
+        return off.value, size.value
+
+    # -- raw byte API ---------------------------------------------------
+
+    def try_write_bytes(self, payload) -> bool:
+        n = len(payload)
+        if n > self.slot_size:
+            # MUST be checked before the copy: an oversized memcpy would
+            # trash the next slot / neighboring store objects for every
+            # process mapping the segment
+            raise ValueError(
+                f"payload of {n} bytes exceeds channel slot size "
+                f"{self.slot_size}")
+        off = self._lib.rt_chan_reserve(self._base)
+        if off < 0:
+            return False
+        dst = self._chan_off + off
+        self._store._mv[dst:dst + n] = payload
+        rc = self._lib.rt_chan_commit(self._base, n)
+        if rc != 0:
+            raise ValueError(f"payload of {n} bytes exceeds channel slot size")
+        return True
+
+    def write_bytes(self, payload, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = _POLL_MIN
+        while not self.try_write_bytes(payload):
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError("channel full (consumer stalled?)")
+            time.sleep(delay)
+            delay = min(delay * 2, _POLL_MAX)
+
+    def try_read_bytes(self) -> Optional[bytes]:
+        ln = ctypes.c_uint64()
+        off = self._lib.rt_chan_acquire(self._base, ctypes.byref(ln))
+        if off == -1:
+            return None
+        if off == -2:
+            raise EOFError("channel closed by writer")
+        src = self._chan_off + off
+        data = bytes(self._store._mv[src:src + ln.value])
+        self._lib.rt_chan_release(self._base)
+        return data
+
+    def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = _POLL_MIN
+        while True:
+            data = self.try_read_bytes()
+            if data is not None:
+                return data
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError("channel empty (producer stalled?)")
+            time.sleep(delay)
+            delay = min(delay * 2, _POLL_MAX)
+
+    # -- object API -----------------------------------------------------
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        from ray_tpu._private import serialization as ser
+
+        self.write_bytes(ser.serialize(value).to_bytes(), timeout)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        from ray_tpu._private import serialization as ser
+
+        return ser.deserialize(self.read_bytes(timeout))
+
+    def close(self) -> None:
+        """Writer hang-up: readers see EOFError after draining."""
+        self._lib.rt_chan_close(self._base)
+
+    def readable(self) -> int:
+        return self._lib.rt_chan_readable(self._base)
+
+    def unpin(self) -> None:
+        self._store.release(self.oid)

@@ -18,6 +18,7 @@ _LOCK = threading.Lock()
 
 _LIBS = {
     "shm_store": ["shm_store.cc"],
+    "shm_channel": ["shm_channel.cc"],
 }
 
 

@@ -1,0 +1,137 @@
+// Shared-memory SPSC channels: the compiled-graph data plane.
+//
+// Capability parity with the reference's preallocated mutable-plasma channels
+// (reference: python/ray/experimental/channel/shared_memory_channel.py backed
+// by src/ray/core_worker/experimental_mutable_object_manager.h), redesigned
+// for this framework's serverless shm store: a channel is ONE sealed store
+// object whose payload holds [Header | Slot0 | Slot1 | ...]; producer and
+// consumer processes both map the segment and synchronize through C++11
+// atomics on the header — no RPC, no task submission, no allocation on the
+// hot path. Single-producer single-consumer ring (a compiled DAG edge has
+// exactly one writer and one reader); capacity doubles as pipeline
+// backpressure (reference bounds in-flight executions via channel buffers
+// the same way).
+//
+// The API is zero-copy on both sides: the writer reserves a slot pointer and
+// commits with a length; the reader acquires the slot pointer and releases it
+// after deserializing. memory_order_release on publish / acquire on consume
+// pairs make the payload bytes visible before the sequence number.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+namespace {
+
+constexpr uint64_t kChanMagic = 0x52544348414E0001ULL;  // "RTCHAN" v1
+
+struct ChannelHeader {
+  uint64_t magic;
+  uint64_t nslots;
+  uint64_t slot_size;           // payload bytes per slot
+  std::atomic<uint64_t> write_seq;  // slots produced
+  std::atomic<uint64_t> read_seq;   // slots consumed
+  std::atomic<uint64_t> closed;     // writer hung up (reader sees EOF)
+};
+
+struct Slot {
+  uint64_t len;
+  // payload follows
+};
+
+inline uint64_t slot_stride(uint64_t slot_size) {
+  return sizeof(Slot) + ((slot_size + 63) & ~63ULL);  // 64B-align payloads
+}
+
+inline Slot* slot_at(ChannelHeader* h, uint64_t idx) {
+  auto* base = reinterpret_cast<uint8_t*>(h) + sizeof(ChannelHeader);
+  return reinterpret_cast<Slot*>(base +
+                                 (idx % h->nslots) * slot_stride(h->slot_size));
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t rt_chan_required_size(uint64_t nslots, uint64_t slot_size) {
+  return sizeof(ChannelHeader) + nslots * slot_stride(slot_size);
+}
+
+int rt_chan_init(void* base, uint64_t region_size, uint64_t nslots,
+                 uint64_t slot_size) {
+  if (region_size < rt_chan_required_size(nslots, slot_size)) return -1;
+  auto* h = new (base) ChannelHeader();
+  h->magic = kChanMagic;
+  h->nslots = nslots;
+  h->slot_size = slot_size;
+  h->write_seq.store(0, std::memory_order_relaxed);
+  h->read_seq.store(0, std::memory_order_relaxed);
+  h->closed.store(0, std::memory_order_relaxed);
+  return 0;
+}
+
+int rt_chan_validate(void* base) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  return h->magic == kChanMagic ? 0 : -1;
+}
+
+// Writer side. rt_chan_reserve returns the offset (from base) of the slot
+// payload to write into, or -1 if the ring is full (backpressure).
+int64_t rt_chan_reserve(void* base) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  uint64_t w = h->write_seq.load(std::memory_order_relaxed);
+  uint64_t r = h->read_seq.load(std::memory_order_acquire);
+  if (w - r >= h->nslots) return -1;  // full
+  auto* s = slot_at(h, w);
+  return reinterpret_cast<uint8_t*>(s) + sizeof(Slot) -
+         reinterpret_cast<uint8_t*>(base);
+}
+
+int rt_chan_commit(void* base, uint64_t len) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  if (len > h->slot_size) return -2;
+  uint64_t w = h->write_seq.load(std::memory_order_relaxed);
+  slot_at(h, w)->len = len;
+  h->write_seq.store(w + 1, std::memory_order_release);
+  return 0;
+}
+
+// Reader side. rt_chan_acquire returns the payload offset and length of the
+// next unread slot, or -1 if empty, -2 if empty AND closed (EOF).
+int64_t rt_chan_acquire(void* base, uint64_t* out_len) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  uint64_t r = h->read_seq.load(std::memory_order_relaxed);
+  uint64_t w = h->write_seq.load(std::memory_order_acquire);
+  if (r == w) {
+    return h->closed.load(std::memory_order_acquire) ? -2 : -1;
+  }
+  auto* s = slot_at(h, r);
+  *out_len = s->len;
+  return reinterpret_cast<uint8_t*>(s) + sizeof(Slot) -
+         reinterpret_cast<uint8_t*>(base);
+}
+
+int rt_chan_release(void* base) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  uint64_t r = h->read_seq.load(std::memory_order_relaxed);
+  h->read_seq.store(r + 1, std::memory_order_release);
+  return 0;
+}
+
+void rt_chan_close(void* base) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  h->closed.store(1, std::memory_order_release);
+}
+
+uint64_t rt_chan_readable(void* base) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  return h->write_seq.load(std::memory_order_acquire) -
+         h->read_seq.load(std::memory_order_acquire);
+}
+
+uint64_t rt_chan_slot_size(void* base) {
+  return reinterpret_cast<ChannelHeader*>(base)->slot_size;
+}
+
+}  // extern "C"

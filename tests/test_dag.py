@@ -16,6 +16,15 @@ def ray_init():
     ray_tpu.shutdown()
 
 
+
+
+def _kill(*actors):
+    for a in actors:
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+
 @ray_tpu.remote
 class Stage:
     def __init__(self, add):
@@ -36,6 +45,7 @@ def test_single_actor_dag(ray_init):
         dag = a.fwd.bind(inp)
     assert ray_tpu.get(dag.execute(5), timeout=60) == 15
     assert ray_tpu.get(dag.execute(7), timeout=60) == 17
+    _kill(a)
 
 
 def test_chained_pipeline(ray_init):
@@ -48,6 +58,7 @@ def test_chained_pipeline(ray_init):
     # chained refs: driver never touches intermediates
     assert ray_tpu.get(dag.execute(0), timeout=60) == 6
     assert ray_tpu.get(dag.execute(10), timeout=60) == 16
+    _kill(*stages)
 
 
 def test_fan_out_fan_in(ray_init):
@@ -59,6 +70,7 @@ def test_fan_out_fan_in(ray_init):
     with InputNode() as inp:
         dag = combine.bind(s1.fwd.bind(inp), s2.fwd.bind(inp))
     assert ray_tpu.get(dag.execute(1), timeout=60) == 302
+    _kill(s1, s2)
 
 
 def test_multi_output(ray_init):
@@ -67,6 +79,7 @@ def test_multi_output(ray_init):
         dag = MultiOutputNode([s1.fwd.bind(inp), s2.fwd.bind(inp)])
     refs = dag.execute(10)
     assert ray_tpu.get(refs, timeout=60) == [11, 12]
+    _kill(s1, s2)
 
 
 def test_input_attribute_nodes(ray_init):
@@ -89,25 +102,243 @@ def test_compiled_pipelining_overlaps(ray_init):
     a, b = SlowStage.remote(), SlowStage.remote()
     with InputNode() as inp:
         dag = b.fwd.bind(a.fwd.bind(inp))
-    compiled = dag.experimental_compile(max_in_flight=4)
-    ray_tpu.get(compiled.execute(100), timeout=120)  # actor warmup
+    compiled = dag.experimental_compile(max_in_flight=5)
+    compiled.execute(100).get(timeout=120)  # loop startup + warmup
     t0 = time.monotonic()
     refs = [compiled.execute(i) for i in range(4)]
-    results = [ray_tpu.get(r, timeout=120) for r in refs]
+    results = [r.get(timeout=120) for r in refs]
     elapsed = time.monotonic() - t0
     assert results == [2, 3, 4, 5]
-    # serial would be 4 execs * 2 stages * 0.2s = 1.6s; pipelined overlaps
-    # stage A of call i with stage B of call i-1 => ~1.0s + overhead
+    # serial would be 4 execs * 2 stages * 0.2s = 1.6s; the channel plane
+    # overlaps stage A of call i with stage B of call i-1 => ~1.0s + eps
     assert elapsed < 1.5, f"no pipeline overlap: {elapsed:.2f}s"
     compiled.teardown()
     with pytest.raises(RuntimeError):
         compiled.execute(0)
+    _kill(a, b)
 
 
 def test_compiled_backpressure(ray_init):
     a = Stage.remote(1)
     with InputNode() as inp:
         compiled = a.fwd.bind(inp).experimental_compile(max_in_flight=2)
-    refs = [compiled.execute(i) for i in range(10)]
-    assert [ray_tpu.get(r, timeout=60) for r in refs] == [i + 1 for i in range(10)]
+    r1, r2 = compiled.execute(1), compiled.execute(2)
+    # pipeline full: admitting a third in-flight execution would risk a
+    # driver-side deadlock, so it raises (reference: max_buffered_results)
+    with pytest.raises(RuntimeError, match="in flight"):
+        compiled.execute(3)
+    assert r1.get(timeout=60) == 2
+    r3 = compiled.execute(3)  # capacity freed
+    assert r2.get(timeout=60) == 3 and r3.get(timeout=60) == 4
+    # sliding window drives any length through a small pipeline
+    out = []
+    pend = []
+    for i in range(10):
+        if len(pend) == 2:
+            out.append(pend.pop(0).get(timeout=60))
+        pend.append(compiled.execute(i))
+    out.extend(r.get(timeout=60) for r in pend)
+    assert out == [i + 1 for i in range(10)]
     compiled.teardown()
+    _kill(a)
+
+
+def test_compiled_results_consumed_in_order(ray_init):
+    a = Stage.remote(5)
+    with InputNode() as inp:
+        compiled = a.fwd.bind(inp).experimental_compile(max_in_flight=4)
+    r1, r2 = compiled.execute(1), compiled.execute(2)
+    with pytest.raises(RuntimeError, match="submission order"):
+        r2.get(timeout=30)
+    assert r1.get(timeout=30) == 6 and r2.get(timeout=30) == 7
+    compiled.teardown()
+    _kill(a)
+
+
+def test_compiled_error_poisons_one_execution(ray_init):
+    @ray_tpu.remote
+    class Shaky:
+        def fwd(self, x):
+            if x == 13:
+                raise ValueError("unlucky")
+            return x * 2
+
+    a, b = Shaky.remote(), Shaky.remote()
+    with InputNode() as inp:
+        compiled = b.fwd.bind(a.fwd.bind(inp)).experimental_compile()
+    assert compiled.execute(1).get(timeout=60) == 4
+    bad = compiled.execute(13)
+    with pytest.raises(ValueError, match="unlucky"):
+        bad.get(timeout=60)
+    # the pipeline survives: later executions are unaffected
+    assert compiled.execute(2).get(timeout=60) == 8
+    compiled.teardown()
+    _kill(a, b)
+
+
+def test_compiled_multi_output_and_input_attr(ray_init):
+    s1, s2 = Stage.remote(1), Stage.remote(2)
+    with InputNode() as inp:
+        dag = MultiOutputNode([s1.fwd.bind(inp["x"]), s2.fwd.bind(inp["y"])])
+    compiled = dag.experimental_compile()
+    assert compiled.execute(x=10, y=20).get(timeout=60) == [11, 22]
+    assert compiled.execute(x=0, y=1).get(timeout=60) == [1, 3]
+    compiled.teardown()
+    _kill(s1, s2)
+
+
+def test_compiled_allreduce_in_graph(ray_init):
+    """Collective node compiled into reduce+broadcast channel steps
+    (reference: collective_node.py _CollectiveOperation)."""
+    import numpy as np
+
+    from ray_tpu.dag.collective import allreduce
+
+    @ray_tpu.remote
+    class Worker:
+        def grads(self, x):
+            return np.asarray(x, dtype=np.float64)
+
+        def apply(self, g):
+            return float(g.sum())
+
+    w1, w2, w3 = Worker.remote(), Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        g1, g2, g3 = (w.grads.bind(inp) for w in (w1, w2, w3))
+        r1, r2, r3 = allreduce.bind([g1, g2, g3], op="sum")
+        dag = MultiOutputNode([w1.apply.bind(r1), w2.apply.bind(r2),
+                               w3.apply.bind(r3)])
+    compiled = dag.experimental_compile(max_in_flight=4, slot_size=64 << 10)
+    out = compiled.execute([1.0, 2.0]).get(timeout=120)
+    assert out == [9.0, 9.0, 9.0]  # 3 * (1+2) on every participant
+    out = compiled.execute([5.0]).get(timeout=120)
+    assert out == [15.0, 15.0, 15.0]
+    compiled.teardown()
+    _kill(w1, w2, w3)
+
+
+def test_compiled_hop_latency_beats_eager(ray_init):
+    """VERDICT r3 next #2 acceptance: per-hop latency through preallocated
+    channels below the eager actor-call path."""
+    stages = [Stage.remote(1) for _ in range(3)]
+    with InputNode() as inp:
+        x = inp
+        for s in stages:
+            x = s.fwd.bind(x)
+        dag = x
+
+    # eager path: full task submission per hop
+    dag.execute(0)  # warm the actors
+    n = 30
+    t0 = time.monotonic()
+    for i in range(n):
+        ray_tpu.get(dag.execute(i), timeout=60)
+    eager = (time.monotonic() - t0) / n
+
+    compiled = dag.experimental_compile(max_in_flight=4)
+    compiled.execute(0).get(timeout=120)  # loop startup
+    t0 = time.monotonic()
+    for i in range(n):
+        compiled.execute(i).get(timeout=60)
+    comp = (time.monotonic() - t0) / n
+    compiled.teardown()
+    _kill(*stages)
+    assert comp < eager, (
+        f"compiled {comp*1e3:.2f}ms/exec not below eager {eager*1e3:.2f}ms")
+
+
+def test_compiled_multi_output_error_keeps_edges_synced(ray_init):
+    """A poisoned execution must drain ALL output edges — otherwise later
+    executions' values shift by one on the non-errored edges."""
+    @ray_tpu.remote
+    class MaybeBad:
+        def fwd(self, x):
+            if x == 7:
+                raise ValueError("seven")
+            return x
+
+    a, b = MaybeBad.remote(), MaybeBad.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.fwd.bind(inp), b.fwd.bind(inp)])
+    compiled = dag.experimental_compile()
+    assert compiled.execute(1).get(timeout=60) == [1, 1]
+    with pytest.raises(ValueError, match="seven"):
+        compiled.execute(7).get(timeout=60)
+    assert compiled.execute(2).get(timeout=60) == [2, 2]
+    compiled.teardown()
+    _kill(a, b)
+
+
+def test_compiled_same_producer_two_args(ray_init):
+    """One producer feeding two argument positions of one consumer must
+    write its channel once per execution (no ring-full deadlock)."""
+    @ray_tpu.remote
+    class Dup:
+        def mk(self, x):
+            return x + 1
+
+        def add(self, a, b):
+            return a + b
+
+    p, c = Dup.remote(), Dup.remote()
+    with InputNode() as inp:
+        y = p.mk.bind(inp)
+        compiled = c.add.bind(y, y).experimental_compile(max_in_flight=2)
+    # more executions than nslots: a double-write bug deadlocks here
+    for i in range(6):
+        assert compiled.execute(i).get(timeout=60) == 2 * (i + 1)
+    compiled.teardown()
+    _kill(p, c)
+
+
+def test_compiled_allreduce_participant_failure_poisons_execution(ray_init):
+    """A failing collective participant poisons that execution for every
+    participant; the pipeline keeps serving later executions."""
+    import numpy as np
+
+    from ray_tpu.dag.collective import allreduce
+
+    @ray_tpu.remote
+    class W:
+        def grads(self, x):
+            if x == 3:
+                raise RuntimeError("grad blew up")
+            return np.asarray([float(x)])
+
+        def apply(self, g):
+            return float(g.sum())
+
+    w1, w2 = W.remote(), W.remote()
+    with InputNode() as inp:
+        g1, g2 = w1.grads.bind(inp), w2.grads.bind(inp)
+        r1, r2 = allreduce.bind([g1, g2], op="sum")
+        dag = MultiOutputNode([w1.apply.bind(r1), w2.apply.bind(r2)])
+    compiled = dag.experimental_compile(max_in_flight=4, slot_size=64 << 10)
+    assert compiled.execute(1).get(timeout=120) == [2.0, 2.0]
+    with pytest.raises(RuntimeError, match="grad blew up"):
+        compiled.execute(3).get(timeout=120)
+    assert compiled.execute(5).get(timeout=120) == [10.0, 10.0]
+    compiled.teardown()
+    _kill(w1, w2)
+
+
+def test_compiled_oversized_payload_degrades_to_error(ray_init):
+    """A value larger than the channel slot must surface as an execution
+    error, not corrupt shared memory or kill the pipeline."""
+    import numpy as np
+
+    @ray_tpu.remote
+    class Big:
+        def fwd(self, n):
+            return np.zeros(int(n), dtype=np.uint8)
+
+    a = Big.remote()
+    with InputNode() as inp:
+        compiled = a.fwd.bind(inp).experimental_compile(
+            max_in_flight=2, slot_size=64 << 10)
+    assert compiled.execute(1024).get(timeout=60).shape == (1024,)
+    with pytest.raises(ValueError, match="slot size"):
+        compiled.execute(1 << 20).get(timeout=60)
+    assert compiled.execute(2048).get(timeout=60).shape == (2048,)
+    compiled.teardown()
+    _kill(a)

@@ -61,3 +61,28 @@ def test_one_f_one_b_order_shape():
     # last stage: strict alternation after a single warmup forward
     ops_last = _one_f_one_b_order(S=2, M=4, sid=1)
     assert ops_last[:4] == [("F", 0), ("B", 0), ("F", 1), ("B", 1)]
+
+
+def test_compiled_actor_pipeline_matches_eager(ray_init):
+    """1F1B through the compiled channel plane (VERDICT r3 next #2): loss
+    parity with the eager actor pipeline AND with the single-stage step."""
+    from ray_tpu.train.pipeline_actors import CompiledActorPipeline
+
+    tokens = np.asarray(jax.random.randint(
+        jax.random.key(1), (4, 16), 0, CFG.vocab_size, dtype=jnp.int32))
+
+    mesh = MeshSpec().build(jax.devices()[:1])
+    init, shard, step, ds = make_train_step(CFG, mesh, learning_rate=1e-2)
+    state = shard(init(jax.random.key(0)))
+    base_losses = []
+    for _ in range(3):
+        state, loss = step(state, jax.device_put(jnp.asarray(tokens), ds))
+        base_losses.append(float(loss))
+
+    pipe = CompiledActorPipeline(CFG, n_stages=2, n_microbatches=2,
+                                 learning_rate=1e-2, seed=0)
+    try:
+        comp_losses = [pipe.train_step(tokens, timeout=600) for _ in range(3)]
+    finally:
+        pipe.shutdown()
+    np.testing.assert_allclose(base_losses, comp_losses, rtol=2e-3)
