@@ -21,6 +21,9 @@ class DataContext:
     """
 
     streaming_block_window: int = 8
+    # max estimated bytes in flight per pipeline stage before admission
+    # backpressure (reference: execution/resource_manager.py budgets)
+    op_memory_budget_bytes: int = 128 << 20
     # advisory target for readers choosing block splits
     target_max_block_size: int = 128 * 1024 * 1024
 

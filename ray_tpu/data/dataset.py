@@ -120,41 +120,6 @@ class _CallableWrapper:
         return functools.partial(_CallableWrapper, fn)
 
 
-class _ActorPool:
-    """Round-robin pool of map actors for one stateful stage."""
-
-    def __init__(self, udf_cls, fn_args, fn_kwargs, size: int):
-        import ray_tpu
-
-        @ray_tpu.remote
-        class _MapWorker:
-            def __init__(self, cls, args, kwargs):
-                self._fn = cls(*args, **kwargs)
-
-            def transform(self, block):
-                return self._fn(normalize_batch(block))
-
-        self._actors = [
-            _MapWorker.remote(udf_cls, list(fn_args), dict(fn_kwargs))
-            for _ in range(size)
-        ]
-        self._i = 0
-
-    def submit(self, block_ref):
-        a = self._actors[self._i % len(self._actors)]
-        self._i += 1
-        return a.transform.remote(block_ref)
-
-    def shutdown(self):
-        import ray_tpu
-
-        for a in self._actors:
-            try:
-                ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001 — already dead
-                pass
-
-
 class _Pipeline:
     """Executable form of a Dataset plan: source producers + stage list.
     Submits ONE chained ref pipeline per source block; actor stages route
@@ -165,12 +130,19 @@ class _Pipeline:
 
         self.producers = producers
         self.stages = stages
+        from ray_tpu.data._executor import AutoScalingActorPool
+
         self._run = RemoteFunction(_run_chain)
-        self._pools: List[Optional[_ActorPool]] = []
+        self._pools: List[Optional[AutoScalingActorPool]] = []
         for st in stages:
             if st[0] == "actors":
                 _, cls, args, kwargs, size = st
-                self._pools.append(_ActorPool(cls, args, kwargs, size))
+                if isinstance(size, tuple):  # (min, max) autoscaling spec
+                    size = size[1]
+                # fixed-size pool (materialize() has no scheduling loop to
+                # drive scaling); the streaming executor autoscales
+                self._pools.append(
+                    AutoScalingActorPool(cls, args, kwargs, size, size))
             else:
                 self._pools.append(None)
 
@@ -202,45 +174,6 @@ class _Pipeline:
         for p in self._pools:
             if p is not None:
                 p.shutdown()
-
-
-class _StreamingExecutor:
-    """Bounded-memory pull-based execution (reference:
-    python/ray/data/_internal/execution/streaming_executor.py:106,423,499).
-
-    At most `window` source blocks are in flight end-to-end; the consumer's
-    pull releases a finished block's ref (freeing its shm copy via
-    ownership refcounting) before the next source block is admitted —
-    datasets far larger than the object store stream through a constant
-    footprint. Per-op concurrency = window for fused task segments plus the
-    actor-pool sizes of stateful stages; backpressure is the pull itself."""
-
-    def __init__(self, producers, stages: List[_Stage], window: int):
-        self.pipeline = _Pipeline(producers, stages)
-        self.window = max(1, window)
-
-    def __iter__(self) -> Iterator[Block]:
-        import collections
-
-        import ray_tpu
-
-        pending = collections.deque()  # in-order final refs
-        todo = list(self.pipeline.producers)
-        i = 0
-        try:
-            while todo or pending:
-                while i < len(todo) and len(pending) < self.window:
-                    pending.append(self.pipeline.submit_block(todo[i]))
-                    i += 1
-                if i >= len(todo):
-                    todo = []
-                if pending:
-                    ref = pending.popleft()
-                    block = ray_tpu.get(ref, timeout=600)
-                    del ref  # last local ref → owner frees the shm copy
-                    yield block
-        finally:
-            self.pipeline.shutdown()
 
 
 class Dataset:
@@ -285,14 +218,20 @@ class Dataset:
         actor-pool stage: `concurrency` actors each construct the UDF once
         (fn_constructor_args) and stream blocks through it — the reference's
         ActorPoolMapOperator, for UDFs with expensive setup (model weights,
-        tokenizers)."""
+        tokenizers). `concurrency=(min, max)` enables queue-driven actor
+        AUTOSCALING in the streaming executor (reference:
+        actor_pool_map_operator.py + actor_autoscaler)."""
         if concurrency is not None or isinstance(fn, type):
             base = self._refs if self._refs is not None else self._producers
             pre = [] if self._refs is not None else self._pre_stages
             ops = [] if self._refs is not None else self._ops
             udf = fn if isinstance(fn, type) else _CallableWrapper.of(fn)
+            if isinstance(concurrency, tuple):
+                conc: Any = (int(concurrency[0]), int(concurrency[1]))
+            else:
+                conc = int(concurrency or 1)
             stage = ("actors", udf, tuple(fn_constructor_args),
-                     dict(fn_constructor_kwargs or {}), int(concurrency or 1))
+                     dict(fn_constructor_kwargs or {}), conc)
             return Dataset(
                 list(base), [],
                 _pre_stages=pre + [("tasks", ops), stage] if ops
@@ -334,10 +273,10 @@ class Dataset:
         return Dataset(refs, [], _refs=refs)
 
     def iter_blocks(self, *, window: Optional[int] = None) -> Iterator[Block]:
-        """STREAMING consumption: pull blocks through the plan with at most
-        `window` source blocks in flight (bounded memory — see
-        _StreamingExecutor). Materialized datasets iterate their cached
-        refs.
+        """STREAMING consumption: pull blocks through the plan under the
+        v2 streaming executor (per-stage dispatch, per-op byte budgets,
+        actor autoscaling — see ray_tpu.data._executor). Materialized
+        datasets iterate their cached refs.
 
         Streaming deliberately does NOT cache results: repeat consumption
         re-executes the plan (and re-creates actor pools). Call
@@ -353,7 +292,14 @@ class Dataset:
             from ray_tpu.data.context import DataContext
 
             window = DataContext.get_current().streaming_block_window
-        yield from _StreamingExecutor(self._producers, self._stages(), window)
+        from ray_tpu.data._executor import StreamingExecutorV2
+
+        ex = StreamingExecutorV2(
+            self._producers, self._stages(), window=window)
+        try:
+            yield from ex
+        finally:
+            self._last_stats = getattr(ex, "last_stats", None)
 
     def _block_refs(self) -> List[Any]:
         # cache the materialization on THIS dataset too: repeated consumers
@@ -792,6 +738,16 @@ class Dataset:
         return float(np.sqrt(max(0.0, (sq - n * mean * mean) / (n - ddof))))
 
     # -- introspection --------------------------------------------------
+
+    def stats(self) -> str:
+        """Per-op execution table of the most recent STREAMING consumption
+        (reference: python/ray/data/stats.py — blocks, bytes, task times,
+        peak concurrency/queue, backpressure time per operator)."""
+        st = getattr(self, "_last_stats", None)
+        if st is None:
+            return ("(no stats yet: stats cover streaming consumption — "
+                    "iterate the dataset first)")
+        return str(st)
 
     def schema(self) -> Optional[Dict[str, str]]:
         import ray_tpu

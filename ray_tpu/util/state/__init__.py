@@ -145,8 +145,25 @@ def timeline(filename: Optional[str] = None) -> Any:
     return trace
 
 
+def list_dataset_stats() -> List[Dict[str, Any]]:
+    """Per-op stats of streaming Dataset executions, cluster-visible via the
+    control store KV (reference: the data dashboard's StatsManager feed)."""
+    import json
+
+    reply = _control_call("kv_keys", {"ns": "data_stats", "prefix": b""})
+    out = []
+    for key in reply["keys"]:
+        val = _control_call("kv_get", {"ns": "data_stats", "key": key})["value"]
+        if val is not None:
+            rec = json.loads(val)
+            rec["dataset"] = key.decode() if isinstance(key, bytes) else key
+            out.append(rec)
+    return out
+
+
 __all__ = [
     "list_actors",
+    "list_dataset_stats",
     "list_jobs",
     "list_nodes",
     "list_placement_groups",

@@ -1,0 +1,141 @@
+"""Streaming executor v2: byte-budget backpressure, per-op stats, actor
+autoscaling, and larger-than-store streaming with spill (VERDICT r3 next #3;
+reference: python/ray/data/_internal/execution/streaming_executor.py,
+resource_manager.py, actor_pool_map_operator.py, data/stats.py)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data import from_items
+
+
+@pytest.fixture()
+def small_store():
+    info = ray_tpu.init(
+        num_cpus=4,
+        system_config={
+            # 24 MiB store; the pipelines below push several times that
+            "object_store_memory_bytes": 24 * 1024 * 1024,
+            "object_spill_check_period_s": 0.1,
+        },
+    )
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_larger_than_store_map_sort_streams_with_spill(small_store):
+    """A map_batches -> sort pipeline over ~3x the store's bytes completes
+    (spill-to-disk absorbs the sort's materialization) — the acceptance
+    test for the v2 executor's memory model."""
+    import ray_tpu.data as rtd
+
+    n_blocks, rows = 24, 40_000  # ~3 MiB/block fp64 -> ~72 MiB total
+
+    def gen(i):
+        def make():
+            rng = np.random.default_rng(i)
+            return {"k": rng.integers(0, 1 << 30, rows),
+                    "v": np.full(rows, float(i))}
+        return make
+
+    ds = rtd.Dataset([gen(i) for i in range(n_blocks)], [])
+    ds = ds.map_batches(lambda b: {"k": b["k"], "v": b["v"] * 2.0})
+    out = ds.sort("k")
+    # stream the sorted result and verify global order with constant memory
+    last = None
+    total = 0
+    for block in out.iter_blocks():
+        ks = np.asarray(block["k"])
+        if ks.size == 0:
+            continue
+        assert np.all(np.diff(ks) >= 0)
+        if last is not None:
+            assert ks[0] >= last
+        last = ks[-1]
+        total += ks.size
+    assert total == n_blocks * rows
+    spill_root = os.path.join(small_store["session_dir"], "spill")
+    spilled = [f for d, _, fs in os.walk(spill_root) for f in fs] \
+        if os.path.isdir(spill_root) else []
+    assert spilled, "pipeline 3x the store size completed without spilling"
+
+
+def test_stats_per_op_table(ray_init):
+    ds = from_items([{"x": i} for i in range(64)], parallelism=8)
+    ds = ds.map_batches(lambda b: {"x": b["x"] * 2}).filter(
+        lambda r: r["x"] % 4 == 0)
+    rows = ds.take_all()
+    assert len(rows) == 32
+    table = ds.stats()
+    # one fused stage row with both op names + totals line
+    assert "map_batches->filter" in table
+    assert "blocks" in table and "total:" in table
+    from ray_tpu.data._executor import list_recorded_stats
+
+    recorded = list(list_recorded_stats().values())
+    assert recorded and recorded[-1].output_blocks == 8
+    assert recorded[-1].ops[0].blocks == 8
+    assert recorded[-1].ops[0].task_s_total > 0
+
+
+def test_stats_in_state_api(ray_init):
+    from ray_tpu.util.state import list_dataset_stats
+
+    ds = from_items([{"x": i} for i in range(16)], parallelism=4)
+    _ = ds.map(lambda r: {"x": r["x"] + 1}).take_all()
+    stats = list_dataset_stats()
+    assert stats, "no dataset stats surfaced through the control store"
+    assert any(rec["output_blocks"] == 4 for rec in stats)
+
+
+def test_actor_pool_autoscales_up(ray_init):
+    """concurrency=(1, 3): a deep queue must grow the pool beyond min."""
+
+    class SlowUDF:
+        def __call__(self, batch):
+            time.sleep(0.15)
+            return batch
+
+    ds = from_items([{"x": i} for i in range(240)], parallelism=12)
+    ds = ds.map_batches(SlowUDF, concurrency=(1, 3))
+    assert len(ds.take_all()) == 240
+    from ray_tpu.data._executor import list_recorded_stats
+
+    rec = list(list_recorded_stats().values())[-1]
+    actor_ops = [o for o in rec.ops if o.name.startswith("actors[")]
+    assert actor_ops and actor_ops[0].actors_peak > 1, (
+        f"pool never scaled: {actor_ops}")
+    assert actor_ops[0].blocks == 12
+
+
+def test_byte_budget_backpressure_recorded(ray_init):
+    """A tiny per-op byte budget must throttle admission (backpressure_s or
+    bounded peak_in_flight observed) while still completing correctly."""
+    from ray_tpu.data._executor import StreamingExecutorV2
+
+    def gen(i):
+        def make():
+            return {"v": np.full(200_000, float(i))}  # ~1.6MB
+        return make
+
+    producers = [gen(i) for i in range(12)]
+    ex = StreamingExecutorV2(
+        producers, [("tasks", [])], window=8,
+        max_bytes_per_op=2 << 20)  # ~1 block in flight once sized
+    blocks = list(ex)
+    assert len(blocks) == 12
+    st = ex.last_stats
+    # once the EMA learns the real block size, in-flight stays tiny
+    assert st.ops[0].peak_in_flight <= 8
+    assert st.output_blocks == 12
