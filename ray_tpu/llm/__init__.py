@@ -75,7 +75,13 @@ class LLMServer:
         self.tokenizer = ByteTokenizer()
         self.cfg, self.params = config.build_model()
 
-    def __call__(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+    def __call__(self, payload: Dict[str, Any]) -> Any:
+        if isinstance(payload, dict) and payload.get("stream"):
+            # OpenAI-style streaming: return a generator of completion
+            # chunks; serve's streaming plane + the proxy's SSE writer carry
+            # them to the client incrementally (reference: the vLLM engine's
+            # streaming completions through proxy.py:1031)
+            return self._stream_chunks(payload)
         prompts = payload.get("prompt", "")
         single = isinstance(prompts, str)
         if single:
@@ -106,6 +112,54 @@ class LLMServer:
                 "completion_tokens": total_tokens,
                 "tokens_per_s": round(total_tokens / max(elapsed, 1e-9), 2),
             },
+        }
+
+    def _stream_chunks(self, payload: Dict[str, Any]):
+        from ray_tpu.llm._generate import generate_stream
+
+        prompt = payload.get("prompt", "")
+        if not isinstance(prompt, str):
+            prompt = prompt[0] if prompt else ""
+        max_new = int(payload.get("max_tokens", self.config.max_new_tokens))
+        temperature = float(
+            payload.get("temperature", self.config.temperature))
+        cid = f"cmpl-{int(time.monotonic() * 1000)}"
+        n = 0
+        # byte-level tokens: decode incrementally so multi-byte UTF-8
+        # characters flush only at valid boundaries (a per-token decode
+        # would stream U+FFFD fragments and corrupt reassembled text)
+        import codecs
+
+        dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
+        for tok in generate_stream(
+                self.cfg, self.params, self.tokenizer.encode(prompt),
+                max_new_tokens=max_new, temperature=temperature,
+                seed=self.config.seed, eos_id=EOS):
+            n += 1
+            text = dec.decode(bytes([tok])) if tok < 256 else ""
+            if not text:
+                continue  # mid-character: fold into the next chunk
+            yield {
+                "id": cid,
+                "object": "text_completion.chunk",
+                "model": self.config.model_id,
+                "choices": [{"index": 0, "text": text}],
+            }
+        tail = dec.decode(b"", final=True)
+        if tail:
+            yield {
+                "id": cid,
+                "object": "text_completion.chunk",
+                "model": self.config.model_id,
+                "choices": [{"index": 0, "text": tail}],
+            }
+        yield {
+            "id": cid,
+            "object": "text_completion.chunk",
+            "model": self.config.model_id,
+            "choices": [{"index": 0, "text": "",
+                         "finish_reason": "stop" if n < max_new
+                         else "length"}],
         }
 
 

@@ -146,6 +146,59 @@ def _generate_jit(cfg: LlamaConfig, params, prompt, prompt_len, max_new: int,
     return first[:, None]
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
+def _decode_one(cfg: LlamaConfig, params, tok, pos, cache, start, invalid):
+    """One cached decode step for the incremental (streaming) generator."""
+    return _block_forward(cfg, params, tok, pos, cache, start, invalid)
+
+
+def generate_stream(cfg: LlamaConfig, params, prompt_ids, *,
+                    max_new_tokens: int = 16, temperature: float = 0.0,
+                    seed: int = 0, eos_id: Optional[int] = None):
+    """Single-sequence INCREMENTAL generation: yields one token id at a
+    time as soon as it is sampled (the serve streaming ingress rides this;
+    the batch path stays on _generate_jit's fused scan). Prompt length
+    buckets to powers of two so prefill compiles once per bucket."""
+    p = list(prompt_ids) or [0]
+    plen = len(p)
+    S = max(8, 1 << (plen - 1).bit_length())
+    # bucket the cache length too: compile shapes must not depend on the
+    # client's exact max_tokens or every distinct value recompiles the
+    # decode step on the serving hot path
+    total = S + max(16, 1 << (max_new_tokens - 1).bit_length())
+    pad = S - plen
+    prompt = np.zeros((1, S), dtype=np.int32)
+    prompt[0, pad:] = p  # left-pad
+    invalid = jnp.asarray((np.arange(total) < pad)[None, :])
+    positions = jnp.maximum(jnp.arange(S)[None, :] - pad, 0)
+    cache = init_cache(cfg, 1, total)
+    logits, cache = _decode_one(
+        cfg, params, jnp.asarray(prompt), positions, cache, jnp.int32(0),
+        invalid)
+    rng = jax.random.PRNGKey(seed)
+
+    def sample(lg, key):
+        if temperature == 0.0:
+            return int(np.argmax(np.asarray(lg)))
+        return int(jax.random.categorical(
+            key, lg / max(temperature, 1e-6)))
+
+    rng, key = jax.random.split(rng)
+    tok = sample(logits[0, -1], key)
+    for i in range(max_new_tokens):
+        if eos_id is not None and tok == eos_id:
+            return
+        yield tok
+        if i == max_new_tokens - 1:
+            return
+        rng, key = jax.random.split(rng)
+        logits, cache = _decode_one(
+            cfg, params, jnp.asarray([[tok]], dtype=jnp.int32),
+            jnp.asarray([[plen + i]], dtype=jnp.int32), cache,
+            jnp.int32(S + i), invalid)
+        tok = sample(logits[0, 0], key)
+
+
 def generate(cfg: LlamaConfig, params, prompts, *, max_new_tokens: int = 16,
              temperature: float = 0.0, seed: int = 0,
              eos_id: Optional[int] = None) -> list:

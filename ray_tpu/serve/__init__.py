@@ -146,12 +146,19 @@ def shutdown():
     """Tear down all deployments, the controller, and the proxy."""
     from ray_tpu.serve._http import PROXY_NAME
 
+    proxy = None
     try:
         proxy = ray_tpu.get_actor(PROXY_NAME, namespace=SERVE_NAMESPACE)
         ray_tpu.get(proxy.stop.remote(), timeout=30)
-        ray_tpu.kill(proxy)
-    except Exception:  # noqa: BLE001 — proxy never started
+    except Exception:  # noqa: BLE001 — proxy never started / drain overran
         pass
+    if proxy is not None:
+        # ALWAYS kill once the actor exists: a drain overrunning the RPC
+        # timeout must not leak a permanently-draining detached proxy
+        try:
+            ray_tpu.kill(proxy)
+        except Exception:  # noqa: BLE001 — already dead
+            pass
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
         ray_tpu.get(controller.shutdown.remote(), timeout=60)

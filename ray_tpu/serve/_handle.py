@@ -47,10 +47,17 @@ class DeploymentHandle:
         self._last_refresh = 0.0
         self._lock = threading.Lock()
 
-    def options(self, *, multiplexed_model_id: str = "") -> Any:
-        """Per-request options (reference: handle.options). Currently:
+    def options(self, *, multiplexed_model_id: str = "",
+                stream: bool = False) -> Any:
+        """Per-request options (reference: handle.options):
         multiplexed_model_id routes to a replica that already holds the
-        model and exposes the id via serve.get_multiplexed_model_id()."""
+        model; stream=True calls the replica's streaming path and returns a
+        result iterator (reference: handle.options(stream=True))."""
+        if multiplexed_model_id and stream:
+            raise ValueError(
+                "stream=True with multiplexed_model_id is not supported yet")
+        if stream:
+            return _StreamCaller(self)
         if not multiplexed_model_id:
             return self
         return _ModelRouter(self, multiplexed_model_id)
@@ -216,6 +223,65 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (_rebuild_handle, (self.deployment_name,))
+
+
+class _TrackedStream:
+    """Iterator over a streaming request's item REFS with handle load
+    accounting: the replica's in-flight slot frees when the stream ends
+    (or is dropped — the generator's release cancels the producer)."""
+
+    def __init__(self, gen, handle: "DeploymentHandle", rid: bytes):
+        self._gen = gen
+        self._handle = handle
+        self._rid = rid
+        self._finished = False
+
+    def _finish(self):
+        if not self._finished:
+            self._finished = True
+            self._handle._done(self._rid)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except StopIteration:
+            self._finish()
+            raise
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        try:
+            return await self._gen.__anext__()
+        except StopAsyncIteration:
+            self._finish()
+            raise
+
+    def __del__(self):
+        self._finish()
+
+
+class _StreamCaller:
+    """handle.options(stream=True): routes to the replica streaming path
+    and returns a _TrackedStream of item refs."""
+
+    def __init__(self, handle: "DeploymentHandle"):
+        self._handle = handle
+
+    def remote(self, *args, **kwargs) -> _TrackedStream:
+        rid, replica = self._handle._pick()
+        try:
+            gen = replica.handle_request_stream.options(
+                num_returns="streaming").remote(*args, **kwargs)
+            return _TrackedStream(gen, self._handle, rid)
+        except Exception:
+            self._handle._done(rid)
+            self._handle._refresh(force=True)
+            raise
 
 
 class _ModelRouter:

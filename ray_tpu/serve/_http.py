@@ -1,9 +1,20 @@
 """HTTP ingress for serve deployments.
 
 Reference: python/ray/serve/_private/proxy.py (HTTP proxy actor routing
-`/app` paths to deployment handles). aiohttp server inside a detached actor;
-POST /<deployment> with a JSON (or raw bytes) body routes to the
-deployment's __call__ and returns the JSON-encoded result.
+`/app` paths to deployment handles; streaming responses :1031; draining on
+shutdown). aiohttp server inside a detached actor:
+
+- POST /<deployment> with a JSON (or raw bytes) body routes to the
+  deployment's __call__ and returns the JSON-encoded result.
+- a request carrying `?stream=1` or a JSON body with `"stream": true`
+  rides the STREAMING path end-to-end: the replica drives the user's
+  generator, items flow back over the actor streaming plane, and the proxy
+  writes them to the client incrementally as Server-Sent Events
+  (`data: <json>\n\n`, terminated by `data: [DONE]`) — the client sees
+  tokens before generation completes.
+- `drain()` stops admitting requests (503) and resolves once in-flight
+  requests finish; `stop()` drains then tears the server down (reference:
+  proxy draining in proxy_state.py).
 """
 
 from __future__ import annotations
@@ -29,6 +40,8 @@ class HttpProxy:
         self._handles = {}
         self._site = None
         self._started = None
+        self._inflight = 0
+        self._draining = False
 
     async def _start(self):
         from aiohttp import web
@@ -39,6 +52,7 @@ class HttpProxy:
         app = web.Application()
         app.router.add_route("*", "/{deployment}", self._dispatch)
         app.router.add_get("/-/routes", self._routes)
+        app.router.add_get("/-/healthz", self._healthz)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
@@ -57,22 +71,48 @@ class HttpProxy:
         deployments = await self._controller.list_deployments.remote()
         return web.json_response(deployments)
 
-    async def _dispatch(self, request):
+    async def _healthz(self, request):
         from aiohttp import web
 
+        return web.json_response(
+            {"status": "draining" if self._draining else "ok",
+             "inflight": self._inflight},
+            status=503 if self._draining else 200)
+
+    async def _get_handle(self, name: str):
         from ray_tpu.serve._handle import DeploymentHandle
 
-        name = request.match_info["deployment"]
         handle = self._handles.get(name)
         if handle is None:
             handle = DeploymentHandle(name, self._controller)
             await handle._refresh_async(force=True)
             if not handle._replicas:
-                return web.json_response(
-                    {"error": f"no deployment {name!r}"}, status=404)
+                return None
             self._handles[name] = handle
         else:
             await handle._refresh_async()
+        return handle
+
+    async def _dispatch(self, request):
+        from aiohttp import web
+
+        if self._draining:
+            return web.json_response(
+                {"error": "proxy is draining"}, status=503)
+        self._inflight += 1
+        try:
+            return await self._dispatch_inner(request)
+        finally:
+            self._inflight -= 1
+
+    async def _dispatch_inner(self, request):
+        from aiohttp import web
+
+        name = request.match_info["deployment"]
+        handle = await self._get_handle(name)
+        if handle is None:
+            return web.json_response(
+                {"error": f"no deployment {name!r}"}, status=404)
         body = await request.read()
         if request.content_type == "application/json" and body:
             payload = json.loads(body)
@@ -80,6 +120,10 @@ class HttpProxy:
             payload = body
         else:
             payload = None
+        stream = request.query.get("stream", "") in ("1", "true") or (
+            isinstance(payload, dict) and bool(payload.get("stream")))
+        if stream:
+            return await self._dispatch_stream(request, handle, payload)
         try:
             result = await handle.remote(payload)
         except Exception as e:  # noqa: BLE001 — surface as 500
@@ -89,7 +133,48 @@ class HttpProxy:
         except TypeError:
             return web.Response(body=bytes(result))
 
-    async def stop(self) -> bool:
+    async def _dispatch_stream(self, request, handle, payload):
+        """SSE: one `data:` event per generator item, flushed as produced
+        (reference: proxy.py:1031 ASGI streaming)."""
+        from aiohttp import web
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        })
+        await resp.prepare(request)
+        try:
+            stream = handle.options(stream=True).remote(payload)
+            async for ref in stream:
+                item = await ref
+                try:
+                    data = json.dumps(item)
+                except TypeError:
+                    data = json.dumps(str(item))
+                await resp.write(f"data: {data}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+        except Exception as e:  # noqa: BLE001 — mid-stream error event
+            await resp.write(
+                f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+        await resp.write_eof()
+        return resp
+
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting requests; resolve once in-flight ones finish."""
+        self._draining = True
+        deadline = asyncio.get_running_loop().time() + timeout
+        while self._inflight > 0:
+            if asyncio.get_running_loop().time() >= deadline:
+                return False
+            await asyncio.sleep(0.02)
+        return True
+
+    async def stop(self, drain_timeout: float = 10.0) -> bool:
+        # drain with headroom under the caller's RPC timeout: if this call
+        # outlived serve.shutdown()'s get, the swallow there would skip the
+        # kill and leak a permanently-draining detached proxy
+        await self.drain(timeout=drain_timeout)
         if self._runner is not None:
             await self._runner.cleanup()
         return True

@@ -86,7 +86,52 @@ class ServeReplica:
             from ray_tpu.serve._multiplex import _set_model_id
 
             _set_model_id(model_id)
-        return await self._run(fn, *args, **kwargs)
+        result = await self._run(fn, *args, **kwargs)
+        if inspect.isgenerator(result) or inspect.isasyncgen(result):
+            raise TypeError(
+                f"deployment {self.deployment_name} returned a generator "
+                f"from the unary path — call it with "
+                f"handle.options(stream=True) (HTTP: ?stream=1 or a "
+                f'"stream": true body field)')
+        return result
+
+    async def handle_request_stream(self, *args, **kwargs):
+        """Streaming request path (reference: proxy.py:1031 generator
+        streaming through replica.py): drives a generator-returning callable
+        and yields items onto the actor streaming plane. A non-generator
+        result yields exactly once, so callers may stream unconditionally."""
+        fn = self._callable
+        model_id = kwargs.pop("__serve_model_id", None)
+        if model_id:
+            from ray_tpu.serve._multiplex import _set_model_id
+
+            _set_model_id(model_id)
+        self._ongoing += 1
+        self._peak_ongoing = max(self._peak_ongoing, self._ongoing)
+        self._total += 1
+        sentinel = object()
+        try:
+            async with self._sem:
+                result = fn(*args, **kwargs)
+                if inspect.isawaitable(result):
+                    result = await result
+                if inspect.isasyncgen(result):
+                    async for item in result:
+                        yield item
+                elif inspect.isgenerator(result):
+                    # a sync generator's next() may block (device steps):
+                    # drive it on the pool so the replica loop stays live
+                    loop = asyncio.get_running_loop()
+                    while True:
+                        item = await loop.run_in_executor(
+                            self._pool, next, result, sentinel)
+                        if item is sentinel:
+                            break
+                        yield item
+                else:
+                    yield result
+        finally:
+            self._ongoing -= 1
 
     async def call_method(self, method: str, *args, **kwargs) -> Any:
         return await self._run(getattr(self._callable, method), *args, **kwargs)

@@ -105,3 +105,41 @@ def test_batch_completions_over_data(ray_init):
         LLMConfig(max_new_tokens=3), ds).take_all()
     assert len(out) == 6
     assert all("completion" in row for row in out)
+
+
+def test_openai_app_sse_streaming(ray_init):
+    """llm.build_openai_app end-to-end: an HTTP client sees completion
+    chunks incrementally over SSE (VERDICT r3 next #5)."""
+    import json as _json
+    import time as _t
+
+    import httpx
+
+    from ray_tpu import serve
+    from ray_tpu.llm import build_openai_app
+
+    build_openai_app(
+        LLMConfig(max_new_tokens=6), deployment_name="sse_completions")
+    base = serve.start(http_port=18732)
+    events = []
+    deadline = _t.monotonic() + 120
+    while _t.monotonic() < deadline:
+        try:
+            with httpx.stream(
+                    "POST", f"{base}/sse_completions",
+                    json={"prompt": "hi", "max_tokens": 6, "stream": True},
+                    timeout=180) as r:
+                assert r.headers["content-type"].startswith(
+                    "text/event-stream")
+                for line in r.iter_lines():
+                    if line.startswith("data: "):
+                        events.append(line[len("data: "):])
+            break
+        except httpx.TransportError:
+            _t.sleep(0.5)
+    assert events and events[-1] == "[DONE]"
+    chunks = [_json.loads(e) for e in events[:-1]]
+    assert all(c["object"] == "text_completion.chunk" for c in chunks)
+    # token chunks (all but the finish chunk) carry incremental text
+    assert len(chunks) >= 2
+    assert chunks[-1]["choices"][0].get("finish_reason") in ("stop", "length")
