@@ -14,6 +14,7 @@ from ray_tpu.util.collective.collective import (
     get_rank,
     init_collective_group,
     is_group_initialized,
+    permute,
     recv,
     reduce,
     reducescatter,
@@ -25,7 +26,7 @@ __all__ = [
     "init_collective_group", "destroy_collective_group", "is_group_initialized",
     "get_rank", "get_collective_group_size",
     "allreduce", "reduce", "broadcast", "allgather", "reducescatter",
-    "send", "recv", "barrier", "Backend", "ReduceOp",
+    "send", "recv", "permute", "barrier", "Backend", "ReduceOp",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -115,5 +115,13 @@ def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
     return _manager.get(group_name).recv(src_rank, timeout)
 
 
+def permute(tensor, perm, group_name: str = "default"):
+    """Device-plane collective point-to-point: every rank calls; rank d
+    receives rank s's tensor for each (s, d) in perm (zeros elsewhere) —
+    lowered to XLA collective-permute over ICI (host-plane send/recv stays
+    for true out-of-band transfers)."""
+    return _manager.get(group_name).permute(tensor, perm)
+
+
 def barrier(group_name: str = "default"):
     _manager.get(group_name).barrier()

@@ -200,3 +200,58 @@ def test_slice_placement_group(ray_init):
     out = ray_tpu.get(refs, timeout=120)
     assert len(out) == 1 and out[0] != "?"
     spg.remove()
+
+
+def test_reducescatter_output_never_replicated_and_permute(ray_init):
+    """VERDICT r3 next #6: (a) reducescatter's jitted output is sharded over
+    ranks (psum_scatter), never fully replicated; (b) permute moves values
+    rank-to-rank on the device plane; (c) multi-chip processes build a
+    (ranks, local) mesh using every local device."""
+
+    @ray_tpu.remote(num_cpus=1)
+    class Member:
+        def __init__(self, rank, world):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            # TWO local CPU devices per process: the mesh must use both
+            jax.config.update("jax_num_cpu_devices", 2)
+            self.rank, self.world = rank, world
+
+        def run(self):
+            import numpy as np
+
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(self.world, self.rank, backend="xla",
+                                      group_name="rs")
+            from ray_tpu.util.collective.collective import _manager
+
+            grp = _manager.get("rs")
+            mesh_shape = dict(grp.mesh.shape)
+            # contributions: rank r contributes row j = r + j
+            rs_in = np.stack([
+                np.full((2,), float(self.rank + j), np.float32)
+                for j in range(self.world)
+            ])
+            rs = grp.reducescatter(rs_in)
+            replicated = grp._last_scatter_sharding.is_fully_replicated
+            perm_out = grp.permute(
+                np.full((2,), float(self.rank), np.float32),
+                perm=[(0, 1), (1, 0)])
+            col.destroy_collective_group("rs")
+            return (mesh_shape, rs.tolist(), bool(replicated),
+                    perm_out.tolist())
+
+    world = 2
+    members = [Member.remote(r, world) for r in range(world)]
+    out = ray_tpu.get([m.run.remote() for m in members], timeout=180)
+    for rank, (mesh_shape, rs, replicated, perm_out) in enumerate(out):
+        assert mesh_shape == {"ranks": 2, "local": 2}, mesh_shape
+        # reduced chunk j on rank j: sum_r (r + j) = world*j + sum(r)
+        expected = float(2 * rank + 1)  # r0+r1 contributions at row j=rank
+        assert rs == [expected, expected], (rank, rs)
+        assert replicated is False, "reduce-scatter output was replicated"
+        # permute [(0,1),(1,0)]: each rank receives the OTHER rank's value
+        assert perm_out == [float(1 - rank)] * 2, (rank, perm_out)
