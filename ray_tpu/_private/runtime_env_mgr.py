@@ -148,6 +148,7 @@ _UPLOAD_CACHE: Dict[str, tuple] = {}
 
 # executor-side record of which py_module version is live per module name
 _APPLIED_MODULES: Dict[str, str] = {}
+_REMOTE_WD_CACHE: Dict[str, str] = {}
 
 
 async def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
@@ -175,9 +176,32 @@ async def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
 
     wd = out.pop("working_dir", None)
     if wd:
-        if not os.path.isdir(wd):
-            raise ValueError(f"working_dir {wd!r} is not a directory")
-        out["working_dir_uri"] = await upload(wd)
+        if "://" in wd:
+            # remote package source (gs://, s3://, memory://...): stage it
+            # locally through the storage plane once per URI (cached), then
+            # upload as usual; the staging dir is removed after upload
+            # (reference: remote working_dir URIs in runtime_env packaging)
+            cached = _REMOTE_WD_CACHE.get(wd)
+            if cached is not None:
+                out["working_dir_uri"] = cached
+            else:
+                import shutil
+                import tempfile
+
+                from ray_tpu.train._storage import get_storage
+
+                staged = tempfile.mkdtemp(prefix="rt_wd_")
+                try:
+                    get_storage(wd).download_dir(wd, staged)
+                    uri = await upload(staged)
+                finally:
+                    shutil.rmtree(staged, ignore_errors=True)
+                _REMOTE_WD_CACHE[wd] = uri
+                out["working_dir_uri"] = uri
+        else:
+            if not os.path.isdir(wd):
+                raise ValueError(f"working_dir {wd!r} is not a directory")
+            out["working_dir_uri"] = await upload(wd)
     mods = out.pop("py_modules", None)
     if mods:
         uris: List[str] = []

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -91,13 +90,20 @@ def snapshot_to_host(state) -> Dict[str, np.ndarray]:
 
 @dataclass
 class Checkpoint:
-    """A checkpoint directory (reference: train/_checkpoint.py:56)."""
+    """A checkpoint directory under a StorageContext URI (reference:
+    train/_checkpoint.py:56 + storage.py — `path` may be a plain local dir
+    or any fsspec URI such as memory://... or gs://...)."""
 
     path: str
     metrics: Dict[str, Any] = field(default_factory=dict)
 
+    def _storage(self):
+        from ray_tpu.train._storage import get_storage
+
+        return get_storage(self.path)
+
     def rank_file(self, rank: int) -> str:
-        return os.path.join(self.path, f"rank_{rank}.npz")
+        return self._storage().join(self.path, f"rank_{rank}.npz")
 
     @property
     def step(self) -> int:
@@ -109,9 +115,12 @@ class Checkpoint:
         Leaves that are jax.Arrays in `skeleton` are device_put with the
         skeleton's sharding (resharding on restore is free this way).
         """
+        import io
+
         import jax
 
-        with np.load(self.rank_file(rank)) as z:
+        data = self._storage().read_bytes(self.rank_file(rank))
+        with np.load(io.BytesIO(data)) as z:
             flat = {k: z[k] for k in z.files}
         rebuilt = _unflatten_from_paths(flat, skeleton)
 
@@ -154,15 +163,24 @@ class AsyncCheckpointWriter:
                 self._inflight.result()  # backpressure
 
             def write():
-                os.makedirs(path, exist_ok=True)
-                tmp = os.path.join(path, f".rank_{rank}.tmp.npz")
-                np.savez(tmp, **host)
-                os.replace(tmp, os.path.join(path, f"rank_{rank}.npz"))
+                import io
+
+                from ray_tpu.train._storage import get_storage
+
+                storage = get_storage(path)
+                storage.makedirs(path)
+                buf = io.BytesIO()
+                np.savez(buf, **host)
+                # tmp-name + rename publish: finalize counts rank_* files,
+                # so the final name must never be visible mid-write (atomic
+                # os.replace on local filesystems; object-store uploads are
+                # atomic per object anyway)
+                tmp = storage.join(path, f".rank_{rank}.tmp.npz")
+                storage.write_bytes(tmp, buf.getvalue())
+                storage.rename(tmp, storage.join(path, f"rank_{rank}.npz"))
                 if manifest is not None:
-                    mpath = os.path.join(path, f".manifest_{rank}.tmp")
-                    with open(mpath, "w") as f:
-                        json.dump(manifest, f)
-                    os.replace(mpath, os.path.join(path, f"manifest_{rank}.json"))
+                    storage.write_json(
+                        storage.join(path, f"manifest_{rank}.json"), manifest)
 
             fut = self._pool.submit(write)
             self._inflight = fut
@@ -184,8 +202,11 @@ class CheckpointManager:
     def __init__(self, storage_path: str, run_name: str,
                  num_to_keep: int = 2,
                  metric: Optional[str] = None, mode: str = "min"):
-        self.run_dir = os.path.join(storage_path, run_name)
-        os.makedirs(self.run_dir, exist_ok=True)
+        from ray_tpu.train._storage import get_storage
+
+        self.storage = get_storage(storage_path)
+        self.run_dir = self.storage.join(storage_path, run_name)
+        self.storage.makedirs(self.run_dir)
         self.num_to_keep = max(1, num_to_keep)
         self.metric = metric
         self.mode = mode
@@ -195,28 +216,34 @@ class CheckpointManager:
     # -- paths ----------------------------------------------------------
 
     def staging_dir(self, step: int) -> str:
-        return os.path.join(self.run_dir, f".staging_checkpoint_{step:09d}")
+        return self.storage.join(self.run_dir, f".staging_checkpoint_{step:09d}")
 
     def final_dir(self, step: int) -> str:
-        return os.path.join(self.run_dir, f"checkpoint_{step:09d}")
+        return self.storage.join(self.run_dir, f"checkpoint_{step:09d}")
 
     def _load_existing(self):
         """Recover the checkpoint list after a controller restart."""
-        if not os.path.isdir(self.run_dir):
+        if not self.storage.isdir(self.run_dir):
             return
-        for name in sorted(os.listdir(self.run_dir)):
+        for name in self.storage.listdir(self.run_dir):
             if not name.startswith("checkpoint_"):
                 continue
-            path = os.path.join(self.run_dir, name)
+            path = self.storage.join(self.run_dir, name)
             metrics = {}
-            for f in os.listdir(path):
+            for f in self.storage.listdir(path):
                 if f.startswith("manifest_"):
                     try:
-                        with open(os.path.join(path, f)) as fh:
-                            metrics = json.load(fh).get("metrics", {})
+                        metrics = self.storage.read_json(
+                            self.storage.join(path, f)).get("metrics", {})
                         break
                     except (OSError, json.JSONDecodeError):
                         pass
+            try:
+                # rank manifests predate finalize and lack "step"; the
+                # directory name is authoritative
+                metrics.setdefault("step", int(name.rsplit("_", 1)[-1]))
+            except ValueError:
+                pass
             self.checkpoints.append(Checkpoint(path, metrics))
 
     # -- lifecycle ------------------------------------------------------
@@ -225,15 +252,16 @@ class CheckpointManager:
                  expected_ranks: int) -> Optional[Checkpoint]:
         """Promote a staging dir once all ranks have written their shard."""
         staging = self.staging_dir(step)
-        if not os.path.isdir(staging):
+        if not self.storage.isdir(staging):
             return None
-        present = [f for f in os.listdir(staging) if f.startswith("rank_")]
+        present = [f for f in self.storage.listdir(staging)
+                   if f.startswith("rank_")]
         if len(present) < expected_ranks:
             return None
         final = self.final_dir(step)
         metrics = dict(metrics)
         metrics.setdefault("step", step)
-        os.replace(staging, final)
+        self.storage.rename(staging, final)
         ckpt = Checkpoint(final, metrics)
         self.checkpoints.append(ckpt)
         self._enforce_retention()
@@ -258,7 +286,7 @@ class CheckpointManager:
         for c in list(self.checkpoints):
             if c.path not in keep:
                 self.checkpoints.remove(c)
-                shutil.rmtree(c.path, ignore_errors=True)
+                self.storage.delete(c.path)
 
     @property
     def latest(self) -> Optional[Checkpoint]:

@@ -108,17 +108,71 @@ class ResultGrid:
             return rows
 
 
+@dataclass
+class RunConfig:
+    """Experiment persistence config (reference: air.RunConfig): with a
+    storage_path, Tuner.fit writes the experiment state (per-trial configs,
+    final metrics, histories, status) through the StorageContext — local
+    dirs or any fsspec URI (memory://, gs://, s3://...)."""
+
+    name: str = "tune_run"
+    storage_path: Optional[str] = None
+
+
 class Tuner:
     """Reference: python/ray/tune/tuner.py:43."""
 
     def __init__(self, trainable: Callable[[dict], None], *,
                  param_space: Optional[Dict[str, Any]] = None,
                  tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
                  trial_resources: Optional[Dict[str, float]] = None):
         self._trainable = trainable
         self._param_space = dict(param_space or {})
         self._cfg = tune_config or TuneConfig()
+        self._run_config = run_config
         self._trial_resources = trial_resources
+
+    @staticmethod
+    def restore_results(storage_path: str, name: str = "tune_run") \
+            -> "ResultGrid":
+        """Rebuild a ResultGrid from a persisted experiment state."""
+        from ray_tpu.train._storage import get_storage
+
+        storage = get_storage(storage_path)
+        state = storage.read_json(
+            storage.join(storage_path, name, "experiment_state.json"))
+        results = []
+        for t in state["trials"]:
+            r = Result(trial_id=t["trial_id"], config=t["config"])
+            r.metrics = t.get("metrics")
+            r.history = t.get("history", [])
+            r.status = t.get("status", "TERMINATED")
+            r.error = t.get("error")
+            results.append(r)
+        return ResultGrid(results, state.get("metric"), state.get("mode"))
+
+    def _persist(self, results: List["Result"]):
+        rc = self._run_config
+        if rc is None or not rc.storage_path:
+            return
+        from ray_tpu.train._storage import get_storage
+
+        storage = get_storage(rc.storage_path)
+        run_dir = storage.join(rc.storage_path, rc.name)
+        storage.makedirs(run_dir)
+        storage.write_json(
+            storage.join(run_dir, "experiment_state.json"),
+            {
+                "metric": self._cfg.metric,
+                "mode": self._cfg.mode,
+                "trials": [
+                    {"trial_id": r.trial_id, "config": r.config,
+                     "metrics": r.metrics, "history": r.history,
+                     "status": r.status, "error": r.error}
+                    for r in results
+                ],
+            })
 
     def fit(self, poll_interval: float = 0.1, timeout: float = 600.0) -> ResultGrid:
         import cloudpickle
@@ -241,6 +295,7 @@ class Tuner:
                     ray_tpu.kill(actor)
                     del running[i]
                     launch()
+        self._persist(results)
         return ResultGrid(results, cfg.metric, cfg.mode)
 
 
@@ -251,6 +306,7 @@ __all__ = [
     "get_checkpoint",
     "Result",
     "ResultGrid",
+    "RunConfig",
     "TuneConfig",
     "Tuner",
     "choice",
