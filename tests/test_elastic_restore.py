@@ -1,0 +1,171 @@
+"""Elastic restore across world sizes + coordinator-death recovery
+(VERDICT r3 next #8; reference: train/v2/_internal/execution/scaling_policy/
+elastic.py + the jax.distributed re-init hazard documented in
+train/v2/jax/config.py:22-35)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train._policies import FailurePolicy, ScalingDecision, ScalingPolicy
+from ray_tpu.train._storage import get_storage
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _write_sharded_checkpoint(root: str, world: int, full: np.ndarray):
+    """Synthesize what `world` training processes write for an array
+    sharded along dim 0 (each rank holds rows [r*per, (r+1)*per))."""
+    s = get_storage(root)
+    s.makedirs(root)
+    per = full.shape[0] // world
+    import io
+
+    for r in range(world):
+        lo, hi = r * per, (r + 1) * per
+        buf = io.BytesIO()
+        np.savez(buf, **{"/w": full[lo:hi], "/step": np.asarray(7)})
+        s.write_bytes(s.join(root, f"rank_{r}.npz"), buf.getvalue())
+        s.write_json(s.join(root, f"manifest_{r}.json"), {
+            "metrics": {"step": 7},
+            "shards": {"/w": {
+                "global_shape": list(full.shape),
+                "shards": [{"key": "/w",
+                            "index": [[lo, hi], [0, full.shape[1]]]}],
+            }},
+        })
+
+
+def test_consolidated_restore_from_different_world_size():
+    """rank shards written at world=4 restore as ONE full array and place
+    onto a skeleton sharded for a different layout."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    full = np.arange(64, dtype=np.float32).reshape(8, 8)
+    root = "memory://elastic/ckpt_w4"
+    _write_sharded_checkpoint(root, world=4, full=full)
+
+    ckpt = Checkpoint(root, {"step": 7})
+    assert ckpt.num_ranks() == 4
+    mesh = MeshSpec(fsdp=2).build(__import__("jax").devices()[:2])
+    skeleton = {
+        "w": jax.device_put(jnp.zeros((8, 8)),
+                            NamedSharding(mesh, P("fsdp", None))),
+        "step": 0,
+    }
+    restored = ckpt.load_consolidated(skeleton)
+    np.testing.assert_allclose(np.asarray(restored["w"]), full)
+    assert restored["step"] == 7
+    # the skeleton's sharding is preserved on the restored leaf
+    assert restored["w"].sharding.spec == P("fsdp", None)
+
+
+def test_snapshot_shard_metadata_shapes():
+    """snapshot_with_meta: single-process multi-device arrays gather to the
+    full value with no metadata, and the jax shard .index (the source of
+    the recorded [lo, hi] pairs) carries the slice a true multi-process
+    save would record."""
+    from ray_tpu.train._checkpoint import snapshot_with_meta
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    mesh = MeshSpec(fsdp=2).build(jax.devices()[:2])
+    arr = jax.device_put(jnp.arange(8.0).reshape(4, 2),
+                         NamedSharding(mesh, P("fsdp", None)))
+    # in-process the array has 2 addressable shards -> full gather, no meta
+    host, meta = snapshot_with_meta({"w": arr})
+    assert host["/w"].shape == (4, 2) and meta == {}
+    # each shard's .index is the global slice a per-process save records
+    starts = sorted(s.index[0].start or 0 for s in arr.addressable_shards)
+    assert starts == [0, 2]
+    assert all(np.asarray(s.data).shape == (2, 2)
+               for s in arr.addressable_shards)
+
+
+class ShrinkingPolicy(ScalingPolicy):
+    """First incarnation at 3 workers, every restart at 2 — the elastic
+    restart-at-a-different-size path."""
+
+    def __init__(self):
+        self.sizes = [3, 2]
+
+    def target_size(self, cluster_cpus, resources_per_worker):
+        n = self.sizes.pop(0) if len(self.sizes) > 1 else self.sizes[0]
+        return ScalingDecision(num_workers=n, reason="shrinking-test")
+
+
+def test_coordinator_death_restarts_at_new_size(ray_init, tmp_path):
+    """Kill the rank-0 (jax.distributed coordinator) worker mid-step; the
+    controller must re-create the WHOLE gang at a different size and resume
+    from the consolidated checkpoint (SURVEY hard-part #4)."""
+    from ray_tpu.train._controller import TrainController
+
+    marker = str(tmp_path / "coord_died")
+    run_dir = str(tmp_path / "elastic_run")
+
+    def train_fn(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        start = 0
+        ckpt = ctx.get_checkpoint()
+        if ckpt is not None:
+            # consolidated: works regardless of the world size that saved it
+            state = ckpt.load_consolidated({"w": np.zeros(2), "step": 0})
+            start = int(state["step"]) + 1
+        for step in range(start, 5):
+            if (step == 2 and ctx.get_world_rank() == 0
+                    and not os.path.exists(config["marker"])):
+                deadline = time.time() + 60
+                while time.time() < deadline and not any(
+                    n.startswith("checkpoint_")
+                    for n in os.listdir(config["run_dir"])
+                ):
+                    time.sleep(0.1)
+                open(config["marker"], "w").close()
+                os._exit(1)  # coordinator hard-death mid-step
+            train.report(
+                {"step": step, "world": ctx.get_world_size(),
+                 "resumed_from": start},
+                checkpoint_state={"w": np.ones(2) * step, "step": step},
+            )
+
+    mgr = CheckpointManager(str(tmp_path), "elastic_run", num_to_keep=2)
+    os.makedirs(run_dir, exist_ok=True)
+    controller = TrainController(
+        train_fn=train_fn,
+        train_config={"marker": marker, "run_dir": mgr.run_dir},
+        scaling_policy=ShrinkingPolicy(),
+        failure_policy=FailurePolicy(max_failures=2),
+        resources_per_worker={"CPU": 1},
+        run_name="elastic_run",
+        storage_path=str(tmp_path),
+        checkpoint_manager=mgr,
+    )
+    result = controller.run()
+    assert result.error is None, result.error
+    assert os.path.exists(marker), "coordinator never died"
+    worlds = {m.get("world") for m in result.metrics_history if "world" in m}
+    assert worlds == {3, 2}, f"expected both gang sizes, saw {worlds}"
+    # the 2-worker incarnation resumed from the 3-worker checkpoint
+    resumed = [m for m in result.metrics_history
+               if m.get("world") == 2 and m.get("resumed_from", 0) > 0]
+    assert resumed, "restarted gang did not resume from checkpoint"
+    assert result.metrics["step"] == 4
