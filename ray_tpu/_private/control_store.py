@@ -511,9 +511,24 @@ class ControlStore:
                          and avail.to_wire() == info.resources.to_wire()
                          and load.get("pending", 0) == 0),
             })
+        # PENDING placement groups are demand too — their bundles (e.g. the
+        # TPU-{type}-head slice reservations) are what drives slice-aware
+        # scale-up (reference: GetClusterResourceState includes pending
+        # gang resource requests)
+        pending_pg_bundles: List[dict] = []
+        for rec in self.placement_groups.values():
+            if rec.state != pb.PG_PENDING:
+                continue
+            for b in rec.bundles:
+                pending_pg_bundles.append({
+                    "resources": b.resources.to_wire(),
+                    "strategy": rec.strategy,
+                    "labels": dict(rec.label_selector or {}),
+                })
         return {
             "pending_total": pending_total,
             "pending_resources": pending_resources,
+            "pending_pg_bundles": pending_pg_bundles,
             "nodes": nodes,
         }
 

@@ -24,7 +24,10 @@ logger = logging.getLogger(__name__)
 
 
 class NodeProvider:
-    """Provider ABC (reference: autoscaler/node_provider.py)."""
+    """Provider ABC (reference: autoscaler/node_provider.py). Providers
+    that can provision whole TPU slices additionally implement
+    create_slice/terminate_slice (the autoscaler detects the capability
+    with hasattr, not a concrete class check)."""
 
     def create_node(self, resources: Dict[str, float]) -> Any:
         raise NotImplementedError
@@ -57,6 +60,60 @@ class LocalNodeProvider(NodeProvider):
 
 
 @dataclass
+class SliceSpec:
+    """Shape of one TPU slice's node group: `hosts` daemons, each exposing
+    `resources_per_host`, host 0 additionally carrying the
+    `TPU-{pod_type}-head` reservation resource. All hosts share a
+    tpu-slice-name label and carry row-major ICI coordinates for
+    TOPOLOGY_STRICT_PACK (reference: the pod-slice node groups a TPU-VM /
+    GKE provider provisions; python/ray/_private/accelerators/tpu.py:345)."""
+
+    hosts: int = 2
+    resources_per_host: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1.0, "TPU": 4.0})
+
+
+class SliceNodeProvider(LocalNodeProvider):
+    """Provisions WHOLE slices as labeled node groups. The local
+    implementation spawns labeled node daemons (the counterpart of the
+    reference's fake_multi_node provider); a cloud provider overrides
+    create_slice/terminate_slice with TPU-VM / GKE node-pool calls
+    (reference: autoscaler/v2/instance_manager/instance_manager.py:29)."""
+
+    _counter = 0
+
+    def create_slice(self, pod_type: str, spec: SliceSpec) -> Dict[str, Any]:
+        from ray_tpu._private import node as node_mod
+        from ray_tpu._private import protocol as pb
+
+        SliceNodeProvider._counter += 1
+        slice_name = f"{pod_type}-slice-{SliceNodeProvider._counter:04d}"
+        nodes = []
+        for h in range(spec.hosts):
+            resources = dict(spec.resources_per_host)
+            if h == 0:
+                # one reservation token per slice (reference: tpu.py:345
+                # TPU-{pod_type}-head on worker 0)
+                resources[f"TPU-{pod_type}-head"] = 1.0
+            labels = {
+                "tpu-slice-name": slice_name,
+                "tpu-pod-type": pod_type,
+                pb.TPU_COORD_LABEL: f"0,{h}",  # row-major line topology
+            }
+            proc, info = node_mod.start_node_daemon(
+                self.control_address, self.session_dir,
+                resources=resources, labels=labels)
+            nodes.append({"proc": proc, "node_id": info["node_id"],
+                          "address": info["address"]})
+        return {"slice_name": slice_name, "pod_type": pod_type,
+                "nodes": nodes}
+
+    def terminate_slice(self, handle: Dict[str, Any]) -> None:
+        for n in handle["nodes"]:
+            self.terminate_node(n)
+
+
+@dataclass
 class AutoscalingConfig:
     """Reference: autoscaler config (max_workers, idle timeout,
     upscaling_speed)."""
@@ -67,6 +124,11 @@ class AutoscalingConfig:
         default_factory=lambda: {"CPU": 2.0})
     idle_timeout_s: float = 10.0
     poll_period_s: float = 1.0
+    # slice-aware scale-up: pod type -> node-group shape; infeasible
+    # TPU-{type}-head demand (pending slice placement groups) provisions
+    # whole slices through SliceNodeProvider.create_slice
+    slice_types: Dict[str, SliceSpec] = field(default_factory=dict)
+    max_slices: int = 4
 
 
 class Autoscaler:
@@ -76,6 +138,7 @@ class Autoscaler:
         self.provider = provider
         self.config = config
         self.workers: List[dict] = []  # provider handles for launched nodes
+        self.slices: List[dict] = []   # provider handles for launched slices
         self._idle_since: Dict[str, float] = {}
         self._draining: Dict[str, float] = {}
         self._stop = threading.Event()
@@ -134,8 +197,6 @@ class Autoscaler:
         from ray_tpu._private.protocol import ResourceSet
 
         shapes = [ResourceSet.from_wire(w) for w in load["pending_resources"]]
-        if not shapes:
-            return load["pending_total"]
         bin_cap = ResourceSet(self.config.worker_resources)
         # DRAINING nodes count as capacity here: demand only they can host
         # must keep gating scale-down so the undrain path can rescue them —
@@ -151,8 +212,61 @@ class Autoscaler:
             or any(r.is_subset_of(t) for t in totals)
         )
         # shapes are capped in heartbeats; assume the uncounted tail is
-        # hostable (err toward keeping capacity)
-        return hostable + max(0, load["pending_total"] - len(shapes))
+        # hostable (err toward keeping capacity). Pending placement-group
+        # bundles gate scale-down only when SOMETHING could ever host them:
+        # an existing node, or (for TPU-{type}-head slice reservations) a
+        # slice type this autoscaler can provision — a permanently
+        # infeasible PG must not hold idle nodes alive forever
+        import re as _re
+
+        pg_hostable = 0
+        for b in load.get("pending_pg_bundles", []):
+            r = ResourceSet.from_wire(b.get("resources", {}))
+            if r.is_subset_of(bin_cap) or any(
+                    r.is_subset_of(t) for t in totals):
+                pg_hostable += 1
+                continue
+            head_types = [
+                m.group(1) for key in b.get("resources", {})
+                if (m := _re.fullmatch(r"TPU-(.+)-head", key))
+            ]
+            if any(t in self.config.slice_types for t in head_types) and \
+                    len(self.slices) < self.config.max_slices:
+                pg_hostable += 1
+        return (hostable + max(0, load["pending_total"] - len(shapes))
+                + pg_hostable)
+
+    def _slice_need(self, load: dict) -> Dict[str, int]:
+        """How many NEW slices each pod type needs: one per pending
+        TPU-{type}-head placement-group bundle that no known node (live or
+        launching) can host."""
+        import re
+
+        # FREE head tokens (available, not total: a token a scheduled PG
+        # already holds must not mask new pending demand) plus tokens
+        # arriving with launching slices
+        capacity: Dict[str, int] = {}
+        for n in load["nodes"]:
+            for key, v in n.get("available", {}).items():
+                m = re.fullmatch(r"TPU-(.+)-head", key)
+                if m and v > 0:
+                    capacity[m.group(1)] = capacity.get(m.group(1), 0) + 1
+        known = {n["node_id"] for n in load["nodes"]}
+        for s in self.slices:
+            if any(n["node_id"] not in known for n in s["nodes"]):
+                capacity[s["pod_type"]] = capacity.get(s["pod_type"], 0) + 1
+        need: Dict[str, int] = {}
+        for b in load.get("pending_pg_bundles", []):
+            for key, v in b.get("resources", {}).items():
+                m = re.fullmatch(r"TPU-(.+)-head", key)
+                if not m or v <= 0:
+                    continue
+                t = m.group(1)
+                if capacity.get(t, 0) > 0:
+                    capacity[t] -= 1
+                else:
+                    need[t] = need.get(t, 0) + 1
+        return need
 
     def reconcile_once(self) -> Dict[str, int]:
         from ray_tpu._private.core_worker import get_core_worker
@@ -161,11 +275,18 @@ class Autoscaler:
         load = cw.run_sync(cw.control.call("get_cluster_load", {}), 30)
         launched = terminated = 0
 
-        # prune workers whose daemons died out-of-band
+        # prune workers/slices whose daemons died out-of-band — a dead
+        # slice must not keep counting as launching head-token capacity
+        # (it would mask the re-pended PG's demand forever)
         alive_ids = {n["node_id"] for n in load["nodes"]}
         self.workers = [
             w for w in self.workers
             if w["proc"].poll() is None or w["node_id"] in alive_ids
+        ]
+        self.slices = [
+            sl for sl in self.slices
+            if any(n["proc"].poll() is None or n["node_id"] in alive_ids
+                   for n in sl["nodes"])
         ]
 
         demand = self._gate_demand(load)
@@ -200,6 +321,24 @@ class Autoscaler:
             self._idle_since.pop(nid, None)
             undrained += 1
             logger.info("autoscaler undrained node %s", nid[:12])
+
+        # slice-aware scale-up: pending TPU-{type}-head bundles (slice
+        # placement-group reservations) that no live or launching node can
+        # host provision WHOLE slices (reference: slice-aware node groups
+        # against TOPOLOGY_STRICT_PACK demand; VERDICT r3 next #9)
+        launched_slices = 0
+        if self.config.slice_types and hasattr(self.provider, "create_slice"):
+            for pod_type, count in self._slice_need(load).items():
+                spec = self.config.slice_types.get(pod_type)
+                if spec is None:
+                    continue
+                room = self.config.max_slices - len(self.slices)
+                for _ in range(max(0, min(count, room))):
+                    handle = self.provider.create_slice(pod_type, spec)
+                    self.slices.append(handle)
+                    launched_slices += 1
+                    logger.info("autoscaler provisioned slice %s (%d hosts)",
+                                handle["slice_name"], len(handle["nodes"]))
 
         # scale up: only for demand existing+starting capacity can't absorb.
         # An undrain this pass returns capacity the load snapshot couldn't
@@ -254,7 +393,9 @@ class Autoscaler:
                     except Exception:  # noqa: BLE001
                         pass
         return {"launched": launched, "terminated": terminated,
-                "workers": len(self.workers), "demand": demand}
+                "workers": len(self.workers), "demand": demand,
+                "slices": len(self.slices),
+                "launched_slices": launched_slices}
 
     # -- background loop -------------------------------------------------
 
@@ -282,6 +423,12 @@ class Autoscaler:
                 except Exception:  # noqa: BLE001
                     pass
             self.workers.clear()
+            for sl in self.slices:
+                try:
+                    self.provider.terminate_slice(sl)
+                except Exception:  # noqa: BLE001
+                    pass
+            self.slices.clear()
 
 
 __all__ = [
@@ -289,4 +436,6 @@ __all__ = [
     "AutoscalingConfig",
     "LocalNodeProvider",
     "NodeProvider",
+    "SliceNodeProvider",
+    "SliceSpec",
 ]
