@@ -9,9 +9,12 @@ from ray_tpu.rllib.learner import PPOLearner, compute_gae
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner import VTraceLearner
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner
+from ray_tpu.rllib.bc import BC, BCConfig, BCLearner
 
-__all__ = ["DQN", "DQNConfig", "DQNLearner", "EnvRunner", "IMPALA",
-           "IMPALAConfig", "PPO", "PPOConfig", "PPOLearner", "ReplayBuffer",
+__all__ = ["BC", "BCConfig", "BCLearner", "DQN", "DQNConfig", "DQNLearner",
+           "EnvRunner", "IMPALA", "IMPALAConfig", "PPO", "PPOConfig",
+           "PPOLearner", "ReplayBuffer", "SAC", "SACConfig", "SACLearner",
            "VTraceLearner", "compute_gae", "connectors"]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

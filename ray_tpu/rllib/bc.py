@@ -1,0 +1,188 @@
+"""Behavior cloning from offline data — the minimal offline-RL path.
+
+Reference surface: rllib/algorithms/bc/ (BCConfig, bc.py — supervised
+policy learning over offline datasets read through Ray Data; rllib/offline/
+offline_prelearner.py). Here the offline plane IS ray_tpu.data: the config
+takes a Dataset of {obs, action} rows and each train() iteration streams
+one shuffled pass of jitted max-likelihood updates (cross-entropy for
+discrete actions, MSE in tanh-space for continuous)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class BCLearner:
+    """Jitted supervised policy updates."""
+
+    def __init__(self, obs_dim: int, act_out: int, *, discrete: bool,
+                 hidden=(128, 128), lr: float = 1e-3, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.learner import init_mlp, mlp_apply
+
+        self.discrete = discrete
+        self.params = {"policy": init_mlp(
+            jax.random.PRNGKey(seed), [obs_dim, *hidden, act_out])}
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+
+        def loss_fn(params, obs, actions):
+            out = mlp_apply(params["policy"], obs)
+            if discrete:
+                logp = jax.nn.log_softmax(out, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, actions[:, None].astype(jnp.int32), axis=1)[:, 0]
+                return nll.mean()
+            return ((out - actions) ** 2).mean()
+
+        def update(params, opt_state, obs, actions):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs, actions)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update)
+
+    def update(self, obs: np.ndarray, actions: np.ndarray) -> float:
+        import jax.numpy as jnp
+
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state,
+            jnp.asarray(obs, jnp.float32),
+            jnp.asarray(actions,
+                        jnp.int32 if self.discrete else jnp.float32),
+        )
+        return float(loss)
+
+    def act(self, obs: np.ndarray):
+        from ray_tpu.rllib.learner import mlp_apply
+
+        out = np.asarray(mlp_apply(self.params["policy"],
+                                   np.asarray(obs, np.float32)[None]))[0]
+        if self.discrete:
+            return int(np.argmax(out))
+        return out
+
+
+class BCConfig:
+    """Builder-style config (reference: BCConfig in
+    rllib/algorithms/bc/bc.py)."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.env_config: dict = {}
+        self.dataset = None
+        self.obs_column = "obs"
+        self.action_column = "action"
+        self.hidden = [128, 128]
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.seed = 0
+
+    def environment(self, env: str, *, env_config: Optional[dict] = None):
+        """Optional: used only by evaluate()."""
+        self.env_name = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def offline_data(self, dataset, *, obs_column: str = "obs",
+                     action_column: str = "action"):
+        """`dataset` is a ray_tpu.data Dataset of rows holding an
+        observation vector and an action (reference: AlgorithmConfig
+        .offline_data(input_=...) reading through Ray Data)."""
+        self.dataset = dataset
+        self.obs_column = obs_column
+        self.action_column = action_column
+        return self
+
+    def training(self, *, lr: Optional[float] = None,
+                 train_batch_size: Optional[int] = None,
+                 hidden: Optional[List[int]] = None):
+        for name, value in (("lr", lr),
+                            ("train_batch_size", train_batch_size),
+                            ("hidden", hidden)):
+            if value is not None:
+                setattr(self, name, value)
+        return self
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    """Offline behavior cloning driver (reference: bc.py)."""
+
+    def __init__(self, config: BCConfig):
+        if config.dataset is None:
+            raise ValueError("config.offline_data(dataset) required")
+        self.config = config
+        # materialize once: every epoch re-streams the same block refs
+        self._ds = config.dataset.materialize()
+        sample = self._ds.take(1)[0]
+        obs = np.asarray(sample[config.obs_column], np.float32)
+        action = sample[config.action_column]
+        self.discrete = np.issubdtype(np.asarray(action).dtype, np.integer)
+        if self.discrete:
+            # scan the dataset for the true action-space size
+            act_out = int(self._ds.max(config.action_column)) + 1
+        else:
+            act_out = int(np.prod(np.shape(action)) or 1)
+        self.learner = BCLearner(
+            obs_dim=int(np.prod(obs.shape)), act_out=act_out,
+            discrete=self.discrete, hidden=tuple(config.hidden),
+            lr=config.lr, seed=config.seed)
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        """One shuffled pass over the offline dataset."""
+        t0 = time.monotonic()
+        c = self.config
+        losses = []
+        n = 0
+        for batch in self._ds.random_shuffle().iter_batches(
+                batch_size=c.train_batch_size):
+            obs = np.asarray(batch[c.obs_column], np.float32)
+            acts = np.asarray(batch[c.action_column])
+            if len(obs) < 2:
+                continue
+            losses.append(self.learner.update(obs, acts))
+            n += len(obs)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "num_samples_trained": n,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "samples_per_s": n / max(1e-9, time.monotonic() - t0),
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        """Greedy rollouts of the cloned policy in the configured env."""
+        if self.config.env_name is None:
+            raise ValueError("config.environment(env=...) needed to evaluate")
+        import gymnasium as gym
+
+        env = gym.make(self.config.env_name, **self.config.env_config)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=self.config.seed + ep)
+            total, done = 0.0, False
+            while not done:
+                a = self.learner.act(np.asarray(obs, np.float32).ravel())
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": num_episodes}
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.learner.params)

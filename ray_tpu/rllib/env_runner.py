@@ -64,7 +64,31 @@ class EnvRunner:
         return self.module_to_env(
             {"actions": np.asarray([action])})["actions"][0]
 
+    def _env_action(self, action):
+        """Map a policy action into the env's action space (squashed
+        continuous policies emit tanh-space [-1, 1] vectors)."""
+        if self.policy_kind != "squashed_gaussian":
+            return action
+        space = self.env.action_space
+        low = np.asarray(space.low, np.float32)
+        high = np.asarray(space.high, np.float32)
+        return (low + (np.asarray(action) + 1.0) * 0.5 * (high - low)).astype(
+            np.float32)
+
     def _policy(self, obs: np.ndarray):
+        if self.policy_kind == "squashed_gaussian":
+            # SAC actor: MLP -> (mu, log_std), tanh-squashed sample
+            # (reference: sac.py action sampling); buffers store the
+            # tanh-space action the critics are trained on
+            from ray_tpu.rllib.learner import (LOG_STD_MAX, LOG_STD_MIN,
+                                               mlp_apply)
+
+            out = np.asarray(mlp_apply(self.weights["actor"], obs[None]))[0]
+            d = out.shape[-1] // 2
+            mu = out[:d]
+            log_std = np.clip(out[d:], LOG_STD_MIN, LOG_STD_MAX)
+            u = mu + np.exp(log_std) * self.rng.standard_normal(d)
+            return np.tanh(u).astype(np.float32), 0.0, 0.0
         if self.policy_kind == "epsilon_greedy":
             from ray_tpu.rllib.learner import mlp_apply
 
@@ -92,6 +116,10 @@ class EnvRunner:
         from ray_tpu.rllib.learner import compute_gae, value_fn
 
         assert self.weights is not None, "set_weights before sample"
+        if self.policy_kind == "squashed_gaussian":
+            raise ValueError(
+                "continuous policies use sample_raw (replay-based learners);"
+                " the GAE path has no continuous log-prob support yet")
         probe = self._preprocess(self.obs)
         obs_buf = np.zeros((num_steps, *probe.shape), dtype=np.float32)
         act_buf = np.zeros(num_steps, dtype=np.int32)
@@ -157,7 +185,11 @@ class EnvRunner:
         probe = self._preprocess(self.obs)
         obs_buf = np.zeros((num_steps, *probe.shape), dtype=np.float32)
         next_obs_buf = np.zeros_like(obs_buf)
-        act_buf = np.zeros(num_steps, dtype=np.int32)
+        if self.policy_kind == "squashed_gaussian":
+            act_dim = int(np.prod(self.env.action_space.shape))
+            act_buf = np.zeros((num_steps, act_dim), dtype=np.float32)
+        else:
+            act_buf = np.zeros(num_steps, dtype=np.int32)
         logp_buf = np.zeros(num_steps, dtype=np.float32)
         rew_buf = np.zeros(num_steps, dtype=np.float32)
         term_buf = np.zeros(num_steps, dtype=np.float32)
@@ -167,7 +199,7 @@ class EnvRunner:
         for t in range(num_steps):
             action, logp, _ = self._policy(pobs)
             nxt, reward, terminated, truncated, _ = self.env.step(
-                self._postprocess_action(action))
+                self._postprocess_action(self._env_action(action)))
             pnxt = self._preprocess(nxt)
             obs_buf[t] = pobs
             next_obs_buf[t] = pnxt  # pre-reset successor on episode end

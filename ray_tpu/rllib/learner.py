@@ -21,6 +21,12 @@ import numpy as np
 import optax
 
 
+# squashed-Gaussian log-std bounds, shared by the SAC learner and the
+# env runner's sampling path (they MUST match or the rollout distribution
+# silently diverges from the trained one)
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
 def init_mlp(key, sizes: List[int]) -> List[Dict[str, jnp.ndarray]]:
     """Orthogonal-init MLP params (the PPO-standard init)."""
     params = []

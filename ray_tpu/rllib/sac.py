@@ -1,0 +1,319 @@
+"""SAC on the JAX learner: squashed-Gaussian actor, twin critics, auto-alpha.
+
+Reference surface: rllib/algorithms/sac/ (SACConfig, sac.py training_step:
+sample → replay → critic/actor/alpha updates → polyak target sync) and
+sac_torch_learner.py's losses. TPU-first: the entire update — twin-Q
+Bellman targets with entropy bonus, reparameterized actor loss, temperature
+loss, Adam steps, and the polyak averaging — is ONE jitted function;
+minibatches run back-to-back on device while env runners sample on hosts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.dqn import ReplayBuffer
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import LOG_STD_MAX, LOG_STD_MIN
+
+
+class SACLearner:
+    """Jitted SAC updates: actor, twin critics, temperature, targets."""
+
+    def __init__(self, obs_dim: int, act_dim: int, *, hidden=(256, 256),
+                 actor_lr: float = 3e-4, critic_lr: float = 3e-4,
+                 alpha_lr: float = 3e-4, gamma: float = 0.99,
+                 tau: float = 0.005, target_entropy: Optional[float] = None,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.learner import init_mlp, mlp_apply
+
+        k = jax.random.PRNGKey(seed)
+        ka, k1, k2 = jax.random.split(k, 3)
+        self.params = {
+            "actor": init_mlp(ka, [obs_dim, *hidden, 2 * act_dim]),
+            "q1": init_mlp(k1, [obs_dim + act_dim, *hidden, 1]),
+            "q2": init_mlp(k2, [obs_dim + act_dim, *hidden, 1]),
+            "log_alpha": jnp.zeros(()),
+        }
+        self.target = {
+            "q1": jax.tree.map(lambda x: x, self.params["q1"]),
+            "q2": jax.tree.map(lambda x: x, self.params["q2"]),
+        }
+        # per-subtree learning rates (actor / critics / temperature)
+        labels = {
+            "actor": jax.tree.map(lambda _: "actor", self.params["actor"]),
+            "q1": jax.tree.map(lambda _: "critic", self.params["q1"]),
+            "q2": jax.tree.map(lambda _: "critic", self.params["q2"]),
+            "log_alpha": "alpha",
+        }
+        self.tx = optax.multi_transform(
+            {"actor": optax.adam(actor_lr),
+             "critic": optax.adam(critic_lr),
+             "alpha": optax.adam(alpha_lr)},
+            labels)
+        self.opt_state = self.tx.init(self.params)
+        self.gamma = gamma
+        self.tau = tau
+        self.updates = 0
+        tgt_ent = (-float(act_dim) if target_entropy is None
+                   else float(target_entropy))
+
+        def actor_dist(params, obs):
+            out = mlp_apply(params["actor"], obs)
+            mu, log_std = jnp.split(out, 2, axis=-1)
+            log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+            return mu, log_std
+
+        def sample_action(params, obs, key):
+            mu, log_std = actor_dist(params, obs)
+            std = jnp.exp(log_std)
+            u = mu + std * jax.random.normal(key, mu.shape)
+            a = jnp.tanh(u)
+            # tanh-squashed Gaussian log-prob
+            logp = (-0.5 * (((u - mu) / std) ** 2 + 2 * log_std
+                            + jnp.log(2 * jnp.pi))).sum(-1)
+            logp = logp - jnp.log(1 - a ** 2 + 1e-6).sum(-1)
+            return a, logp
+
+        def q_apply(qp, obs, act):
+            return mlp_apply(qp, jnp.concatenate([obs, act], -1))[:, 0]
+
+        def losses(params, target, batch, key):
+            alpha = jnp.exp(params["log_alpha"])
+            k1_, k2_ = jax.random.split(key)
+            # critic target: entropy-regularized twin-min bootstrap
+            next_a, next_logp = sample_action(params, batch["next_obs"], k1_)
+            tq = jnp.minimum(
+                q_apply(target["q1"], batch["next_obs"], next_a),
+                q_apply(target["q2"], batch["next_obs"], next_a),
+            ) - jax.lax.stop_gradient(alpha) * next_logp
+            y = batch["rewards"] + self.gamma * (
+                1.0 - batch["terminated"]) * jax.lax.stop_gradient(tq)
+            q1 = q_apply(params["q1"], batch["obs"], batch["actions"])
+            q2 = q_apply(params["q2"], batch["obs"], batch["actions"])
+            critic_loss = ((q1 - y) ** 2).mean() + ((q2 - y) ** 2).mean()
+            # actor: reparameterized, against the CURRENT critics
+            a, logp = sample_action(params, batch["obs"], k2_)
+            q_pi = jnp.minimum(
+                q_apply(jax.lax.stop_gradient(params["q1"]),
+                        batch["obs"], a),
+                q_apply(jax.lax.stop_gradient(params["q2"]),
+                        batch["obs"], a),
+            )
+            actor_loss = (jax.lax.stop_gradient(alpha) * logp - q_pi).mean()
+            # temperature: drive entropy toward the target
+            alpha_loss = (-jnp.exp(params["log_alpha"])
+                          * jax.lax.stop_gradient(logp + tgt_ent)).mean()
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "critic_loss": critic_loss, "actor_loss": actor_loss,
+                "alpha": alpha, "entropy": -logp.mean(),
+            }
+
+        def update(params, target, opt_state, batch, key):
+            (_, aux), grads = jax.value_and_grad(losses, has_aux=True)(
+                params, target, batch, key)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree.map(
+                lambda t, p: (1 - self.tau) * t + self.tau * p,
+                target, {"q1": params["q1"], "q2": params["q2"]})
+            return params, target, opt_state, aux
+
+        self._update = jax.jit(update)
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        jb = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "next_obs": jnp.asarray(batch["next_obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.float32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "terminated": jnp.asarray(batch["terminated"], jnp.float32),
+        }
+        self._rng, key = jax.random.split(self._rng)
+        self.params, self.target, self.opt_state, aux = self._update(
+            self.params, self.target, self.opt_state, jb, key)
+        self.updates += 1
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights)
+        self.target = {
+            "q1": jax.tree.map(lambda x: x, self.params["q1"]),
+            "q2": jax.tree.map(lambda x: x, self.params["q2"]),
+        }
+        self.opt_state = self.tx.init(self.params)
+
+
+class SACConfig:
+    """Builder-style config (reference: SACConfig in
+    rllib/algorithms/sac/sac.py)."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.env_config: dict = {}
+        self.num_env_runners = 2
+        self.rollout_fragment_length = 128
+        self.hidden = [256, 256]
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.buffer_size = 100_000
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 64
+        self.learning_starts = 1_000
+        self.seed = 0
+
+    def environment(self, env: str, *, env_config: Optional[dict] = None):
+        self.env_name = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(self, *, num_env_runners: int = 2,
+                    rollout_fragment_length: int = 128):
+        self.num_env_runners = num_env_runners
+        self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, actor_lr: Optional[float] = None,
+                 critic_lr: Optional[float] = None,
+                 gamma: Optional[float] = None, tau: Optional[float] = None,
+                 buffer_size: Optional[int] = None,
+                 train_batch_size: Optional[int] = None,
+                 num_updates_per_iter: Optional[int] = None,
+                 learning_starts: Optional[int] = None,
+                 hidden: Optional[List[int]] = None):
+        for name, value in (
+            ("actor_lr", actor_lr), ("critic_lr", critic_lr),
+            ("gamma", gamma), ("tau", tau), ("buffer_size", buffer_size),
+            ("train_batch_size", train_batch_size),
+            ("num_updates_per_iter", num_updates_per_iter),
+            ("learning_starts", learning_starts), ("hidden", hidden),
+        ):
+            if value is not None:
+                setattr(self, name, value)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    """The algorithm driver (reference: sac.py training_step)."""
+
+    def __init__(self, config: SACConfig):
+        if config.env_name is None:
+            raise ValueError("config.environment(env=...) required")
+        self.config = config
+        import gymnasium as gym
+
+        probe = gym.make(config.env_name, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        act_dim = int(np.prod(probe.action_space.shape))
+        probe.close()
+        self.learner = SACLearner(
+            obs_dim, act_dim, hidden=tuple(config.hidden),
+            actor_lr=config.actor_lr, critic_lr=config.critic_lr,
+            gamma=config.gamma, tau=config.tau, seed=config.seed,
+        )
+        self.env_runners = [
+            EnvRunner.remote(
+                config.env_name, seed=config.seed + 1000 * (i + 1),
+                env_config=config.env_config,
+                policy_kind="squashed_gaussian",
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self.iteration = 0
+        self.total_steps = 0
+        self._sync_weights()
+
+    def _sync_weights(self):
+        # runners only sample the policy: ship the actor subtree, not the
+        # twin critics (2/3 of the bytes) or the temperature
+        w = {"actor": self.learner.get_weights()["actor"]}
+        ray_tpu.get([r.set_weights.remote(w) for r in self.env_runners],
+                    timeout=120)
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        c = self.config
+        batches = ray_tpu.get(
+            [r.sample_raw.remote(c.rollout_fragment_length)
+             for r in self.env_runners],
+            timeout=600,
+        )
+        for b in batches:
+            self.buffer.add_batch(b)
+            self.total_steps += len(b["obs"])
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= c.learning_starts:
+            for _ in range(c.num_updates_per_iter):
+                metrics = self.learner.update(
+                    self.buffer.sample(c.train_batch_size))
+        self._sync_weights()
+        returns: List[float] = []
+        for r in ray_tpu.get(
+            [r.episode_returns.remote() for r in self.env_runners],
+            timeout=120,
+        ):
+            returns.extend(r)
+        self.iteration += 1
+        sampled = sum(len(b["obs"]) for b in batches)
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled": sampled,
+            "num_env_steps_sampled_lifetime": self.total_steps,
+            "env_steps_per_s": sampled / max(1e-9, time.monotonic() - t0),
+            "replay_buffer_size": len(self.buffer),
+            "episode_return_mean": (
+                float(np.mean(returns)) if returns else float("nan")),
+            "num_episodes": len(returns),
+            **metrics,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights):
+        self.learner.set_weights(weights)
+        self._sync_weights()
+
+    def save_checkpoint(self, path: str):
+        import pickle
+
+        with open(path, "wb") as f:
+            pickle.dump(self.learner.get_weights(), f)
+        return path
+
+    def restore_checkpoint(self, path: str):
+        import pickle
+
+        with open(path, "rb") as f:
+            self.set_weights(pickle.load(f))
+
+    def stop(self):
+        for r in self.env_runners:
+            ray_tpu.kill(r)

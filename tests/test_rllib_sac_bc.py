@@ -1,0 +1,113 @@
+"""SAC (continuous control) + offline BC via ray_tpu.data
+(VERDICT r3 next #10; reference: rllib/algorithms/sac/, rllib/algorithms/bc/
++ rllib/offline/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import BC, BCConfig, SAC, SACConfig
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_sac_learner_update_shapes():
+    from ray_tpu.rllib.sac import SACLearner
+
+    learner = SACLearner(obs_dim=3, act_dim=1, hidden=(32, 32), seed=0)
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": rng.normal(size=(16, 3)).astype(np.float32),
+        "next_obs": rng.normal(size=(16, 3)).astype(np.float32),
+        "actions": np.tanh(rng.normal(size=(16, 1))).astype(np.float32),
+        "rewards": rng.normal(size=16).astype(np.float32),
+        "terminated": np.zeros(16, np.float32),
+    }
+    m1 = learner.update(batch)
+    for _ in range(4):
+        m = learner.update(batch)
+    assert np.isfinite(m["critic_loss"]) and np.isfinite(m["actor_loss"])
+    assert m["alpha"] > 0
+    # weights round-trip
+    w = learner.get_weights()
+    learner.set_weights(w)
+    assert np.isfinite(learner.update(batch)["critic_loss"])
+    assert m1 is not m
+
+
+def test_sac_pendulum_improves(ray_init):
+    """The VERDICT done-criterion: Pendulum SAC reaches a return threshold
+    in CI like PPO/DQN do (random policy: ~-1200..-1600; learning shows as
+    clear improvement / crossing -1000)."""
+    algo = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_env_runners=2, rollout_fragment_length=250)
+        .training(actor_lr=1e-3, critic_lr=1e-3, tau=0.02,
+                  train_batch_size=128, num_updates_per_iter=150,
+                  learning_starts=500, hidden=[64, 64])
+        .build()
+    )
+    results = [algo.train() for _ in range(16)]
+    assert results[-1]["training_iteration"] == 16
+    assert results[-1]["replay_buffer_size"] > 2000
+    early = [r["episode_return_mean"] for r in results[:3]
+             if np.isfinite(r["episode_return_mean"])]
+    late = [r["episode_return_mean"] for r in results[-3:]
+            if np.isfinite(r["episode_return_mean"])]
+    assert late, "no completed episodes late in training"
+    # tuned settings reach late ~-300..-650 from early ~-1100 across seeds
+    assert np.mean(late) > np.mean(early) + 200 or np.mean(late) > -700, (
+        f"no learning: early={early} late={late}")
+    # entropy temperature adapted away from its init
+    assert results[-1]["alpha"] != pytest.approx(1.0)
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".pkl") as f:
+        algo.save_checkpoint(f.name)
+        algo.restore_checkpoint(f.name)
+    algo.stop()
+
+
+def _cartpole_expert(obs):
+    """Scripted expert: push in the direction the pole is falling."""
+    return int(obs[2] + 0.5 * obs[3] > 0)
+
+
+def test_bc_clones_expert_from_dataset(ray_init):
+    """Offline BC reads {obs, action} rows from a ray_tpu.data Dataset and
+    clones a scripted CartPole expert well enough to hit its return."""
+    import gymnasium as gym
+
+    import ray_tpu.data as rtd
+
+    env = gym.make("CartPole-v1")
+    rows = []
+    obs, _ = env.reset(seed=0)
+    for _ in range(4000):
+        a = _cartpole_expert(obs)
+        rows.append({"obs": np.asarray(obs, np.float32), "action": a})
+        obs, _r, term, trunc, _ = env.step(a)
+        if term or trunc:
+            obs, _ = env.reset()
+    env.close()
+    ds = rtd.from_items(rows, parallelism=4)
+
+    algo = (
+        BCConfig()
+        .environment("CartPole-v1")
+        .offline_data(ds, obs_column="obs", action_column="action")
+        .training(lr=1e-3, train_batch_size=256, hidden=[64, 64])
+        .build()
+    )
+    losses = [algo.train()["loss"] for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+    ev = algo.evaluate(num_episodes=3)
+    # the scripted expert scores ~120-200; the clone must be in its league
+    # (a random policy scores ~20)
+    assert ev["episode_return_mean"] > 80, ev
