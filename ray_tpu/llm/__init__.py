@@ -206,6 +206,28 @@ class LLMEngine:
         async for tok in gen:
             yield int(tok)
 
+    @_rt.method(num_returns="streaming")
+    async def completions_stream_prefilled(self, prompt_ids, kv,
+                                           max_tokens: Optional[int] = None,
+                                           temperature: Optional[float] = None,
+                                           seed: Optional[int] = None):
+        """Decode side of prefill/decode disaggregation: admit with KV
+        block contents transferred from a remote PrefillWorker (reference:
+        serving_patterns/prefill_decode + vLLM KV transfer connectors)."""
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+        gen = self.engine.generate_stream(
+            list(prompt_ids),
+            max_tokens=(self.config.max_new_tokens
+                        if max_tokens is None else max_tokens),
+            temperature=(self.config.temperature
+                         if temperature is None else temperature),
+            seed=self.config.seed if seed is None else seed,
+            prefilled=tuple(kv),
+        )
+        async for tok in gen:
+            yield int(tok)
+
     async def stats(self) -> Dict[str, Any]:
         s = self.engine.stats()
         elapsed = max(time.monotonic() - (self._t0 or time.monotonic()),

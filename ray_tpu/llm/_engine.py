@@ -216,6 +216,11 @@ class _Request:
     slot: int = -1
     produced: int = 0
     admitted_mid_decode: bool = False
+    # disaggregated serving: prefill ran on ANOTHER worker; admission
+    # injects the transferred KV blocks instead of running _prefill
+    # (reference: serving_patterns/prefill_decode — KV transfer between
+    # prefill and decode engines)
+    prefilled: Optional[tuple] = None  # (k [L,nb,bs,kvh,hd], v, last_logits)
 
 
 class PagedEngine:
@@ -247,6 +252,7 @@ class PagedEngine:
         self._decode = _make_decode_step(cfg, e)
         self._prefill = _make_prefill(cfg, e)
         self._pending: "asyncio.Queue[_Request]" = None  # type: ignore
+        self._inject = None  # lazy jitted donated KV scatter (P/D admission)
         self._loop_task = None
         self._rid = 0
         self._rngs = np.zeros((B, 2), np.uint32)
@@ -305,6 +311,8 @@ class PagedEngine:
             slot = next(i for i, r in enumerate(self.slot_req) if r is None)
         except StopIteration:
             return False
+        if req.prefilled is not None:
+            return self._admit_prefilled(req, slot, need)
         blocks = [self.free_blocks.pop() for _ in range(need)]
         try:
             row = np.zeros((self.max_blocks,), np.int32)
@@ -320,14 +328,7 @@ class PagedEngine:
             logits, self.kc, self.vc = self._prefill(
                 S, self.params, self.kc, self.vc, jnp.asarray(row),
                 jnp.asarray(prompt), jnp.int32(plen))
-            key = jax.random.PRNGKey(req.seed * 1000003 + req.rid)
-            if req.temperature > 0:
-                tok = int(jax.random.categorical(
-                    key, logits / max(req.temperature, 1e-6)))
-            else:
-                tok = int(np.argmax(np.asarray(logits)))
-            self._rngs[slot] = np.asarray(
-                jax.random.key_data(jax.random.fold_in(key, 7)), np.uint32)
+            tok = self._sample_first(req, slot, logits)
         except BaseException:
             # any failure between the block pop and slot activation (prefill
             # trace/compile error, XLA OOM in sampling) must hand the blocks
@@ -337,15 +338,7 @@ class PagedEngine:
             self.free_blocks.extend(blocks)
             self.tables[slot] = 0
             raise
-        self.slot_req[slot] = req
-        if req.admitted_mid_decode:
-            self.mid_decode_admissions += 1
-        req.slot = slot
-        self.lens[slot] = plen
-        self.active[slot] = True
-        self.last_tok[slot] = tok
-        self.temps[slot] = req.temperature
-        self._emit(req, tok)
+        self._activate_slot(req, slot, tok)
         return True
 
     def _emit(self, req: _Request, tok: int):
@@ -374,6 +367,80 @@ class PagedEngine:
         self.active[slot] = False
         self.slot_req[slot] = None
         req.slot = -1
+
+    def _sample_first(self, req: _Request, slot: int, logits):
+        """Sample the first generated token + seed the slot's decode RNG —
+        shared by local and prefilled admission (the seed formula and the
+        fold_in MUST match or the two paths diverge)."""
+        import jax
+
+        key = jax.random.PRNGKey(req.seed * 1000003 + req.rid)
+        if req.temperature > 0:
+            tok = int(jax.random.categorical(
+                key, logits / max(req.temperature, 1e-6)))
+        else:
+            tok = int(np.argmax(np.asarray(logits)))
+        self._rngs[slot] = np.asarray(
+            jax.random.key_data(jax.random.fold_in(key, 7)), np.uint32)
+        return tok
+
+    def _activate_slot(self, req: _Request, slot: int, tok: int):
+        """Final admission bookkeeping shared by both admission paths."""
+        self.slot_req[slot] = req
+        if req.admitted_mid_decode:
+            self.mid_decode_admissions += 1
+        req.slot = slot
+        self.lens[slot] = len(req.prompt)
+        self.active[slot] = True
+        self.last_tok[slot] = tok
+        self.temps[slot] = req.temperature
+        self._emit(req, tok)
+
+    def _admit_prefilled(self, req: _Request, slot: int, need: int) -> bool:
+        """Admit a request whose prefill ran on ANOTHER worker: scatter the
+        transferred KV block contents into this engine's pool and seed the
+        first token from the transferred last-position logits — the decode
+        side of prefill/decode disaggregation (reference:
+        serving_patterns/prefill_decode/builder.py:236-238 + the vLLM KV
+        transfer connectors)."""
+        import jax
+        import jax.numpy as jnp
+
+        k_in, v_in, last_logits = req.prefilled
+        nb = k_in.shape[1]
+        expect = -(-len(req.prompt) // self.bs)
+        if nb != expect or nb > need:
+            # malformed transfer: failing the REQUEST (not returning False,
+            # which _run_loop reads as "wait for resources") keeps the
+            # admission queue moving
+            req.queue.put_nowait(ValueError(
+                f"transferred KV has {nb} blocks; prompt of "
+                f"{len(req.prompt)} tokens needs {expect} "
+                f"(budget {need})"))
+            return True
+        blocks = [self.free_blocks.pop() for _ in range(need)]
+        try:
+            row = np.zeros((self.max_blocks,), np.int32)
+            row[: len(blocks)] = blocks
+            self.tables[slot] = row
+            if self._inject is None:
+                self._inject = jax.jit(
+                    lambda kc, vc, phys, k, v: (kc.at[:, phys].set(k),
+                                                vc.at[:, phys].set(v)),
+                    donate_argnums=(0, 1),
+                )
+            phys = jnp.asarray(np.asarray(blocks[:nb], np.int32))
+            self.kc, self.vc = self._inject(
+                self.kc, self.vc, phys,
+                jnp.asarray(k_in, self.kc.dtype),
+                jnp.asarray(v_in, self.vc.dtype))
+            tok = self._sample_first(req, slot, jnp.asarray(last_logits))
+        except BaseException:
+            self.free_blocks.extend(blocks)
+            self.tables[slot] = 0
+            raise
+        self._activate_slot(req, slot, tok)
+        return True
 
     # -- engine loop ----------------------------------------------------
 
@@ -473,9 +540,12 @@ class PagedEngine:
 
     async def generate_stream(self, prompt_ids: List[int], *,
                               max_tokens: int = 32,
-                              temperature: float = 0.0, seed: int = 0):
+                              temperature: float = 0.0, seed: int = 0,
+                              prefilled: Optional[tuple] = None):
         """Async generator of token ids. Engine-side failures raise into the
-        consumer (queue items: int token | None end | Exception)."""
+        consumer (queue items: int token | None end | Exception).
+        `prefilled=(k, v, last_logits)` admits with KV transferred from a
+        remote prefill worker instead of running prefill here."""
         if len(prompt_ids) + 1 > self.ecfg.max_model_len:
             raise ValueError(
                 f"prompt of {len(prompt_ids)} tokens exceeds "
@@ -484,7 +554,7 @@ class PagedEngine:
         self._rid += 1
         req = _Request(self._rid, list(prompt_ids), int(max_tokens),
                        float(temperature), int(seed),
-                       queue=asyncio.Queue())
+                       queue=asyncio.Queue(), prefilled=prefilled)
         self._pending.put_nowait(req)
         while True:
             tok = await req.queue.get()

@@ -84,6 +84,9 @@ class BCConfig:
         self.lr = 1e-3
         self.train_batch_size = 256
         self.seed = 0
+        # continuous datasets logged in tanh-space (the SAC runner's
+        # convention) need squash+rescale to env bounds at evaluation
+        self.action_squash = False
 
     def environment(self, env: str, *, env_config: Optional[dict] = None):
         """Optional: used only by evaluate()."""
@@ -103,10 +106,12 @@ class BCConfig:
 
     def training(self, *, lr: Optional[float] = None,
                  train_batch_size: Optional[int] = None,
-                 hidden: Optional[List[int]] = None):
+                 hidden: Optional[List[int]] = None,
+                 action_squash: Optional[bool] = None):
         for name, value in (("lr", lr),
                             ("train_batch_size", train_batch_size),
-                            ("hidden", hidden)):
+                            ("hidden", hidden),
+                            ("action_squash", action_squash)):
             if value is not None:
                 setattr(self, name, value)
         return self
@@ -168,13 +173,28 @@ class BC:
         import gymnasium as gym
 
         env = gym.make(self.config.env_name, **self.config.env_config)
+
+        def to_env_action(a):
+            if self.discrete:
+                return a
+            space = env.action_space
+            low = np.asarray(space.low, np.float32)
+            high = np.asarray(space.high, np.float32)
+            if self.config.action_squash:
+                # tanh-space dataset actions: squash + rescale to bounds
+                # (mirrors EnvRunner._env_action)
+                a = np.tanh(np.asarray(a, np.float32))
+                return (low + (a + 1.0) * 0.5 * (high - low)).astype(
+                    np.float32)
+            return np.clip(np.asarray(a, np.float32), low, high)
+
         returns = []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=self.config.seed + ep)
             total, done = 0.0, False
             while not done:
                 a = self.learner.act(np.asarray(obs, np.float32).ravel())
-                obs, r, term, trunc, _ = env.step(a)
+                obs, r, term, trunc, _ = env.step(to_env_action(a))
                 total += float(r)
                 done = term or trunc
             returns.append(total)

@@ -66,7 +66,7 @@ def test_llm_server_completions():
 
 @pytest.fixture(scope="module")
 def ray_init():
-    info = ray_tpu.init(num_cpus=4)
+    info = ray_tpu.init(num_cpus=10)
     yield info
     try:
         from ray_tpu import serve
@@ -143,3 +143,43 @@ def test_openai_app_sse_streaming(ray_init):
     # token chunks (all but the finish chunk) carry incremental text
     assert len(chunks) >= 2
     assert chunks[-1]["choices"][0].get("finish_reason") in ("stop", "length")
+
+
+def test_prefill_decode_app_over_serve(ray_init):
+    """P/D disaggregation end-to-end (VERDICT missing #6): prompt ->
+    prefill worker -> KV transfer -> decode engine; repeated prompts hit
+    the prefill cache and stick to the same decode replica."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serving_patterns import build_pd_app
+
+    for name in list(serve.status()):
+        serve.delete(name)  # reclaim CPUs from earlier tests' deployments
+    handle = build_pd_app(
+        LLMConfig(max_new_tokens=4), num_prefill=1, num_decode=2,
+        deployment_name="pd_app")
+    out = handle.remote({"prompt": "hello", "max_tokens": 4}).result(
+        timeout=300)
+    assert out["object"] == "text_completion"
+    assert out["usage"]["completion_tokens"] >= 1
+    out2 = handle.remote({"prompt": "hello", "max_tokens": 4}).result(
+        timeout=300)
+    assert out2["usage"]["prefill_cache_hits"] >= 1
+    # KV-aware routing: identical prompts share a decode replica
+    assert out2["usage"]["decode_replica"] == out["usage"]["decode_replica"]
+    serve.delete("pd_app")
+
+
+def test_dp_engine_gang_over_serve(ray_init):
+    """Data-parallel engine gang behind one route (VERDICT missing #6)."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serving_patterns import build_dp_app
+
+    for name in list(serve.status()):
+        serve.delete(name)
+    handle = build_dp_app(
+        LLMConfig(max_new_tokens=3), dp_size=2, deployment_name="dp_app")
+    outs = [handle.remote({"prompt": f"p{i}"}).result(timeout=300)
+            for i in range(4)]
+    assert all(o["usage"]["completion_tokens"] >= 1 for o in outs)
+    assert {o["usage"]["dp_rank"] for o in outs} <= {0, 1}
+    serve.delete("dp_app")
