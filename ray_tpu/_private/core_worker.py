@@ -53,6 +53,14 @@ from ray_tpu.runtime.rpc import RpcClient, RpcConnectionLost, RpcServer
 
 logger = logging.getLogger(__name__)
 
+
+def _trace_inject():
+    """Outgoing trace context (None when tracing is off — the common case
+    costs one function call and an env lookup)."""
+    from ray_tpu.util.tracing import inject_context
+
+    return inject_context()
+
 MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
 
@@ -1387,6 +1395,7 @@ class CoreWorker:
         task_id = self.next_task_id()
         wire_args, pyrefs, pending = self.serialize_args_sync(args, kwargs)
         spec = TaskSpec(
+            trace_ctx=_trace_inject(),
             task_id=task_id,
             job_id=self.job_id,
             kind=pb.TASK_KIND_NORMAL,
@@ -1523,6 +1532,7 @@ class CoreWorker:
             self.job_id, ActorID(actor_id), self.current_task_id, self._next_seq(st)
         )
         spec = TaskSpec(
+            trace_ctx=_trace_inject(),
             task_id=task_id,
             job_id=self.job_id,
             kind=pb.TASK_KIND_ACTOR_TASK,
@@ -2512,6 +2522,7 @@ class CoreWorker:
         wire_args = await self.serialize_args(args, kwargs)
         pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
         spec = TaskSpec(
+            trace_ctx=_trace_inject(),
             task_id=TaskID.for_actor_creation(actor_id),
             job_id=self.job_id,
             kind=pb.TASK_KIND_ACTOR_CREATION,
@@ -2580,6 +2591,7 @@ class CoreWorker:
             self.job_id, ActorID(actor_id), self.current_task_id, self._next_seq(st)
         )
         spec = TaskSpec(
+            trace_ctx=_trace_inject(),
             task_id=task_id,
             job_id=self.job_id,
             kind=pb.TASK_KIND_ACTOR_TASK,

@@ -213,6 +213,11 @@ class TaskSpec:
     # pushed (with this flag) so its sequence slot advances on the executor
     # instead of leaving a hole that stalls successors.
     cancelled: bool = False
+    # opt-in distributed tracing: {"trace_id", "parent_span_id"} injected at
+    # submission and extracted around execution so spans chain across
+    # processes (reference: util/tracing/tracing_helper.py:181
+    # _DictPropagator.inject into TaskSpec)
+    trace_ctx: Optional[dict] = None
 
     @property
     def is_streaming(self) -> bool:
@@ -263,6 +268,7 @@ class TaskSpec:
             "name": self.name,
             "stream_backpressure": self.stream_backpressure,
             "cancelled": self.cancelled,
+            "trace_ctx": self.trace_ctx,
         }
 
     @classmethod
@@ -295,6 +301,7 @@ class TaskSpec:
             name=w.get("name", ""),
             stream_backpressure=w.get("stream_backpressure", -1),
             cancelled=w.get("cancelled", False),
+            trace_ctx=w.get("trace_ctx"),
         )
 
 

@@ -102,6 +102,24 @@ class TaskExecutor:
             atask.cancel()
         return {"ok": True, "running": running}
 
+    def _record_span(self, spec):
+        """Span recorder bound to one spec: emits a SPAN task event carrying
+        trace/span/parent ids (read back by util.tracing.list_spans and the
+        timeline; reference: spans flushed through the task-event plane)."""
+        def rec(span):
+            self.cw.task_events.record(
+                task_id=spec.task_id.binary(),
+                name=span["name"], kind=spec.kind, event="SPAN",
+                worker_id=self.cw.worker_id.binary(),
+                node_id=self.cw.node_id_hex or "",
+                ts=span["start"],
+                duration_s=span["end"] - span["start"],
+                extra={"trace_id": span["trace_id"],
+                       "span_id": span["span_id"],
+                       "parent_span_id": span["parent_span_id"]},
+            )
+        return rec
+
     def _call_traced(self, tid: bytes, fn, *args, **kwargs):
         """Run `fn` on this pool thread with the thread ident registered so
         cancel() can raise into it. The ident is cleared before returning;
@@ -296,8 +314,12 @@ class TaskExecutor:
                 # puts inside the fn derive ids from the current task
                 self.cw.current_task_id = spec.task_id
                 try:
-                    outs.append(
-                        (self._call_traced(tid, fn, *args, **kwargs), None))
+                    from ray_tpu.util.tracing import execution_span
+
+                    with execution_span(spec, self._record_span(spec)):
+                        outs.append(
+                            (self._call_traced(tid, fn, *args, **kwargs),
+                             None))
                 except BaseException as e:  # noqa: BLE001 — per-task error
                     outs.append((None, e))
             return outs
@@ -408,9 +430,16 @@ class TaskExecutor:
             fn = await self.cw.fetch_function(spec.function_key)
             args, kwargs = await self._resolve_args(spec.args)
             self.cw.current_task_id = spec.task_id
-            result = await self._invoke(tid, fn, args, kwargs)
-            if spec.is_streaming:
-                return await self._stream_out(spec, result)
+            from ray_tpu.util.tracing import bind_span, execution_span
+
+            with execution_span(spec, self._record_span(spec)) as span:
+                if span is not None and not inspect.iscoroutinefunction(fn):
+                    fn = bind_span(fn, span)
+                result = await self._invoke(tid, fn, args, kwargs)
+                if spec.is_streaming:
+                    # the generator body runs during iteration: the span
+                    # must cover it, not just construction
+                    return await self._stream_out(spec, result)
             return await self._returns_reply(spec, result)
         except BaseException as e:  # noqa: BLE001 — all errors cross the wire
             return self._error_reply(spec, e)
@@ -462,9 +491,14 @@ class TaskExecutor:
                         max_workers=max(1, gmax),
                         thread_name_prefix=f"actor-cg-{gname}",
                     )
-            self.actor_instance = await asyncio.get_running_loop().run_in_executor(
-                self.thread_pool, lambda: cls(*args, **kwargs)
-            )
+            from ray_tpu.util.tracing import bind_span, execution_span
+
+            with execution_span(spec, self._record_span(spec)) as span:
+                ctor = (lambda: cls(*args, **kwargs)) if span is None \
+                    else bind_span(lambda: cls(*args, **kwargs), span)
+                self.actor_instance = (
+                    await asyncio.get_running_loop().run_in_executor(
+                        self.thread_pool, ctor))
             return {"returns": []}
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(spec, e)
@@ -591,20 +625,27 @@ class TaskExecutor:
                     f"concurrency group {group!r} (declared: "
                     f"{sorted(declared) or 'none'})"
                 )
-            if is_async:
-                sem = self._group_sems.get(group, self._actor_sem)
-                async with sem:
-                    if inspect.iscoroutinefunction(method):
-                        result = await self._invoke(tid, method, args, kwargs)
-                    else:
-                        result = method(*args, **kwargs)
-            else:
-                result = await self._invoke(
-                    tid, method, args, kwargs,
-                    pool=self._group_pools.get(group),
-                )
-            if spec.is_streaming:
-                return await self._stream_out(spec, result)
+            from ray_tpu.util.tracing import bind_span, execution_span
+
+            with execution_span(spec, self._record_span(spec)) as span:
+                if span is not None and not inspect.iscoroutinefunction(
+                        method):
+                    method = bind_span(method, span)
+                if is_async:
+                    sem = self._group_sems.get(group, self._actor_sem)
+                    async with sem:
+                        if inspect.iscoroutinefunction(method):
+                            result = await self._invoke(
+                                tid, method, args, kwargs)
+                        else:
+                            result = method(*args, **kwargs)
+                else:
+                    result = await self._invoke(
+                        tid, method, args, kwargs,
+                        pool=self._group_pools.get(group),
+                    )
+                if spec.is_streaming:
+                    return await self._stream_out(spec, result)
             return await self._returns_reply(spec, result)
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(spec, e)

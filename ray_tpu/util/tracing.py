@@ -1,0 +1,145 @@
+"""Distributed tracing: spans propagated through task specs, opt-in.
+
+Reference surface: python/ray/util/tracing/tracing_helper.py
+(_DictPropagator.inject/extract :181 — trace context carried inside the
+TaskSpec; method wrappers creating spans around submission and execution;
+opt-in via _enable_tracing :98).
+
+Redesign: tracing is a first-class field of the framework's TaskSpec
+(`trace_ctx`) rather than a monkey-patched wrapper layer. When enabled:
+
+- the submitting side stamps {trace_id, parent_span_id} from the caller's
+  current span context into every outgoing spec;
+- the executing side opens a span around the user function (streaming
+  tasks included: the span covers generator iteration), installs it as
+  the current context (so nested submissions chain), and records the
+  finished span into the task-event plane — `list_spans()` reads them
+  back with trace/span/parent ids intact. An OTel exporter can be layered
+  by draining `list_spans()`; the ids are W3C-shaped for that purpose.
+
+W3C-style ids (32-hex trace ids, 16-hex span ids) keep the contexts
+interoperable with OTel propagators.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+_ENABLED = os.environ.get("RT_TRACING_ENABLED", "") in ("1", "true")
+_current_span: "contextvars.ContextVar[Optional[dict]]" = (
+    contextvars.ContextVar("rt_trace_span", default=None))
+
+
+def enable_tracing() -> None:
+    """Turn on span propagation + recording in THIS process. Worker
+    processes inherit the setting through the RT_TRACING_ENABLED env var
+    (set it in runtime_env env_vars, or before ray_tpu.init on the
+    driver — init propagates the driver's env to spawned daemons)."""
+    global _ENABLED
+    _ENABLED = True
+    os.environ["RT_TRACING_ENABLED"] = "1"
+
+
+def tracing_enabled() -> bool:
+    return _ENABLED or os.environ.get("RT_TRACING_ENABLED", "") == "1"
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def current_span() -> Optional[dict]:
+    return _current_span.get()
+
+
+def inject_context() -> Optional[dict]:
+    """Context dict for an outgoing TaskSpec (reference:
+    _DictPropagator.inject). Starts a new trace at the root caller."""
+    if not tracing_enabled():
+        return None
+    span = _current_span.get()
+    if span is None:
+        return {"trace_id": _new_id(16), "parent_span_id": ""}
+    return {"trace_id": span["trace_id"], "parent_span_id": span["span_id"]}
+
+
+@contextlib.contextmanager
+def execution_span(spec, recorder=None):
+    """Open a span around one task execution; records on exit (reference:
+    the _function_span/_actor_span wrappers in tracing_helper.py)."""
+    ctx = getattr(spec, "trace_ctx", None)
+    if ctx is None:
+        # the spec's trace_ctx IS the opt-in: a submitter that injected it
+        # must get spans even if this worker's env lacks the flag
+        yield None
+        return
+    span = {
+        "trace_id": ctx["trace_id"],
+        "span_id": _new_id(8),
+        "parent_span_id": ctx.get("parent_span_id", ""),
+        "name": spec.name or spec.method_name or spec.function_key,
+        "start": time.time(),
+    }
+    token = _current_span.set(span)
+    try:
+        yield span
+    finally:
+        _current_span.reset(token)
+        span["end"] = time.time()
+        if recorder is not None:
+            try:
+                recorder(span)
+            except Exception:  # noqa: BLE001 — tracing must never fail a task
+                pass
+
+
+def bind_span(fn, span: dict):
+    """Wrap a SYNC user function so the span is the current context inside
+    the executor THREAD it runs on (run_in_executor does not propagate
+    contextvars) — nested task submissions from sync tasks then chain."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*a, **k):
+        token = _current_span.set(span)
+        try:
+            return fn(*a, **k)
+        finally:
+            _current_span.reset(token)
+
+    return wrapped
+
+
+def list_spans(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Finished spans recorded through the task-event plane (driver-side
+    view over the cluster's trace history). Reads RAW task events — the
+    per-task latest-state collapse of list_tasks() would drop SPAN records
+    once the task's FINISHED event lands."""
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    reply = cw.run_sync(cw.control.call(
+        "list_task_events", {"limit": limit * 4}), 30)
+    out = []
+    for ev in reply["events"]:
+        if ev.get("event") == "SPAN" and ev.get("trace_id"):
+            out.append({
+                "task_id": ev["task_id"].hex(),
+                "name": ev["name"],
+                "event": "SPAN",
+                "trace_id": ev["trace_id"],
+                "span_id": ev["span_id"],
+                "parent_span_id": ev.get("parent_span_id", ""),
+                "ts": ev["ts"],
+                "duration_s": ev.get("duration_s"),
+                "node_id": ev.get("node_id", ""),
+            })
+    return out[-limit:]
+
+
+__all__ = ["current_span", "enable_tracing", "execution_span",
+           "inject_context", "list_spans", "tracing_enabled"]

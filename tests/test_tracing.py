@@ -1,0 +1,140 @@
+"""Distributed tracing: span propagation through task specs
+(VERDICT missing #8; reference: util/tracing/tracing_helper.py:181 —
+trace context injected into the TaskSpec, spans around execution)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    tracing.enable_tracing()  # before init: workers inherit the env
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_spans_chain_across_nested_tasks(ray_init):
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent(x):
+        # nested submission from inside a task must CHAIN, not start a
+        # fresh trace
+        return ray_tpu.get(child.remote(x), timeout=60) + 10
+
+    assert ray_tpu.get(parent.remote(1), timeout=120) == 12
+
+    deadline = time.time() + 60
+    spans = []
+    while time.time() < deadline:
+        spans = [s for s in tracing.list_spans()
+                 if s.get("event") == "SPAN"
+                 and s["name"].split(".")[-1] in ("parent", "child")
+                 or (s.get("event") == "SPAN"
+                     and ("parent" in s["name"] or "child" in s["name"]))]
+        if len(spans) >= 2:
+            break
+        time.sleep(0.5)
+    assert len(spans) >= 2, spans
+    par = next(s for s in spans if "parent" in s["name"])
+    chi = next(s for s in spans if "child" in s["name"])
+    assert par["trace_id"] == chi["trace_id"], "nested call split the trace"
+    assert chi["parent_span_id"] == par["span_id"], (
+        "child span not parented to the caller's span")
+    assert par["parent_span_id"] == ""  # driver-rooted trace
+    assert par["duration_s"] >= 0
+
+
+def test_actor_method_spans(ray_init):
+    @ray_tpu.remote
+    class Svc:
+        def work(self, x):
+            return x * 2
+
+    a = Svc.remote()
+    assert ray_tpu.get(a.work.remote(4), timeout=120) == 8
+    deadline = time.time() + 60
+    got = []
+    while time.time() < deadline:
+        got = [s for s in tracing.list_spans()
+               if s.get("event") == "SPAN" and s["name"] == "work"]
+        if got:
+            break
+        time.sleep(0.5)
+    assert got, "actor method produced no span"
+    assert got[0]["trace_id"] and got[0]["span_id"]
+
+
+def test_tracing_off_adds_no_context():
+    from ray_tpu._private.protocol import TaskSpec
+    from ray_tpu.util import tracing as tr
+
+    old = tr._ENABLED
+    import os
+
+    env_old = os.environ.pop("RT_TRACING_ENABLED", None)
+    tr._ENABLED = False
+    try:
+        assert tr.inject_context() is None
+        spec = TaskSpec.from_wire(TaskSpec(
+            task_id=__import__("ray_tpu._private.ids", fromlist=["TaskID"])
+            .TaskID.nil(), job_id=__import__(
+                "ray_tpu._private.ids", fromlist=["JobID"]).JobID.nil(),
+        ).to_wire())
+        assert spec.trace_ctx is None
+    finally:
+        tr._ENABLED = old
+        if env_old is not None:
+            os.environ["RT_TRACING_ENABLED"] = env_old
+
+
+def test_actor_init_and_streaming_spans(ray_init):
+    """Spans cover actor __init__ (nested submissions chain from it) and
+    the full iteration of streaming tasks."""
+    @ray_tpu.remote
+    def leaf():
+        return 1
+
+    @ray_tpu.remote
+    class Nester:
+        def __init__(self):
+            self.n = ray_tpu.get(leaf.remote(), timeout=60)
+
+        def get(self):
+            return self.n
+
+    a = Nester.remote()
+    assert ray_tpu.get(a.get.remote(), timeout=120) == 1
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(3):
+            time.sleep(0.05)
+            yield i
+
+    assert [ray_tpu.get(r, timeout=60) for r in gen.remote()] == [0, 1, 2]
+
+    deadline = time.time() + 60
+    spans = []
+    while time.time() < deadline:
+        spans = tracing.list_spans()
+        names = {s["name"] for s in spans}
+        if (any("leaf" in n for n in names)
+                and any("gen" in n for n in names)
+                and any("Nester" in n for n in names)):
+            break
+        time.sleep(0.5)
+    leaf_s = next(s for s in spans if "leaf" in s["name"])
+    init_s = next(s for s in spans if "Nester" in s["name"])
+    assert leaf_s["trace_id"] == init_s["trace_id"]
+    assert leaf_s["parent_span_id"] == init_s["span_id"]
+    gen_s = next(s for s in spans if "gen" in s["name"])
+    # span covers iteration (3 x 50ms), not just generator construction
+    assert gen_s["duration_s"] > 0.1, gen_s
