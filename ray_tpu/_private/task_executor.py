@@ -37,6 +37,7 @@ from ray_tpu._private import serialization as ser
 from ray_tpu._private.errors import TaskCancelledError, TaskError
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.runtime.object_store import META_NORMAL
+from ray_tpu.util.tracing import bind_generator, bind_span, execution_span
 
 logger = logging.getLogger(__name__)
 
@@ -314,9 +315,9 @@ class TaskExecutor:
                 # puts inside the fn derive ids from the current task
                 self.cw.current_task_id = spec.task_id
                 try:
-                    from ray_tpu.util.tracing import execution_span
-
-                    with execution_span(spec, self._record_span(spec)):
+                    rec = (self._record_span(spec) if spec.trace_ctx
+                           else None)
+                    with execution_span(spec, rec):
                         outs.append(
                             (self._call_traced(tid, fn, *args, **kwargs),
                              None))
@@ -430,15 +431,17 @@ class TaskExecutor:
             fn = await self.cw.fetch_function(spec.function_key)
             args, kwargs = await self._resolve_args(spec.args)
             self.cw.current_task_id = spec.task_id
-            from ray_tpu.util.tracing import bind_span, execution_span
-
-            with execution_span(spec, self._record_span(spec)) as span:
+            rec = self._record_span(spec) if spec.trace_ctx else None
+            with execution_span(spec, rec) as span:
                 if span is not None and not inspect.iscoroutinefunction(fn):
                     fn = bind_span(fn, span)
                 result = await self._invoke(tid, fn, args, kwargs)
                 if spec.is_streaming:
-                    # the generator body runs during iteration: the span
-                    # must cover it, not just construction
+                    # the generator body runs during iteration (on pool
+                    # threads): the span must cover it, not just
+                    # construction
+                    if span is not None and inspect.isgenerator(result):
+                        result = bind_generator(result, span)
                     return await self._stream_out(spec, result)
             return await self._returns_reply(spec, result)
         except BaseException as e:  # noqa: BLE001 — all errors cross the wire
@@ -491,9 +494,8 @@ class TaskExecutor:
                         max_workers=max(1, gmax),
                         thread_name_prefix=f"actor-cg-{gname}",
                     )
-            from ray_tpu.util.tracing import bind_span, execution_span
-
-            with execution_span(spec, self._record_span(spec)) as span:
+            rec = self._record_span(spec) if spec.trace_ctx else None
+            with execution_span(spec, rec) as span:
                 ctor = (lambda: cls(*args, **kwargs)) if span is None \
                     else bind_span(lambda: cls(*args, **kwargs), span)
                 self.actor_instance = (
@@ -625,9 +627,8 @@ class TaskExecutor:
                     f"concurrency group {group!r} (declared: "
                     f"{sorted(declared) or 'none'})"
                 )
-            from ray_tpu.util.tracing import bind_span, execution_span
-
-            with execution_span(spec, self._record_span(spec)) as span:
+            rec = self._record_span(spec) if spec.trace_ctx else None
+            with execution_span(spec, rec) as span:
                 if span is not None and not inspect.iscoroutinefunction(
                         method):
                     method = bind_span(method, span)
@@ -645,6 +646,8 @@ class TaskExecutor:
                         pool=self._group_pools.get(group),
                     )
                 if spec.is_streaming:
+                    if span is not None and inspect.isgenerator(result):
+                        result = bind_generator(result, span)
                     return await self._stream_out(spec, result)
             return await self._returns_reply(spec, result)
         except BaseException as e:  # noqa: BLE001

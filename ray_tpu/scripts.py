@@ -170,6 +170,36 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """`ray_tpu serve run|deploy|status|shutdown` (reference: the serve CLI
+    in python/ray/serve/scripts.py driving config-file deploys)."""
+    import json
+    import time
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.schema import deploy_config
+
+    ray_tpu.init(address=_resolve_address(args.address))
+    if args.serve_cmd in ("run", "deploy"):
+        handles = deploy_config(args.config)
+        base = handles.pop("_http", "")
+        print(json.dumps({"applications": sorted(handles),
+                          "http": base}))
+        if args.serve_cmd == "run" and not args.non_blocking:
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                serve.shutdown()
+        return 0
+    if args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+        return 0
+    serve.shutdown()
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ray_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -206,6 +236,19 @@ def main(argv=None) -> int:
         j.add_argument("id")
     jsub.add_parser("list")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser(
+        "serve", help="deploy serve applications from a config file")
+    sp.add_argument("--address", default="")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    sr = ssub.add_parser("run", help="deploy a config and block")
+    sr.add_argument("config")
+    sr.add_argument("--non-blocking", action="store_true")
+    sd = ssub.add_parser("deploy", help="deploy a config and return")
+    sd.add_argument("config")
+    ssub.add_parser("status")
+    ssub.add_parser("shutdown")
+    sp.set_defaults(fn=cmd_serve)
 
     args = ap.parse_args(argv)
     return args.fn(args)

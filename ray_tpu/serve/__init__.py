@@ -167,8 +167,12 @@ def shutdown():
         pass
 
 
+from ray_tpu.serve.schema import build_config, deploy_config  # noqa: E402
+
 __all__ = [
     "batch",
+    "build_config",
+    "deploy_config",
     "multiplexed",
     "get_multiplexed_model_id",
     "Deployment",

@@ -45,7 +45,8 @@ def enable_tracing() -> None:
 
 
 def tracing_enabled() -> bool:
-    return _ENABLED or os.environ.get("RT_TRACING_ENABLED", "") == "1"
+    return _ENABLED or os.environ.get(
+        "RT_TRACING_ENABLED", "") in ("1", "true")
 
 
 def _new_id(nbytes: int) -> str:
@@ -114,16 +115,33 @@ def bind_span(fn, span: dict):
     return wrapped
 
 
+def bind_generator(gen, span: dict):
+    """Wrap a SYNC generator so each body step runs with the span current —
+    the body executes on arbitrary pool threads during streaming iteration
+    (run_in_executor), where the construction-time binding is invisible."""
+
+    def it():
+        while True:
+            token = _current_span.set(span)
+            try:
+                item = next(gen)
+            except StopIteration:
+                return
+            finally:
+                _current_span.reset(token)
+            yield item
+
+    return it()
+
+
 def list_spans(limit: int = 1000) -> List[Dict[str, Any]]:
     """Finished spans recorded through the task-event plane (driver-side
     view over the cluster's trace history). Reads RAW task events — the
     per-task latest-state collapse of list_tasks() would drop SPAN records
     once the task's FINISHED event lands."""
-    from ray_tpu._private.core_worker import get_core_worker
+    from ray_tpu.util.state import _control_call
 
-    cw = get_core_worker()
-    reply = cw.run_sync(cw.control.call(
-        "list_task_events", {"limit": limit * 4}), 30)
+    reply = _control_call("list_task_events", {"limit": limit * 4})
     out = []
     for ev in reply["events"]:
         if ev.get("event") == "SPAN" and ev.get("trace_id"):
@@ -141,5 +159,6 @@ def list_spans(limit: int = 1000) -> List[Dict[str, Any]]:
     return out[-limit:]
 
 
-__all__ = ["current_span", "enable_tracing", "execution_span",
-           "inject_context", "list_spans", "tracing_enabled"]
+__all__ = ["bind_generator", "bind_span", "current_span", "enable_tracing",
+           "execution_span", "inject_context", "list_spans",
+           "tracing_enabled"]

@@ -417,3 +417,50 @@ def test_http_proxy_draining(ray_init):
     assert r.status_code == 503
     hz = httpx.get(f"{base}/-/healthz", timeout=30)
     assert hz.status_code == 503
+
+
+def test_config_file_deploy_and_cli_schema(ray_init, tmp_path):
+    """Config-file deploy (reference: serve schema.py + `serve deploy`):
+    applications resolve from import_path with overrides applied."""
+    import sys
+
+    (tmp_path / "my_app.py").write_text(
+        "from ray_tpu import serve\n"
+        "\n"
+        "@serve.deployment(num_replicas=1)\n"
+        "class Adder:\n"
+        "    def __init__(self, inc=1):\n"
+        "        self.inc = inc\n"
+        "    def __call__(self, x):\n"
+        "        return x + self.inc\n"
+        "\n"
+        "adder_app = Adder.bind(inc=5)\n"
+        "\n"
+        "def builder():\n"
+        "    return Adder.options(name='Built').bind(inc=7)\n")
+    sys.path.insert(0, str(tmp_path))
+    try:
+        cfg = {
+            "applications": [
+                {"import_path": "my_app:adder_app", "num_replicas": 2},
+                {"import_path": "my_app:builder"},
+            ],
+        }
+        import yaml
+
+        path = tmp_path / "serve.yaml"
+        path.write_text(yaml.safe_dump(cfg))
+        handles = serve.deploy_config(str(path), start_http=False)
+        assert set(handles) == {"Adder", "Built"}
+        assert handles["Adder"].remote(1).result(timeout=60) == 6
+        assert handles["Built"].remote(1).result(timeout=60) == 8
+        st = serve.status()
+        assert st["Adder"]["running"] == 2
+        # build_config round-trips the shape
+        from ray_tpu.serve import build_config
+
+        built = build_config(
+            serve.Deployment(lambda x: x, "X", num_replicas=3))
+        assert built["applications"][0]["num_replicas"] == 3
+    finally:
+        sys.path.remove(str(tmp_path))

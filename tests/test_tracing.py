@@ -138,3 +138,30 @@ def test_actor_init_and_streaming_spans(ray_init):
     gen_s = next(s for s in spans if "gen" in s["name"])
     # span covers iteration (3 x 50ms), not just generator construction
     assert gen_s["duration_s"] > 0.1, gen_s
+
+
+def test_streaming_generator_body_chains(ray_init):
+    """Nested submissions from INSIDE a sync streaming generator's body
+    (which runs on pool threads during iteration) chain to the task span."""
+    @ray_tpu.remote
+    def inner(i):
+        return i
+
+    @ray_tpu.remote(num_returns="streaming")
+    def streamer():
+        for i in range(2):
+            yield ray_tpu.get(inner.remote(i), timeout=60)
+
+    assert [ray_tpu.get(r, timeout=60) for r in streamer.remote()] == [0, 1]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        spans = tracing.list_spans()
+        outer = [s for s in spans if "streamer" in s["name"]]
+        inners = [s for s in spans if "inner" in s["name"]]
+        if outer and len(inners) >= 2:
+            break
+        time.sleep(0.5)
+    assert outer and len(inners) >= 2
+    for s in inners:
+        assert s["trace_id"] == outer[0]["trace_id"]
+        assert s["parent_span_id"] == outer[0]["span_id"]
