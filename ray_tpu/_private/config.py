@@ -140,6 +140,11 @@ _flag("object_chunk_bytes", 1024 * 1024, "Chunk size for node-to-node object pus
 _flag("max_task_retries_default", 3, "Default retries for idempotent tasks.")
 _flag("actor_max_restarts_default", 0, "Default actor restarts.")
 _flag("memory_store_max_bytes", 256 * 1024 * 1024, "Per-process in-memory store cap.")
+_flag("cgroup_isolation_enabled", False, "Isolate system vs worker processes in a cgroup2 hierarchy (reference: common/cgroup2/cgroup_manager.h). No-op when cgroupfs is unwritable.")
+_flag("cgroup_system_reserved_memory_bytes", 0, "memory.min reservation for the system cgroup (daemon/store processes).")
+_flag("cgroup_worker_memory_high_bytes", 0, "memory.high throttle for the workers cgroup (0 = unset).")
+_flag("cgroup_worker_memory_max_bytes", 0, "memory.max hard cap for the workers cgroup (0 = unset).")
+_flag("cgroup_worker_cpu_weight", 0, "cpu.weight for the workers cgroup (0 = unset).")
 _flag("task_event_buffer_max", 10000, "Profile/task events buffered per worker before drop.")
 _flag("telemetry_flush_period_s", 1.0, "Task-event + metrics flush cadence to the control store.")
 _flag("control_store_port", 0, "Port for the control store (0 = auto).")

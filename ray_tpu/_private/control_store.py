@@ -179,6 +179,12 @@ class ControlStore:
     def __init__(self, persist_dir: Optional[str] = None):
         self.server = RpcServer(name="control_store")
         self.pubsub = PubSub(self.server)
+        # structured cluster events (reference: the export-event pipeline —
+        # export_*.proto schemas + dashboard/modules/aggregator/
+        # aggregator_agent.py): bounded ring, queryable + pushed on the
+        # "events" pubsub channel
+        self.events: collections.deque = collections.deque(maxlen=10000)
+        self._event_seq = 0
         # node_id bytes -> NodeInfo
         self.nodes: Dict[bytes, NodeInfo] = {}
         # node_id bytes -> (available ResourceSet, last heartbeat time)
@@ -395,6 +401,7 @@ class ControlStore:
         if client:
             await client.close()
         logger.warning("node %s marked DEAD: %s", info.node_id.hex()[:8], reason)
+        self._event("node", "DEAD", reason, node_id=info.node_id.hex())
         self._persist("node", info.to_wire())
         self.pubsub.publish("nodes", info.to_wire())
         # Fail over actors that lived on the node.
@@ -434,6 +441,9 @@ class ControlStore:
             "node %s registered at %s resources=%s",
             info.node_id.hex()[:8], info.address, info.resources.to_dict(),
         )
+        self._event("node", "REGISTERED", info.address,
+                    node_id=info.node_id.hex(),
+                    resources=info.resources.to_dict())
         self.pubsub.publish("nodes", info.to_wire())
         # seed the joiner with the existing membership (it only receives
         # pushes for changes after its subscription)
@@ -557,6 +567,8 @@ class ControlStore:
         if info is None:
             return {"ok": False}
         info.state = pb.NODE_DRAINING
+        self._event("node", "DRAINING", "drain requested",
+                    node_id=info.node_id.hex())
         self._persist("node", info.to_wire())
         self.pubsub.publish("nodes", info.to_wire())
         return {"ok": True}
@@ -581,6 +593,46 @@ class ControlStore:
     # ------------------------------------------------------------------
     # KV service (reference: gcs_service.proto InternalKV :633)
     # ------------------------------------------------------------------
+
+    # ------------------------------------------------------------------
+    # structured event export (reference: RayEventExport /
+    # events_event_aggregator_service.proto + aggregator agent)
+    # ------------------------------------------------------------------
+
+    def _event(self, source: str, etype: str, message: str, **meta):
+        self._event_seq += 1
+        ev = {
+            "seq": self._event_seq,
+            "ts": time.time(),
+            "source": source,       # node | actor | job | pg | autoscaler...
+            "type": etype,          # REGISTERED / DEAD / DRAINING / ...
+            "message": message,
+            "meta": meta,
+        }
+        self.events.append(ev)
+        self.pubsub.publish("events", ev)
+
+    async def rpc_report_event(self, conn_id: int, payload: dict) -> dict:
+        """Components (autoscaler, daemons, libraries) push their own
+        structured events into the cluster stream."""
+        self._event(payload.get("source", "external"),
+                    payload.get("type", "EVENT"),
+                    payload.get("message", ""),
+                    **(payload.get("meta") or {}))
+        return {"ok": True}
+
+    async def rpc_list_events(self, conn_id: int, payload: dict) -> dict:
+        limit = int(payload.get("limit", 1000))
+        if limit <= 0:
+            return {"events": []}  # out[-0:] would be the WHOLE ring
+        source = payload.get("source")
+        etype = payload.get("type")
+        out = [
+            ev for ev in self.events
+            if (source is None or ev["source"] == source)
+            and (etype is None or ev["type"] == etype)
+        ]
+        return {"events": out[-limit:]}
 
     async def rpc_kv_put(self, conn_id: int, payload: dict) -> dict:
         ns = self.kv.setdefault(payload.get("ns", ""), {})
@@ -643,6 +695,10 @@ class ControlStore:
         if job:
             job["finished"] = True
             job["end_time"] = time.time()
+            self._event("job", "FINISHED", job.get("entrypoint", ""),
+                        job_id=payload["job_id"].hex()
+                        if isinstance(payload["job_id"], bytes)
+                        else str(payload["job_id"]))
             self._persist("job", {"job": job})
             self.pubsub.publish("jobs", job)
             # Kill detached-from-driver resources: actors owned by the job.
@@ -777,12 +833,16 @@ class ControlStore:
             rec.worker_address = reply["worker_address"]
             rec.state = pb.ACTOR_ALIVE
             logger.info("actor %s ALIVE on %s", actor_hex, rec.worker_address)
+            self._event("actor", "ALIVE", rec.name or actor_hex[:12],
+                        actor_id=actor_hex)
             self._persist_actor(rec)
             self.pubsub.publish("actors", rec.to_wire())
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001
             logger.warning("actor %s creation failed: %s", actor_hex, e)
+            self._event("actor", "CREATION_FAILED", str(e),
+                        actor_id=actor_hex)
             rec.state = pb.ACTOR_DEAD
             rec.death_cause = f"creation failed: {e}"
             self._persist_actor(rec)
@@ -1072,6 +1132,9 @@ class ControlStore:
             if placements is None:
                 if time.monotonic() > deadline:
                     rec.state = pb.PG_REMOVED
+                    self._event("pg", "UNSCHEDULABLE",
+                                rec.name or rec.pg_id.hex()[:12],
+                                pg_id=rec.pg_id.hex())
                     self._persist("pg_up", rec.to_wire())
                     self.pubsub.publish("placement_groups", rec.to_wire())
                     return

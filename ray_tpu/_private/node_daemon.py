@@ -116,6 +116,17 @@ class NodeDaemon:
         self.host = host
         self.session_dir = session_dir
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        # cgroup-v2 isolation (opt-in; reference: cgroup_manager.h) — the
+        # daemon itself is a "system" process, workers are confined
+        from ray_tpu._private.cgroup import manager_from_config
+
+        self.cgroups = manager_from_config(os.path.basename(session_dir))
+        if self.cgroups is not None and self.cgroups.setup(
+                system_pids=[os.getpid()]):
+            logger.info("cgroup2 worker isolation active under %s",
+                        self.cgroups.base)
+        else:
+            self.cgroups = None
         res = dict(resources or {})
         if "CPU" not in res:
             res["CPU"] = float(os.cpu_count() or 1)
@@ -267,6 +278,8 @@ class NodeDaemon:
         await self.server.stop()
         if self.store:
             self.store.destroy()
+        if self.cgroups is not None:
+            self.cgroups.cleanup()
 
     def _sync_drain_state(self, state: str):
         """Mirror the control store's view of this node into the local
@@ -563,6 +576,8 @@ class NodeDaemon:
             if tpu_chips:
                 self._return_chips(tpu_chips)
             raise
+        if self.cgroups is not None:
+            self.cgroups.add_worker(proc.pid)
         handle = WorkerHandle(worker_id, proc, job_id)
         handle.env_key = env_key
         handle.reserved = reserve

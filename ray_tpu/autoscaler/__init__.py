@@ -268,6 +268,17 @@ class Autoscaler:
                     need[t] = need.get(t, 0) + 1
         return need
 
+    def _report_event(self, cw, etype: str, message: str, **meta):
+        """Push a structured autoscaler event into the cluster stream
+        (reference: autoscaler events in the export pipeline)."""
+        try:
+            cw.run_sync(cw.control.call("report_event", {
+                "source": "autoscaler", "type": etype,
+                "message": message, "meta": meta,
+            }), 10)
+        except Exception:  # noqa: BLE001 — events must never break scaling
+            pass
+
     def reconcile_once(self) -> Dict[str, int]:
         from ray_tpu._private.core_worker import get_core_worker
 
@@ -339,6 +350,9 @@ class Autoscaler:
                     launched_slices += 1
                     logger.info("autoscaler provisioned slice %s (%d hosts)",
                                 handle["slice_name"], len(handle["nodes"]))
+                    self._report_event(
+                        cw, "SLICE_PROVISIONED", handle["slice_name"],
+                        pod_type=pod_type, hosts=len(handle["nodes"]))
 
         # scale up: only for demand existing+starting capacity can't absorb.
         # An undrain this pass returns capacity the load snapshot couldn't
@@ -351,6 +365,7 @@ class Autoscaler:
             launched += 1
             logger.info("autoscaler launched node %s",
                         handle["node_id"][:12])
+            self._report_event(cw, "NODE_LAUNCHED", handle["node_id"][:12])
 
         # scale down in two phases (reference: DrainRaylet then terminate):
         # idle past the timeout -> DRAIN (store stops routing to it);

@@ -86,6 +86,7 @@ class DashboardActor:
         app.router.add_get("/api/task_summary", self._task_summary)
         app.router.add_get("/api/placement_groups", self._pgs)
         app.router.add_get("/api/cluster_load", self._cluster_load)
+        app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/node_stats", self._node_stats)
         app.router.add_get("/api/workers", self._workers)
         app.router.add_get("/api/profile", self._profile)
@@ -303,6 +304,24 @@ class DashboardActor:
             }
             for pg in reply["pgs"]
         ])
+
+    async def _events(self, request):
+        """Structured cluster event stream (reference: the aggregator
+        agent's export feed). Query params: source, type, limit."""
+        from aiohttp import web as _web
+
+        try:
+            payload = {"limit": int(request.query.get("limit", 1000))}
+        except ValueError:
+            return _web.json_response({"error": "limit must be an int"},
+                                      status=400)
+        for key in ("source", "type"):
+            if request.query.get(key):
+                payload[key] = request.query[key]
+        from aiohttp import web
+
+        reply = await self._control("list_events", payload)
+        return web.json_response(reply["events"])
 
     async def _cluster_load(self, request):
         from aiohttp import web

@@ -145,6 +145,33 @@ def timeline(filename: Optional[str] = None) -> Any:
     return trace
 
 
+def list_cluster_events(source: str = None, type: str = None,
+                        limit: int = 1000):
+    """Structured cluster events (node/actor/job/pg/autoscaler lifecycle;
+    reference: the export-event pipeline's aggregator feed)."""
+    payload = {"limit": limit}
+    if source:
+        payload["source"] = source
+    if type:
+        payload["type"] = type
+    return _control_call("list_events", payload)["events"]
+
+
+def export_cluster_events(dest_uri: str, limit: int = 10000) -> int:
+    """Dump the event stream as JSONL to any storage URI (file path,
+    memory://, gs://... — reference: aggregator_agent.py export sinks).
+    Returns the number of events written."""
+    import json as _json
+
+    from ray_tpu.train._storage import get_storage
+
+    events = list_cluster_events(limit=limit)
+    storage = get_storage(dest_uri)
+    payload = "\n".join(_json.dumps(e, default=str) for e in events)
+    storage.write_bytes(dest_uri, payload.encode())
+    return len(events)
+
+
 def list_dataset_stats() -> List[Dict[str, Any]]:
     """Per-op stats of streaming Dataset executions, cluster-visible via the
     control store KV (reference: the data dashboard's StatsManager feed)."""
@@ -162,7 +189,9 @@ def list_dataset_stats() -> List[Dict[str, Any]]:
 
 
 __all__ = [
+    "export_cluster_events",
     "list_actors",
+    "list_cluster_events",
     "list_dataset_stats",
     "list_jobs",
     "list_nodes",

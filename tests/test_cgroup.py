@@ -1,0 +1,75 @@
+"""cgroup-v2 worker isolation (VERDICT missing #10; reference:
+src/ray/common/cgroup2/cgroup_manager.h + fake_cgroup_driver.h — the
+manager's protocol is tested against the in-memory fake the way every
+reference cgroup test is)."""
+
+import ray_tpu
+from ray_tpu._private.cgroup import (
+    CgroupManager,
+    FakeCgroupDriver,
+    SysFsCgroupDriver,
+)
+
+
+def test_manager_builds_hierarchy_and_limits():
+    d = FakeCgroupDriver()
+    mgr = CgroupManager(
+        "ray_tpu/sess1", d,
+        system_reserved_memory_bytes=512 << 20,
+        worker_memory_high_bytes=2 << 30,
+        worker_memory_max_bytes=3 << 30,
+        worker_cpu_weight=50,
+    )
+    assert mgr.setup(system_pids=[100, 101]) is True
+    assert "ray_tpu/sess1/system" in d.tree
+    assert "ray_tpu/sess1/workers" in d.tree
+    # no-internal-process rule: leaves created before subtree_control
+    assert d.tree["ray_tpu/sess1"]["cgroup.subtree_control"] == "+memory +cpu"
+    assert d.tree["ray_tpu/sess1/system"]["memory.min"] == str(512 << 20)
+    assert d.tree["ray_tpu/sess1/workers"]["memory.high"] == str(2 << 30)
+    assert d.tree["ray_tpu/sess1/workers"]["memory.max"] == str(3 << 30)
+    assert d.tree["ray_tpu/sess1/workers"]["cpu.weight"] == "50"
+    assert d.pids("ray_tpu/sess1/system") == [100, 101]
+
+
+def test_workers_move_between_groups_and_cleanup():
+    d = FakeCgroupDriver()
+    mgr = CgroupManager("ray_tpu/sess2", d)
+    assert mgr.setup(system_pids=[1])
+    mgr.add_worker(200)
+    mgr.add_worker(201)
+    assert d.pids("ray_tpu/sess2/workers") == [200, 201]
+    # cgroup2 move semantics: a pid written elsewhere LEAVES its old group
+    mgr.add_system_process(200)
+    assert d.pids("ray_tpu/sess2/workers") == [201]
+    assert 200 in d.pids("ray_tpu/sess2/system")
+    mgr.cleanup()
+    assert not mgr.enabled
+    assert "ray_tpu/sess2/workers" in d.deleted
+
+
+def test_unavailable_driver_disables_gracefully(tmp_path):
+    # a root without cgroup.controllers (cgroup v1 or no cgroupfs)
+    drv = SysFsCgroupDriver(root=str(tmp_path))
+    assert drv.available() is False
+    mgr = CgroupManager("ray_tpu/x", drv)
+    assert mgr.setup() is False
+    assert not mgr.enabled
+    # every op is a no-op, never an exception
+    mgr.add_worker(123)
+    mgr.cleanup()
+
+
+def test_daemon_runs_with_isolation_flag_on_unwritable_host():
+    """e2e: the flag on a host without writable cgroup2 must not break
+    cluster startup or task execution (graceful degradation)."""
+    info = ray_tpu.init(
+        num_cpus=2, system_config={"cgroup_isolation_enabled": True})
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(1), timeout=120) == 2
+    finally:
+        ray_tpu.shutdown()

@@ -146,3 +146,49 @@ def test_jax_profiler_capture(ray_init, tmp_path):
                             duration_s=0.5)
     assert files, "no trace files produced"
     assert any(f.endswith(".xplane.pb") or "trace" in f for f in files), files
+
+
+def test_cluster_event_stream_and_export(ray_init, tmp_path):
+    """Structured event export pipeline (VERDICT missing #9): lifecycle
+    events collected cluster-wide, queryable, and exportable as JSONL."""
+    from ray_tpu.util.state import export_cluster_events, list_cluster_events
+
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return 1
+
+    a = Marker.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+
+    events = list_cluster_events()
+    assert events, "no cluster events recorded"
+    sources = {e["source"] for e in events}
+    assert "node" in sources  # head registration
+    assert any(e["type"] == "REGISTERED" for e in events)
+    assert any(e["source"] == "actor" and e["type"] == "ALIVE"
+               for e in events)
+    # filters
+    only_nodes = list_cluster_events(source="node")
+    assert only_nodes and all(e["source"] == "node" for e in only_nodes)
+    # seq strictly increasing
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    # custom events via report_event
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    cw.run_sync(cw.control.call("report_event", {
+        "source": "test", "type": "CUSTOM", "message": "hello",
+        "meta": {"k": 1}}), 10)
+    got = list_cluster_events(source="test")
+    assert got and got[-1]["message"] == "hello"
+    # JSONL export through the storage plane
+    dest = str(tmp_path / "events.jsonl")
+    n = export_cluster_events(dest)
+    assert n >= len(events)
+    import json as _json
+
+    lines = [l for l in open(dest).read().splitlines() if l]
+    assert len(lines) == n
+    assert _json.loads(lines[0])["seq"]
