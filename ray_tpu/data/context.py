@@ -24,6 +24,10 @@ class DataContext:
     # max estimated bytes in flight per pipeline stage before admission
     # backpressure (reference: execution/resource_manager.py budgets)
     op_memory_budget_bytes: int = 128 << 20
+    # shuffle-class ops: target partition size + fan-out cap (B blocks x
+    # B partitions return-ref blowup guard)
+    shuffle_target_partition_bytes: int = 64 << 20
+    shuffle_max_partitions: int = 64
     # advisory target for readers choosing block splits
     target_max_block_size: int = 128 * 1024 * 1024
 

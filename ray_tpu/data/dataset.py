@@ -92,6 +92,39 @@ def _stable_key_hash(v) -> int:
     return int.from_bytes(d, "little")
 
 
+
+def _shuffle_partitions(refs, requested: Optional[int] = None) -> int:
+    """Partition count for shuffle-class ops (sort/shuffle/groupby/join).
+
+    Spill-aware sizing (reference: the shuffle partitioning in
+    execution/operators/hash_shuffle + resource_manager budgets): target
+    ~shuffle_target_partition_bytes per partition from SAMPLED block sizes,
+    capped at shuffle_max_partitions — without the cap, B input blocks x
+    B partitions costs B^2 return refs and B-arg merge tasks, which is what
+    falls over at hundreds of blocks, not the O(N) data movement."""
+    if requested:
+        return max(1, int(requested))
+    n = len(refs)
+    if n <= 1:
+        return max(1, n)
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    target = ctx.shuffle_target_partition_bytes
+    cap = ctx.shuffle_max_partitions
+    from ray_tpu.data._executor import _ref_size
+
+    # strided sample: leading blocks are often unrepresentative (header /
+    # remainder blocks from readers)
+    probe = refs[::max(1, n // 8)][:8]
+    sizes = [sz for sz in (_ref_size(r) for r in probe) if sz is not None]
+    if sizes:
+        est_total = (sum(sizes) / len(sizes)) * n
+        want = -(-int(est_total) // max(1, target))
+        return max(1, min(n, cap, max(want, 1)))
+    return max(1, min(n, cap))
+
+
 def _slice_row_range(lo: int, hi: int, block_starts, *blocks) -> Block:
     """Rows [lo, hi) of a virtual concatenation, given each block's global
     start offset (shared by repartition and zip alignment)."""
@@ -437,8 +470,8 @@ class Dataset:
         from ray_tpu.remote_function import RemoteFunction
 
         refs = self._block_refs()
-        k = len(refs)
-        if k <= 1:
+        k = _shuffle_partitions(refs)
+        if len(refs) <= 1:
             return Dataset(list(refs), [], _refs=list(refs))
 
         def _scatter(sd, j: int, k: int, block):
@@ -463,13 +496,33 @@ class Dataset:
                 return {c: v[perm] for c, v in whole.items()}
             return [whole[j] for j in perm]
 
-        scatter = RemoteFunction(_scatter).options(num_returns=k)
         merge = RemoteFunction(_merge)
-        partitions = [scatter.remote(seed, j, k, refs[j]) for j in range(k)]
+        if k == 1:
+            # size-driven single partition: permute everything in one task
+            new_refs = [merge.remote(seed, 0, *refs)]
+            return Dataset(new_refs, [], _refs=new_refs)
+        scatter = RemoteFunction(_scatter).options(num_returns=k)
+        # EVERY input block scatters (k is the partition count, which may
+        # be smaller than the block count under spill-aware sizing)
+        partitions = [
+            scatter.remote(seed, j, k, refs[j]) for j in range(len(refs))
+        ]
         new_refs = [
-            merge.remote(seed, i, *[partitions[j][i] for j in range(k)])
+            merge.remote(seed, i, *[p[i] for p in partitions])
             for i in range(k)
         ]
+        return Dataset(new_refs, [], _refs=new_refs)
+
+    @staticmethod
+    def _sort_single_partition(refs, key, descending) -> "Dataset":
+        """One global sort task (a per-block sort would not be a global
+        order when several blocks feed one partition)."""
+        from ray_tpu.remote_function import RemoteFunction
+
+        def _sort_all(*blocks):
+            return _sort_block(block_concat(list(blocks)), key, descending)
+
+        new_refs = [RemoteFunction(_sort_all).remote(*refs)]
         return Dataset(new_refs, [], _refs=new_refs)
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
@@ -480,9 +533,12 @@ class Dataset:
         from ray_tpu.remote_function import RemoteFunction
 
         refs = self._block_refs()
-        k = len(refs)
-        if k == 0:
+        k = _shuffle_partitions(refs)
+        if not refs:
             return Dataset([], [], _refs=[])
+        if k == 1:
+            # no range bounds needed — skip the sampling round-trip
+            return self._sort_single_partition(refs, key, descending)
 
         def _sample(block):
             col = np.asarray(block[key]) if isinstance(block, dict) else (
@@ -498,13 +554,9 @@ class Dataset:
             s for s in ray_tpu.get(
                 [RemoteFunction(_sample).remote(r) for r in refs], timeout=600)
             if s.size
-        ]) if k else np.array([])
-        if samples.size == 0 or k == 1:
-            def _sort_one(block):
-                return _sort_block(block, key, descending)
-
-            new_refs = [RemoteFunction(_sort_one).remote(r) for r in refs]
-            return Dataset(new_refs, [], _refs=new_refs)
+        ])
+        if samples.size == 0:
+            return self._sort_single_partition(refs, key, descending)
         # positional quantiles, not np.quantile: sort keys may be strings
         # (any sortable dtype) and only order matters for range bounds
         srt = np.sort(samples)
@@ -535,8 +587,10 @@ class Dataset:
         scatter = RemoteFunction(_scatter).options(num_returns=k)
         partitions = [scatter.remote(r, bounds) for r in refs]
         order = range(k - 1, -1, -1) if descending else range(k)
+        # fan-in over EVERY scatter (len(refs)), not range(k): k may be
+        # size-driven < len(refs)
         new_refs = [
-            RemoteFunction(_merge_sort).remote(*[partitions[j][i] for j in range(k)])
+            RemoteFunction(_merge_sort).remote(*[p[i] for p in partitions])
             for i in order
         ]
         return Dataset(new_refs, [], _refs=new_refs)
@@ -628,7 +682,10 @@ class Dataset:
 
         left = self._block_refs()
         right = other._block_refs()
-        k = num_partitions or max(1, max(len(left), len(right)))
+        # size BOTH sides: a huge few-block side must not collapse the
+        # join because the other side has more (tiny) blocks
+        k = (int(num_partitions) if num_partitions
+             else max(_shuffle_partitions(left), _shuffle_partitions(right)))
 
         def _scatter(block, k):
             rows = list(block_rows(block))
@@ -805,7 +862,7 @@ class GroupedData:
         refs = self._ds._block_refs()
         if not refs:
             return Dataset([], [], _refs=[])
-        k = len(refs)
+        k = _shuffle_partitions(refs)
 
         def _scatter(block, k):
             keys = (np.asarray(block[key]) if isinstance(block, dict)
@@ -838,13 +895,16 @@ class GroupedData:
 
         agg_fn = RemoteFunction(_agg_partition)
         if k == 1:
-            # num_returns=1 .remote() yields a bare ref; no scatter needed
-            new_refs = [agg_fn.remote(agg, col, refs[0])]
+            # no scatter needed — but EVERY block feeds the one partition
+            # (k may be size-driven < len(refs) now)
+            new_refs = [agg_fn.remote(agg, col, *refs)]
         else:
             scatter = RemoteFunction(_scatter).options(num_returns=k)
             partitions = [scatter.remote(r, k) for r in refs]
+            # fan-in over EVERY scatter (len(refs) of them), not range(k):
+            # k may be size-driven < len(refs)
             new_refs = [
-                agg_fn.remote(agg, col, *[partitions[j][i] for j in range(k)])
+                agg_fn.remote(agg, col, *[p[i] for p in partitions])
                 for i in range(k)
             ]
         return Dataset(new_refs, [], _refs=new_refs)
