@@ -114,10 +114,11 @@ def main():
     # flash dkv kernel drops to 512x256 blocks (scoped-vmem limit).
     sweep = {}
     if on_tpu:
-        for sw_batch, sw_seq, sw_remat in ((4, 4096, "dots"),
-                                           (2, 8192, "ffn")):
+        for sw_batch, sw_seq, sw_chunk, sw_remat in (
+                (4, 4096, 4096, "dots"), (2, 8192, 2048, "ffn")):
             try:
-                tps, sdt, _ = run_config(sw_batch, sw_seq, 4, 2048, sw_remat)
+                tps, sdt, _ = run_config(sw_batch, sw_seq, 4, sw_chunk,
+                                         sw_remat)
                 sweep[str(sw_seq)] = {
                     "tokens_per_s": round(tps, 1),
                     "step_ms": round(sdt * 1e3, 2),
