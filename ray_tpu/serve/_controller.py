@@ -58,20 +58,29 @@ class ServeController:
                      actor_options: Optional[dict] = None,
                      max_concurrent: int = 100) -> bool:
         await self._ensure_loop()
+        config = {
+            "callable_blob": callable_blob,
+            "init_args_blob": init_args_blob,
+            "autoscaling": autoscaling,
+            "actor_options": dict(actor_options or {}),
+            "max_concurrent": max_concurrent,
+        }
         async with self._scale_lock:
             old = self.deployments.get(name)
+            if old is not None and old["config"] == config:
+                # identical config: a pure replica-count update — rescale in
+                # place, no roll (reference: deployment_state only restarts
+                # replicas whose config actually changed)
+                old["target"] = num_replicas
+                await self._scale_to_locked(name, num_replicas)
+                return True
             if old is not None:
-                # config change: roll all existing replicas
+                # config change: roll all existing replicas (no publish for
+                # the intermediate empty set)
                 old["target"] = 0
-                await self._scale_to_locked(name, 0)
+                await self._scale_to_locked(name, 0, publish=False)
             self.deployments[name] = {
-                "config": {
-                    "callable_blob": callable_blob,
-                    "init_args_blob": init_args_blob,
-                    "autoscaling": autoscaling,
-                    "actor_options": dict(actor_options or {}),
-                    "max_concurrent": max_concurrent,
-                },
+                "config": config,
                 "replicas": [],
                 "next_id": old["next_id"] if old else 0,
                 "target": num_replicas,
@@ -153,7 +162,8 @@ class ServeController:
         except Exception:  # noqa: BLE001 — already dead
             pass
 
-    async def _scale_to_locked(self, name: str, target: int):
+    async def _scale_to_locked(self, name: str, target: int,
+                               publish: bool = True):
         """Scale a deployment's replica set; caller must hold _scale_lock.
         Re-checks deployment identity after every await — a redeploy swaps
         the dict and this scale must not touch the new generation."""
@@ -163,6 +173,7 @@ class ServeController:
         if d is None:
             return
         cfg = d["config"]
+        before = [id(r) for r in d["replicas"]]
         while len(d["replicas"]) < target:
             rid = d["next_id"]
             d["next_id"] += 1
@@ -188,6 +199,24 @@ class ServeController:
             d["replicas"].append(replica)
         while len(d["replicas"]) > target:
             await self._kill_replica(d["replicas"].pop())
+        # config PUSH (reference: long_poll.py:318 — the controller notifies
+        # routers of replica-set changes instead of them polling a TTL).
+        # Only on CHANGE (the reconcile tick calls this every second), and
+        # never for the intermediate roll-to-0 of a redeploy (publish=False
+        # there: handles refreshing into an empty set would hard-fail while
+        # the ActorDied failover path rides out the roll).
+        if publish and [id(r) for r in d["replicas"]] != before:
+            try:
+                from ray_tpu._private.core_worker import get_core_worker
+
+                cw = get_core_worker()
+                await cw.control.call("publish", {
+                    "channel": "serve",
+                    "message": {"name": name,
+                                "replicas": len(d["replicas"])},
+                })
+            except Exception:  # noqa: BLE001 — push is an optimization
+                pass
 
     async def _reconcile_loop(self):
         """Autoscaling + health: every second, poll replica stats; scale

@@ -17,6 +17,51 @@ import ray_tpu
 
 _REFRESH_S = 2.0
 
+# config-push plumbing (reference: long_poll.py:318): one per-process
+# subscription to the controller's "serve" channel; a push invalidates
+# every live handle of that deployment so its next request refreshes
+# immediately instead of waiting out the TTL (which stays as the fallback
+# for missed pushes).
+import weakref
+
+_handle_registry: "weakref.WeakSet" = weakref.WeakSet()
+# keyed by the CoreWorker instance: a new session's core worker needs its
+# own subscription (a bare bool would leave every later session pushless)
+_push_cw = None
+
+
+def _on_serve_push(message):
+    import math
+
+    name = (message or {}).get("name")
+    for h in list(_handle_registry):
+        if h.deployment_name == name:
+            # -inf, not 0.0: monotonic() starts at boot, so `now - 0 >= TTL`
+            # is FALSE under any TTL larger than the uptime — the push
+            # would be silently inert
+            h._last_refresh = -math.inf
+
+
+def _subscribe_push():
+    global _push_cw
+    try:
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        if _push_cw is cw:
+            return
+        cw.control.subscribe_channel("serve", _on_serve_push)
+
+        async def sub():
+            await cw.control.call("subscribe", {"channel": "serve"})
+
+        cw.schedule(sub())
+        cw.control.on_reconnect(
+            lambda: cw.control.call("subscribe", {"channel": "serve"}))
+        _push_cw = cw
+    except Exception:  # noqa: BLE001 — TTL polling still covers refresh
+        pass
+
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller=None):
@@ -46,6 +91,8 @@ class DeploymentHandle:
         self._model_affinity: Dict[str, bytes] = {}
         self._last_refresh = 0.0
         self._lock = threading.Lock()
+        _handle_registry.add(self)
+        _subscribe_push()
 
     def options(self, *, multiplexed_model_id: str = "",
                 stream: bool = False) -> Any:

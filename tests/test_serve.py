@@ -58,8 +58,8 @@ def test_function_deployment(ray_init):
 def test_method_call_and_redeploy(ray_init):
     @serve.deployment(num_replicas=1)
     class Counter:
-        def __init__(self):
-            self.n = 0
+        def __init__(self, start=0):
+            self.n = start
 
         def __call__(self, _x=None):
             return "root"
@@ -71,10 +71,14 @@ def test_method_call_and_redeploy(ray_init):
     handle = serve.run(Counter.bind())
     assert handle.method("incr").remote().result(timeout=60) == 1
     assert handle.method("incr").remote().result(timeout=60) == 2
-    # redeploy resets state (rolling replace)
+    # identical config redeploys are IN-PLACE (reference: deployment_state
+    # only restarts replicas whose config changed) — state survives
     handle = serve.run(Counter.bind())
+    assert handle.method("incr").remote().result(timeout=60) == 3
+    # a CONFIG CHANGE rolls the replicas: state resets
+    handle = serve.run(Counter.bind(start=10))
     time.sleep(0.5)
-    assert handle.method("incr").remote().result(timeout=60) == 1
+    assert handle.method("incr").remote().result(timeout=60) == 11
 
 
 def test_routing_spreads_load(ray_init):
@@ -464,3 +468,31 @@ def test_config_file_deploy_and_cli_schema(ray_init, tmp_path):
         assert built["applications"][0]["num_replicas"] == 3
     finally:
         sys.path.remove(str(tmp_path))
+
+
+def test_config_push_invalidates_handles_without_ttl(ray_init, monkeypatch):
+    """Replica-set changes PUSH to handles (reference: long_poll.py:318) —
+    with the TTL effectively disabled, a scaled deployment must still be
+    visible to an existing handle promptly."""
+    from ray_tpu.serve import _handle as handle_mod
+
+    monkeypatch.setattr(handle_mod, "_REFRESH_S", 1e9)
+
+    @serve.deployment(num_replicas=1)
+    class Pushed:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Pushed.bind())
+    assert handle.remote(1).result(timeout=60) == 1
+    assert len(handle._replicas) == 1
+    # identical config, more replicas: a NON-rolling rescale — no request
+    # failure can mask a broken push (the ActorDied failover path never
+    # fires), so only the push itself can refresh the handle
+    serve.run(Pushed.options(num_replicas=2).bind())
+    deadline = time.time() + 60
+    while time.time() < deadline and len(handle._replicas) != 2:
+        handle._refresh()  # no-op unless the push marked the handle stale
+        time.sleep(0.2)
+    assert len(handle._replicas) == 2, "push never refreshed the handle"
+    assert handle.remote(2).result(timeout=60) == 2
