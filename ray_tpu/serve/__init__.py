@@ -122,6 +122,13 @@ def status() -> Dict[str, Any]:
     return ray_tpu.get(controller.list_deployments.remote(), timeout=30)
 
 
+def start_grpc(grpc_host: str = "127.0.0.1", grpc_port: int = 9000) -> str:
+    """Start the gRPC ingress (reference: gRPCProxy, proxy.py:548)."""
+    from ray_tpu.serve._grpc import start_grpc as _start
+
+    return _start(grpc_host, grpc_port)
+
+
 def start(http_host: str = "127.0.0.1", http_port: int = 8000) -> str:
     """Start the HTTP ingress; returns its base URL (reference:
     serve.start(http_options=...))."""
@@ -146,6 +153,20 @@ def shutdown():
     """Tear down all deployments, the controller, and the proxy."""
     from ray_tpu.serve._http import PROXY_NAME
 
+    gproxy = None
+    try:
+        from ray_tpu.serve._grpc import GRPC_PROXY_NAME
+
+        gproxy = ray_tpu.get_actor(GRPC_PROXY_NAME, namespace=SERVE_NAMESPACE)
+        ray_tpu.get(gproxy.stop.remote(), timeout=15)
+    except Exception:  # noqa: BLE001 — gRPC proxy never started / stop hung
+        pass
+    if gproxy is not None:
+        # ALWAYS kill once the actor exists (same rule as the HTTP proxy)
+        try:
+            ray_tpu.kill(gproxy)
+        except Exception:  # noqa: BLE001 — already dead
+            pass
     proxy = None
     try:
         proxy = ray_tpu.get_actor(PROXY_NAME, namespace=SERVE_NAMESPACE)
@@ -180,6 +201,7 @@ __all__ = [
     "deployment",
     "run",
     "start",
+    "start_grpc",
     "status",
     "delete",
     "shutdown",

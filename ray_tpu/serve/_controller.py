@@ -210,11 +210,14 @@ class ServeController:
                 from ray_tpu._private.core_worker import get_core_worker
 
                 cw = get_core_worker()
+                # short timeout: the push is an optimization and this
+                # runs under _scale_lock — a wedged control store must not
+                # freeze every deployment's reconcile for retry-minutes
                 await cw.control.call("publish", {
                     "channel": "serve",
                     "message": {"name": name,
                                 "replicas": len(d["replicas"])},
-                })
+                }, timeout=2)
             except Exception:  # noqa: BLE001 — push is an optimization
                 pass
 

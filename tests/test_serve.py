@@ -496,3 +496,54 @@ def test_config_push_invalidates_handles_without_ttl(ray_init, monkeypatch):
         time.sleep(0.2)
     assert len(handle._replicas) == 2, "push never refreshed the handle"
     assert handle.remote(2).result(timeout=60) == 2
+
+
+def test_grpc_ingress_unary_and_streaming(ray_init):
+    """gRPC ingress (reference: gRPCProxy proxy.py:548): unary calls and
+    server-streaming generator deployments over a generic bytes service."""
+    import json as _json
+
+    import grpc
+
+    @serve.deployment(num_replicas=1)
+    class Echoer:
+        def __call__(self, payload):
+            if isinstance(payload, dict) and payload.get("stream"):
+                def gen():
+                    for i in range(int(payload["n"])):
+                        yield {"i": i}
+                return gen()
+            return {"echo": payload}
+
+    serve.run(Echoer.bind())
+    addr = serve.start_grpc(grpc_port=19090)
+
+    channel = grpc.insecure_channel(addr)
+    unary = channel.unary_unary(
+        "/ray_tpu.serve.Serve/Call",
+        request_serializer=bytes, response_deserializer=bytes)
+    md = (("rt-serve-deployment", "Echoer"),)
+    reply = _json.loads(unary(_json.dumps({"x": 7}).encode(),
+                              metadata=md, timeout=60))
+    assert reply["result"]["echo"] == {"x": 7}
+
+    stream = channel.unary_stream(
+        "/ray_tpu.serve.Serve/CallStream",
+        request_serializer=bytes, response_deserializer=bytes)
+    items = [_json.loads(m) for m in stream(
+        _json.dumps({"stream": True, "n": 3}).encode(),
+        metadata=md, timeout=60)]
+    assert items == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+    # unknown deployment -> NOT_FOUND; missing metadata -> INVALID_ARGUMENT
+    try:
+        unary(b"{}", metadata=(("rt-serve-deployment", "Nope"),), timeout=30)
+        assert False, "expected NOT_FOUND"
+    except grpc.RpcError as e:
+        assert e.code() == grpc.StatusCode.NOT_FOUND
+    try:
+        unary(b"{}", timeout=30)
+        assert False, "expected INVALID_ARGUMENT"
+    except grpc.RpcError as e:
+        assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+    channel.close()
