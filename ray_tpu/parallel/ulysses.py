@@ -18,7 +18,6 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_tpu.parallel.mesh import BATCH_AXES
-from ray_tpu.parallel.ring_attention import ring_attention_reference
 
 
 def ulysses_attention_sharded(
@@ -46,8 +45,13 @@ def ulysses_attention_sharded(
             return lax.all_to_all(x, "sp", split_axis=1, concat_axis=2,
                                   tiled=True)
 
+        from ray_tpu.ops.flash_attention import flash_attention
+
         ql, kl, vl = scatter(q), scatter(k), scatter(v)
-        out = ring_attention_reference(ql, kl, vl, causal=causal)
+        # local full-sequence attention on 1/sp of the heads rides the
+        # Pallas flash kernel on TPU (fwd+bwd, no (s, s) materialization);
+        # unsupported shapes/backends fall back to fused XLA inside
+        out = flash_attention(ql, kl, vl, causal=causal)
         return gather(out)
 
     return shard_map(
