@@ -95,6 +95,44 @@ def bench_actor_calls_async(ray_tpu, n):
     return {"bench": "actor_calls_async", "value": round(n / dt, 1), "unit": "calls/s"}
 
 
+def bench_queued_task_depth(ray_tpu, n):
+    """Deep submission queue: N tasks submitted before any result is
+    consumed, all must drain correctly (the '1M queued tasks' envelope
+    probe from release/benchmarks scaled to this VM — ray_perf has no
+    direct counterpart; reports sustained drain rate at depth)."""
+
+    @ray_tpu.remote
+    def tag(i):
+        return i
+
+    ray_tpu.get(tag.remote(0), timeout=60)
+    t0 = time.perf_counter()
+    refs = [tag.remote(i) for i in range(n)]
+    t_submit = time.perf_counter() - t0
+    out = ray_tpu.get(refs, timeout=1200)
+    dt = time.perf_counter() - t0
+    assert out == list(range(n)), "queued-task drain corrupted results"
+    return {"bench": f"queued_tasks_{n}", "value": round(n / dt, 1),
+            "unit": "tasks/s",
+            "submit_rate": round(n / max(t_submit, 1e-9), 1)}
+
+
+def bench_many_args(ray_tpu, n_args):
+    """One task consuming n_args object refs (the '10k args per task'
+    envelope probe, release/benchmarks/README.md:27)."""
+
+    @ray_tpu.remote
+    def consume(*parts):
+        return len(parts)
+
+    refs = [ray_tpu.put(i) for i in range(n_args)]
+    t0 = time.perf_counter()
+    assert ray_tpu.get(consume.remote(*refs), timeout=600) == n_args
+    dt = time.perf_counter() - t0
+    return {"bench": f"task_{n_args}_args", "value": round(dt * 1e3, 1),
+            "unit": "ms"}
+
+
 def bench_put_small(ray_tpu, n):
     """Small-object put latency (inline path)."""
     payload = b"x" * 1024
@@ -206,6 +244,8 @@ def main():
         results.extend(bench_put_get_gigabytes(ray_tpu, 40 * scale))
         results.append(bench_task_arg_passthrough(ray_tpu, 16))
         results.append(bench_collective_allreduce(ray_tpu, 8 * scale))
+        results.append(bench_queued_task_depth(ray_tpu, 4000 * scale))
+        results.append(bench_many_args(ray_tpu, 1000 * scale))
     finally:
         for r in results:
             print(json.dumps(r))
