@@ -11,9 +11,15 @@ from ray_tpu.rllib.learner import VTraceLearner
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner
 from ray_tpu.rllib.bc import BC, BCConfig, BCLearner
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 
 __all__ = ["BC", "BCConfig", "BCLearner", "DQN", "DQNConfig", "DQNLearner",
-           "EnvRunner", "IMPALA", "IMPALAConfig", "PPO", "PPOConfig",
+           "EnvRunner", "IMPALA", "IMPALAConfig", "MultiAgentEnvRunner",
+           "MultiAgentPPO", "MultiAgentPPOConfig", "PPO", "PPOConfig",
            "PPOLearner", "ReplayBuffer", "SAC", "SACConfig", "SACLearner",
            "VTraceLearner", "compute_gae", "connectors"]
 

@@ -1,0 +1,105 @@
+"""Multi-agent RL (VERDICT missing #7 second half; reference:
+rllib/env/multi_agent_env_runner.py + MultiRLModule policy mapping): a
+cooperative two-agent matching game learned by independent policies AND by
+a shared (parameter-shared) policy."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import MultiAgentPPOConfig
+
+# test modules are importable by NAME in the pytest process but not in
+# workers: force by-value pickling of everything defined here
+import sys as _sys
+
+import cloudpickle as _cp
+
+_cp.register_pickle_by_value(_sys.modules[__name__])
+
+
+def make_matching_env():
+    """Factory (cloudpickled BY VALUE — test modules aren't importable in
+    workers): two agents see the same random context bit and earn +1 each
+    when BOTH play the action equal to the bit. Optimal return per 20-step
+    episode = 40 total; random play averages ~10."""
+    import numpy as _np
+
+    class MatchingEnv:
+        agents = ("a0", "a1")
+
+        def __init__(self, episode_len=20):
+            self.episode_len = episode_len
+            self._rng = _np.random.default_rng(0)
+            self._t = 0
+            self._bit = 0
+
+        def _obs(self):
+            v = _np.asarray([self._bit, 1 - self._bit], _np.float32)
+            return {a: v for a in self.agents}
+
+        def reset(self, seed=None):
+            if seed is not None:
+                self._rng = _np.random.default_rng(seed)
+            self._t = 0
+            self._bit = int(self._rng.integers(2))
+            return self._obs(), {}
+
+        def step(self, actions):
+            hit = all(actions[a] == self._bit for a in self.agents)
+            rew = {a: (1.0 if hit else 0.0) for a in self.agents}
+            self._t += 1
+            self._bit = int(self._rng.integers(2))
+            done = self._t >= self.episode_len
+            terms = {a: done for a in self.agents}
+            truncs = {a: False for a in self.agents}
+            return self._obs(), rew, terms, truncs, {}
+
+    return MatchingEnv()
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _run(policies, mapping, iters=12):
+    algo = (
+        MultiAgentPPOConfig()
+        .environment(make_matching_env)
+        .multi_agent(policies=policies, policy_mapping=mapping)
+        .env_runners(num_env_runners=2, rollout_fragment_length=200)
+        .training(lr=3e-3)
+        .build()
+    )
+    try:
+        results = [algo.train() for _ in range(iters)]
+    finally:
+        algo.stop()
+    return results
+
+
+def test_independent_policies_learn(ray_init):
+    spec = {"obs_dim": 2, "num_actions": 2, "hidden": (32, 32)}
+    results = _run({"a0": dict(spec), "a1": dict(spec)}, mapping={})
+    late = [r["episode_return_mean"] for r in results[-3:]
+            if np.isfinite(r["episode_return_mean"])]
+    assert late, "no completed episodes"
+    # optimal 40/episode (total across agents); random ~10
+    assert np.mean(late) > 25, f"no coordination learned: {late}"
+    # per-policy metrics surfaced
+    assert any(k.startswith("a0/") for k in results[-1])
+    assert any(k.startswith("a1/") for k in results[-1])
+
+
+def test_parameter_shared_policy_learns(ray_init):
+    results = _run(
+        {"shared": {"obs_dim": 2, "num_actions": 2, "hidden": (32, 32)}},
+        mapping={"a0": "shared", "a1": "shared"},
+    )
+    late = [r["episode_return_mean"] for r in results[-3:]
+            if np.isfinite(r["episode_return_mean"])]
+    assert late and np.mean(late) > 25, f"shared policy failed: {late}"
+    assert any(k.startswith("shared/") for k in results[-1])
