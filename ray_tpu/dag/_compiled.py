@@ -286,6 +286,7 @@ class CompiledDAG:
         self._nslots = max_in_flight
         self._slot_size = slot_size
         self._torn_down = False
+        self._poisoned: Optional[str] = None
         self._seq_submitted = 0
         self._seq_collected = 0
 
@@ -429,6 +430,24 @@ class CompiledDAG:
         for plan in plans.values():
             plan.steps.sort(key=lambda s: created[s.idx])
 
+        # Every actor's loop must be reachable by the STOP sentinel, which
+        # only flows through input/chan-sourced fetches. An actor whose
+        # steps read nothing (all-const args, e.g. b.tick.bind()) would
+        # free-run ahead of execute() and never unwind at teardown —
+        # reject it at compile time.
+        for akey, plan in plans.items():
+            stoppable = any(
+                src[0] in ("chan", "input", "input_attr")
+                for s in plan.steps
+                for src in list(s.arg_sources) + list(s.kwarg_sources.values())
+            )
+            if not stoppable:
+                raise ValueError(
+                    f"actor {akey[:8]} has no InputNode- or channel-sourced "
+                    f"step: its executor loop could never observe teardown. "
+                    f"Bind at least one argument to the DAG input or to "
+                    f"another actor's output.")
+
         # targets stream to the driver
         self._out_edges: List[str] = []
         for t in targets:
@@ -470,6 +489,10 @@ class CompiledDAG:
     def execute(self, *args, **kwargs) -> CompiledDAGRef:
         if self._torn_down:
             raise RuntimeError("CompiledDAG is torn down")
+        if self._poisoned:
+            raise RuntimeError(
+                f"compiled DAG {self.dag_id} is desynchronized "
+                f"({self._poisoned}); call teardown()")
         if self._seq_submitted - self._seq_collected >= self._nslots:
             # every edge ring holds nslots items; admitting more in-flight
             # executions than that could block this writer forever while
@@ -489,9 +512,25 @@ class CompiledDAG:
 
         # serialize ONCE; entry channels share the byte payload
         payload = ser.serialize(value).to_bytes()
-        for e in self._entry_edges:
-            # a full entry channel IS the pipeline backpressure
-            self._channels[e].write_bytes(payload, timeout=300)
+        for i, e in enumerate(self._entry_edges):
+            try:
+                # a full entry channel IS the pipeline backpressure
+                self._channels[e].write_bytes(payload, timeout=300)
+            except Exception as exc:  # noqa: BLE001
+                if i == 0:
+                    raise  # nothing fed yet — the DAG is still consistent
+                # Entries 0..i-1 already hold this execution's payload; the
+                # stages they feed will run it while the rest never see it.
+                # Every later execute() would return outputs shifted by one
+                # on the fed edges — poison the DAG so subsequent calls
+                # fail loudly instead of returning wrong results. teardown()
+                # still works (STOP rides the same entry channels).
+                self._poisoned = (
+                    f"entry write to {e!r} failed after {i} entry "
+                    f"channel(s) were already fed")
+                raise RuntimeError(
+                    f"compiled DAG {self.dag_id}: {self._poisoned}; the "
+                    f"pipeline is desynchronized — call teardown()") from exc
         self._seq_submitted += 1
         return CompiledDAGRef(self, self._seq_submitted)
 

@@ -182,6 +182,7 @@ class AutoScalingActorPool:
         self.max_size = max(self.min_size, max_size)
         self._actors: List[Any] = []
         self._load: Dict[int, int] = {}  # actor index -> outstanding
+        self._by_ref: Dict[bytes, int] = {}  # result ref -> actor index
         for _ in range(self.min_size):
             self._add_actor()
         self._idle_polls = 0
@@ -194,12 +195,11 @@ class AutoScalingActorPool:
         i = min(self._load, key=self._load.get)
         self._load[i] += 1
         ref = self._actors[i].transform.remote(block_ref)
-        self._by_ref = getattr(self, "_by_ref", {})
         self._by_ref[ref._id.binary()] = i
         return ref
 
     def task_done(self, ref):
-        i = getattr(self, "_by_ref", {}).pop(ref._id.binary(), None)
+        i = self._by_ref.pop(ref._id.binary(), None)
         if i is not None and i in self._load:
             self._load[i] = max(0, self._load[i] - 1)
 
@@ -239,6 +239,10 @@ class AutoScalingActorPool:
                 ray_tpu.kill(a)
             except Exception:  # noqa: BLE001 — already dead
                 pass
+        # drop load bookkeeping for submissions whose task_done never came
+        # (the materialize path is fire-and-forget — see _Pipeline)
+        self._by_ref.clear()
+        self._load = {i: 0 for i in self._load}
 
 
 # ---------------------------------------------------------------------------

@@ -156,7 +156,13 @@ class _CallableWrapper:
 class _Pipeline:
     """Executable form of a Dataset plan: source producers + stage list.
     Submits ONE chained ref pipeline per source block; actor stages route
-    through their pool."""
+    through their pool.
+
+    Pools here are FIRE-AND-FORGET: materialize() submits every block
+    before any resolves and shuts the pools down right after the barrier,
+    so no task_done feedback flows and least-loaded routing degrades to
+    submission-count balancing (which is uniform). The streaming executor
+    (_executor.StreamingExecutorV2) is the path with live load feedback."""
 
     def __init__(self, producers, stages: List[_Stage]):
         from ray_tpu.remote_function import RemoteFunction

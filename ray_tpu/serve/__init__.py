@@ -29,7 +29,8 @@ class Deployment:
                  autoscaling_config: Optional[dict] = None,
                  ray_actor_options: Optional[dict] = None,
                  max_concurrent_queries: int = 100,
-                 init_args: tuple = (), init_kwargs: Optional[dict] = None):
+                 init_args: tuple = (), init_kwargs: Optional[dict] = None,
+                 version: Optional[str] = None):
         self._target = target
         self.name = name
         self.num_replicas = num_replicas
@@ -38,6 +39,12 @@ class Deployment:
         self.max_concurrent_queries = max_concurrent_queries
         self._init_args = init_args
         self._init_kwargs = dict(init_kwargs or {})
+        # Stable code identity: redeploying with the same version is a pure
+        # replica-count/options update (in-place rescale, replica state
+        # kept); a changed version forces a rolling restart. Without it the
+        # controller falls back to comparing pickle bytes, which cloudpickle
+        # does not guarantee deterministic (reference: serve version=).
+        self.version = version
 
     def options(self, **overrides) -> "Deployment":
         cfg = dict(
@@ -48,6 +55,7 @@ class Deployment:
             init_args=self._init_args,
             init_kwargs=self._init_kwargs,
             name=self.name,
+            version=self.version,
         )
         cfg.update(overrides)
         name = cfg.pop("name")
@@ -62,6 +70,7 @@ class Deployment:
             ray_actor_options=self.ray_actor_options,
             max_concurrent_queries=self.max_concurrent_queries,
             init_args=args, init_kwargs=kwargs,
+            version=self.version,
         )
 
 
@@ -69,7 +78,8 @@ def deployment(_target=None, *, name: Optional[str] = None,
                num_replicas: int = 1,
                autoscaling_config: Optional[dict] = None,
                ray_actor_options: Optional[dict] = None,
-               max_concurrent_queries: int = 100):
+               max_concurrent_queries: int = 100,
+               version: Optional[str] = None):
     """`@serve.deployment` decorator (reference: serve.api.deployment)."""
 
     def wrap(target):
@@ -79,6 +89,7 @@ def deployment(_target=None, *, name: Optional[str] = None,
             autoscaling_config=autoscaling_config,
             ray_actor_options=ray_actor_options,
             max_concurrent_queries=max_concurrent_queries,
+            version=version,
         )
 
     if _target is not None:
@@ -102,6 +113,7 @@ def run(dep: Deployment, *, wait_for_ready: bool = True,
             autoscaling=dep.autoscaling_config,
             actor_options=dep.ray_actor_options,
             max_concurrent=dep.max_concurrent_queries,
+            version=dep.version,
         ),
         timeout=timeout,
     )

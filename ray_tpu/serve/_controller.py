@@ -52,11 +52,37 @@ class ServeController:
 
     # -- deployment API -------------------------------------------------
 
+    @staticmethod
+    def _config_matches(old_cfg: dict, new_cfg: dict) -> Optional[str]:
+        """None if the new config is the same logical deployment (in-place
+        rescale is safe); else the name of the first differing field.
+
+        Identity is the explicitly-passed options plus, for the code blobs,
+        the user-supplied `version` when one is given — cloudpickle bytes
+        are not guaranteed deterministic across calls for the same logical
+        callable, so a byte mismatch alone must not force a roll when the
+        user pinned a version (reference: serve deployment `version=` and
+        the lightweight-config-update path in deployment_state.py)."""
+        for k in ("autoscaling", "actor_options", "max_concurrent"):
+            if old_cfg[k] != new_cfg[k]:
+                return k
+        if old_cfg.get("version") is not None \
+                and old_cfg.get("version") == new_cfg.get("version"):
+            return None
+        if old_cfg.get("version") != new_cfg.get("version"):
+            return "version"
+        # no version pinned on either side: fall back to blob bytes
+        for k in ("callable_blob", "init_args_blob"):
+            if old_cfg[k] != new_cfg[k]:
+                return k
+        return None
+
     async def deploy(self, name: str, callable_blob: bytes,
                      init_args_blob: bytes, num_replicas: int,
                      autoscaling: Optional[dict] = None,
                      actor_options: Optional[dict] = None,
-                     max_concurrent: int = 100) -> bool:
+                     max_concurrent: int = 100,
+                     version: Optional[str] = None) -> bool:
         await self._ensure_loop()
         config = {
             "callable_blob": callable_blob,
@@ -64,19 +90,29 @@ class ServeController:
             "autoscaling": autoscaling,
             "actor_options": dict(actor_options or {}),
             "max_concurrent": max_concurrent,
+            "version": version,
         }
         async with self._scale_lock:
             old = self.deployments.get(name)
-            if old is not None and old["config"] == config:
-                # identical config: a pure replica-count update — rescale in
-                # place, no roll (reference: deployment_state only restarts
-                # replicas whose config actually changed)
+            differs = (None if old is None
+                       else self._config_matches(old["config"], config))
+            if old is not None and differs is None:
+                # same logical deployment: a pure replica-count update —
+                # rescale in place, no roll (reference: deployment_state
+                # only restarts replicas whose config actually changed).
+                # Keep the OLD blobs so new replicas of a version-pinned
+                # deployment match the running ones byte-for-byte.
                 old["target"] = num_replicas
                 await self._scale_to_locked(name, num_replicas)
                 return True
             if old is not None:
-                # config change: roll all existing replicas (no publish for
-                # the intermediate empty set)
+                # config change (field `differs`): roll all existing
+                # replicas (no publish for the intermediate empty set)
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "serve deployment %s: rolling restart (config field "
+                    "%r changed)", name, differs)
                 old["target"] = 0
                 await self._scale_to_locked(name, 0, publish=False)
             self.deployments[name] = {

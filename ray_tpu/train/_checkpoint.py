@@ -159,16 +159,68 @@ class Checkpoint:
 
         Leaves that are jax.Arrays in `skeleton` are device_put with the
         skeleton's sharding (resharding on restore is free this way).
+
+        Leaves this rank saved as multiple local shards (multi-chip hosts
+        where the process holds several non-replicated regions) are merged
+        back by region from the rank manifest and placed shard-by-shard;
+        that only works when the skeleton expects the SAME local regions —
+        a world-size or sharding change must go through load_consolidated.
         """
         import io
 
         import jax
 
-        data = self._storage().read_bytes(self.rank_file(rank))
+        s = self._storage()
+        data = s.read_bytes(self.rank_file(rank))
         with np.load(io.BytesIO(data)) as z:
-            flat = {k: z[k] for k in z.files if "#shard" not in k}
-        rebuilt = _unflatten_from_paths(flat, skeleton)
-        return _place_onto(skeleton, rebuilt)
+            raw = {k: z[k] for k in z.files}
+        try:
+            shards_meta = s.read_json(
+                s.join(self.path, f"manifest_{rank}.json")).get("shards", {})
+        except FileNotFoundError:  # pre-metadata checkpoint
+            shards_meta = {}
+        flat = {k: v for k, v in raw.items()
+                if "#shard" not in k and k not in shards_meta}
+        # per-leaf {region bounds: saved shard array} — shards are served
+        # directly, never merged into a global-shape buffer (a full-model
+        # allocation per process would defeat the whole point of per-rank
+        # sharded restore)
+        partial: Dict[str, Dict[tuple, np.ndarray]] = {}
+        for path, rec in shards_meta.items():
+            partial[path] = {
+                tuple(map(tuple, e["index"])): raw[e["key"]]
+                for e in rec["shards"]
+            }
+
+        flat_skel = _flatten_with_paths(skeleton)
+        placed: Dict[str, Any] = {}
+        for path, ref_leaf in flat_skel.items():
+            if isinstance(ref_leaf, jax.Array) and path in partial:
+                by_region = partial[path]
+                needed = {
+                    tuple(map(tuple, _shard_bounds(idx, ref_leaf.shape)))
+                    for idx in ref_leaf.sharding
+                    .addressable_devices_indices_map(ref_leaf.shape).values()
+                }
+                if not needed <= set(by_region):
+                    raise ValueError(
+                        f"checkpoint leaf {path!r} was saved with different "
+                        f"local shard regions than the restore sharding "
+                        f"expects (world size or sharding changed) — use "
+                        f"load_consolidated() instead of load_state()")
+                placed[path] = jax.make_array_from_callback(
+                    tuple(ref_leaf.shape), ref_leaf.sharding,
+                    lambda idx, b=by_region, sh=ref_leaf.shape:
+                        b[tuple(map(tuple, _shard_bounds(idx, sh)))])
+                continue
+            new_leaf = flat[path]
+            if isinstance(ref_leaf, jax.Array):
+                placed[path] = jax.device_put(new_leaf, ref_leaf.sharding)
+            elif isinstance(ref_leaf, (int, float)):
+                placed[path] = type(ref_leaf)(new_leaf)
+            else:
+                placed[path] = new_leaf
+        return _unflatten_from_paths(placed, skeleton)
 
     def _rank_ids(self) -> List[int]:
         s = self._storage()

@@ -342,3 +342,54 @@ def test_compiled_oversized_payload_degrades_to_error(ray_init):
     assert compiled.execute(2048).get(timeout=60).shape == (2048,)
     compiled.teardown()
     _kill(a)
+
+
+def test_compile_rejects_const_only_actor(ray_init):
+    """An actor whose steps read nothing (all-const args) could never
+    observe STOP — its loop would free-run and leak at teardown. Compile
+    must reject the plan up front (ADVICE r4)."""
+
+    @ray_tpu.remote
+    class A:
+        def f(self, x):
+            return x
+
+    @ray_tpu.remote
+    class B:
+        def tick(self):
+            return 1
+
+    a, b = A.remote(), B.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.f.bind(inp), b.tick.bind()])
+    with pytest.raises(ValueError, match="InputNode- or channel-sourced"):
+        dag.experimental_compile()
+    _kill(a, b)
+
+
+def test_execute_raises_after_poisoned_entry_writes(ray_init):
+    """Partial entry-write failure desynchronizes the pipeline; later
+    execute() calls must fail loudly, not return shifted results."""
+
+    @ray_tpu.remote
+    class A:
+        def f(self, x):
+            return x
+
+    @ray_tpu.remote
+    class B:
+        def g(self, x):
+            return x
+
+    a, b = A.remote(), B.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.f.bind(inp), b.g.bind(inp)])
+    compiled = dag.experimental_compile(max_in_flight=2)
+    assert compiled.execute(1).get(timeout=60) == [1, 1]
+    # simulate a partial feed: first entry succeeded, second timed out
+    compiled._poisoned = "entry write to 'driver->1' failed after 1 entry channel(s) were already fed"
+    with pytest.raises(RuntimeError, match="desynchronized"):
+        compiled.execute(2)
+    compiled._poisoned = None
+    compiled.teardown()
+    _kill(a, b)
