@@ -13,11 +13,19 @@ doc/source/train/benchmarks.rst parity tables are time-based; MFU is the
 chip-neutral equivalent). vs_baseline > 1.0 means better hardware utilization
 than the reference's GPU path.
 
-MFU accounting (PaLM appendix-B convention): model FLOPs per token =
-6·N + 12·L·dim·seq — the attention term matters at long context (at seq 8192
-it is ~85% of 6N for this model; omitting it, as round ≤3 did, makes MFU
-artificially fall with sequence length even at constant hardware
-utilization). `mfu_6n` keeps the old parameter-only number for continuity.
+MFU accounting: the HEADLINE `vs_baseline` uses the parameter-only 6N
+convention (`mfu_6n`) — the same accounting as rounds 1-3, so the trend line
+is comparable across rounds (VERDICT r4 weak #1: the r4 switch to
+attention-inclusive FLOPs against an unchanged 0.40 baseline inflated
+vs_baseline while tokens/s fell; that redefinition is reverted). The
+attention-inclusive PaLM appendix-B number (6·N + 12·L·dim·seq) is still
+reported as `mfu_palm` — at long context it is the truer utilization gauge
+(at seq 8192 the attention term is ~85% of 6N for this model) but it gets
+its own column, not the baseline's denominator.
+
+`attn_ab` publishes the flash-kernel vs naive-XLA attention A/B at long
+sequence (VERDICT r4 next #2 / SURVEY hard-part #7): same model, same
+sharding, only the attention implementation differs.
 """
 
 from __future__ import annotations
@@ -81,14 +89,16 @@ def main():
     mesh = MeshSpec(dp=1, fsdp=1, tp=1, sp=1).build(jax.devices()[:1])
     peak = peak_flops_for(dev)
 
-    def run_config(batch, seq, steps, loss_chunk, remat):
+    def run_config(batch, seq, steps, loss_chunk, remat, run_cfg=None):
+        run_cfg = run_cfg or cfg
         init_state, shard_state, train_step, data_sharding = make_train_step(
-            cfg, mesh, learning_rate=1e-4, remat=remat, loss_chunk=loss_chunk
+            run_cfg, mesh, learning_rate=1e-4, remat=remat,
+            loss_chunk=loss_chunk
         )
         state = shard_state(init_state(jax.random.key(0)))
         tokens = jax.device_put(
             jax.random.randint(jax.random.key(1), (batch, seq), 0,
-                               cfg.vocab_size, dtype=jnp.int32),
+                               run_cfg.vocab_size, dtype=jnp.int32),
             data_sharding,
         )
         # compile + warmup. NOTE: sync via float(loss) value transfer —
@@ -132,17 +142,59 @@ def main():
                 msg = re.sub(r"\x1b\[[0-9;]*m", "", str(e).split("\n")[0])
                 sweep[str(sw_seq)] = {"error": msg[:120]}
 
+    # flash-kernel vs naive-XLA attention A/B at long sequence: identical
+    # model/optimizer/remat, only attention_impl differs. The xla column is
+    # what "let GSPMD lower the einsum attention" costs at 8k/16k.
+    attn_ab = {}
+    if on_tpu:
+        import dataclasses
+
+        # 4096 is the largest size the naive path compiles on one chip
+        # (even at batch 1 its (s, s) buffers kill the 8k compile) — it
+        # anchors the speedup number; 8k/16k document what only the
+        # kernel path can run at all
+        for ab_batch, ab_seq, ab_chunk, ab_remat in (
+                (2, 4096, 4096, "dots"),
+                (1, 8192, 2048, "ffn"), (1, 16384, 2048, "ffn")):
+            row = {}
+            for impl in ("flash", "xla"):
+                ab_cfg = dataclasses.replace(cfg, attention_impl=impl)
+                try:
+                    tps, sdt, _ = run_config(ab_batch, ab_seq, 4, ab_chunk,
+                                             ab_remat, run_cfg=ab_cfg)
+                    row[impl] = {"tokens_per_s": round(tps, 1),
+                                 "step_ms": round(sdt * 1e3, 2)}
+                except Exception as e:  # noqa: BLE001 — publish the failure
+                    import re
+
+                    msg = re.sub(r"\x1b\[[0-9;]*m", "",
+                                 str(e).split("\n")[0])
+                    row[impl] = {"error": msg[:120]}
+            if "tokens_per_s" in row.get("flash", {}) \
+                    and "tokens_per_s" in row.get("xla", {}):
+                row["flash_speedup"] = round(
+                    row["flash"]["tokens_per_s"]
+                    / row["xla"]["tokens_per_s"], 3)
+            elif "tokens_per_s" in row.get("flash", {}) \
+                    and "error" in row.get("xla", {}):
+                row["note"] = ("kernel path runs; naive s^2 attention "
+                               "fails to compile at this size on one chip")
+            attn_ab[str(ab_seq)] = row
+
     n_params = cfg.num_params()
-    mfu = model_flops_per_token(cfg, seq) * tokens_per_sec / peak
+    mfu_palm = model_flops_per_token(cfg, seq) * tokens_per_sec / peak
     mfu_6n = 6.0 * n_params * tokens_per_sec / peak
-    vs_baseline = mfu / BASELINE_MFU
+    # headline: 6N accounting against the 0.40 GPU-path baseline — the same
+    # ratio rounds 1-3 reported
+    vs_baseline = mfu_6n / BASELINE_MFU
 
     # control-plane numbers tracked beside MFU (VERDICT r2 weak #7): quote
     # the committed bench_core artifact for this round
     core = {}
     import os
 
-    for cand in ("BENCH_CORE_r04.json", "BENCH_CORE_r03.json"):
+    for cand in ("BENCH_CORE_r05.json", "BENCH_CORE_r04.json",
+                 "BENCH_CORE_r03.json"):
         try:
             path = os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), cand)
@@ -159,8 +211,9 @@ def main():
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(vs_baseline, 3),
-        "mfu": round(mfu, 4),
+        "vs_baseline_accounting": "mfu_6n / 0.40 (rounds 1-3 convention)",
         "mfu_6n": round(mfu_6n, 4),
+        "mfu_palm": round(mfu_palm, 4),
         "params": n_params,
         "device": getattr(dev, "device_kind", str(dev)),
         "batch": batch,
@@ -168,6 +221,7 @@ def main():
         "step_ms": round(dt * 1e3, 2),
         "loss": round(final_loss, 4),
         "seq_sweep": sweep,
+        "attn_ab": attn_ab,
         "bench_core": core,
     }))
 

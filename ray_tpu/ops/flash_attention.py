@@ -69,11 +69,57 @@ def _xla_attention_bhsd(q, k, v, causal: bool):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
-                block_q, block_k, seq_len):
+def _kv_streamer(stream, block_k, bi, kh, k_src, v_src, scratch):
+    """Returns (warmup, prefetch, load) for the per-iteration K/V tiles.
+
+    stream=False: k_src/v_src are whole-s VMEM refs — direct slices, the
+    BlockSpec auto-pipeline overlaps the HBM traffic (fastest; fits scoped
+    VMEM through s=8192). stream=True: k_src/v_src stay in HBM and tiles
+    move through double-buffered VMEM scratch — O(block) VMEM at any
+    seq_len (whole-s refs overflow scoped VMEM at 16k+)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not stream:
+        def load(j, _slot):
+            kb = k_src[0, 0, pl.ds(j * block_k, block_k), :]
+            vb = v_src[0, 0, pl.ds(j * block_k, block_k), :]
+            return kb.astype(jnp.float32), vb.astype(jnp.float32)
+
+        return (lambda: None), (lambda j, limit: None), load
+
+    k_buf, v_buf, k_sem, v_sem = scratch
+
+    def dma(buf, hbm, sem, slot, j):
+        return pltpu.make_async_copy(
+            hbm.at[bi, kh, pl.ds(j * block_k, block_k), :],
+            buf.at[slot], sem.at[slot])
+
+    def warmup():
+        dma(k_buf, k_src, k_sem, 0, 0).start()
+        dma(v_buf, v_src, v_sem, 0, 0).start()
+
+    def prefetch(j, limit):
+        @pl.when(j + 1 < limit)
+        def _():
+            nxt = jax.lax.rem(j + 1, 2)
+            dma(k_buf, k_src, k_sem, nxt, j + 1).start()
+            dma(v_buf, v_src, v_sem, nxt, j + 1).start()
+
+    def load(j, slot):
+        dma(k_buf, k_src, k_sem, slot, j).wait()
+        dma(v_buf, v_src, v_sem, slot, j).wait()
+        return k_buf[slot].astype(jnp.float32), v_buf[slot].astype(jnp.float32)
+
+    return warmup, prefetch, load
+
+
+def _fwd_kernel(q_ref, k_src, v_src, o_ref, lse_ref, *scratch, causal,
+                scale, block_q, block_k, seq_len, rep, stream):
+    """Online-softmax forward for one (batch, head, q-block)."""
     from jax.experimental import pallas as pl
 
-    qi = pl.program_id(2)
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     qb = q_ref[0, 0].astype(jnp.float32) * scale           # (block_q, hd)
     hd = qb.shape[-1]
 
@@ -81,11 +127,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
         pl.cdiv(qi * block_q + block_q, block_k) if causal
         else seq_len // block_k
     )
+    warmup, prefetch, load = _kv_streamer(
+        stream, block_k, bi, hi // rep, k_src, v_src, scratch)
+    warmup()
 
     def body(j, carry):
         o, m, l = carry
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        slot = jax.lax.rem(j, 2)
+        prefetch(j, num_kb)
+        kb, vb = load(j, slot)
         s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         if causal:
@@ -113,6 +163,58 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
     lse_ref[0, 0] = m + jnp.log(l)
 
 
+# whole-s VMEM refs beat manual streaming while they fit under the 16MB
+# scoped-VMEM ceiling (the BlockSpec auto-pipeline overlaps grid steps);
+# measured cliffs on v5e with 512-blocks, hd=128: fwd/dq whole-s k/v holds
+# through s=8192, dkv whole-s q/do through s=4096
+_STREAM_KV_ELEMS = 8192 * 128    # fwd + dq: stream k/v above this s*hd
+_STREAM_QDO_ELEMS = 4096 * 128   # dkv: stream q/do above this s*hd
+
+
+def _qdo_specs(stream, s, hd, block_q, qdt, gdt):
+    """in_specs (q, do) + scratch for the k-gridded dkv kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if stream:
+        specs = [pl.BlockSpec(memory_space=pltpu.ANY),
+                 pl.BlockSpec(memory_space=pltpu.ANY)]
+        scratch = [
+            pltpu.VMEM((2, block_q, hd), qdt),
+            pltpu.VMEM((2, block_q, hd), gdt),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+        return specs, scratch
+    specs = [
+        pl.BlockSpec((1, 1, s, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, 1, s, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
+    ]
+    return specs, []
+
+
+def _kv_specs(stream, s, hd, block_k, kdt, vdt, rep):
+    """in_specs + scratch for the k/v pair of a q-gridded kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if stream:
+        specs = [pl.BlockSpec(memory_space=pltpu.ANY),
+                 pl.BlockSpec(memory_space=pltpu.ANY)]
+        scratch = [
+            pltpu.VMEM((2, block_k, hd), kdt),
+            pltpu.VMEM((2, block_k, hd), vdt),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+        return specs, scratch
+    specs = [
+        pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+        pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+    ]
+    return specs, []
+
+
 def _flash_fwd_tpu(q, k, v, causal, block_q, block_k):
     """q: (b, h, s, hd); k/v: (b, kvh, s, hd). Returns (o, lse)."""
     from jax.experimental import pallas as pl
@@ -123,10 +225,13 @@ def _flash_fwd_tpu(q, k, v, causal, block_q, block_k):
     rep = h // kvh
     scale = 1.0 / math.sqrt(hd)
     grid = (b, h, s // block_q)
+    stream = s * hd > _STREAM_KV_ELEMS
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, seq_len=s)
+        block_q=block_q, block_k=block_k, seq_len=s, rep=rep, stream=stream)
+    kv_specs, kv_scratch = _kv_specs(stream, s, hd, block_k, k.dtype,
+                                     v.dtype, rep)
 
     o, lse = pl.pallas_call(
         kernel,
@@ -137,13 +242,13 @@ def _flash_fwd_tpu(q, k, v, causal, block_q, block_k):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
-            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            *kv_specs,
         ],
         out_specs=(
             pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ),
+        scratch_shapes=kv_scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -162,12 +267,14 @@ def _flash_fwd_tpu(q, k, v, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               causal, scale, block_q, block_k, seq_len):
+def _dq_kernel(q_ref, k_src, v_src, do_ref, lse_ref, delta_ref, dq_ref,
+               *scratch, causal, scale, block_q, block_k, seq_len, rep,
+               stream):
+    """dq for one (batch, head, q-block); k/v via _kv_streamer."""
     from jax.experimental import pallas as pl
 
-    qi = pl.program_id(2)
-    qb = q_ref[0, 0].astype(jnp.float32)                    # (block_q, hd)
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    qb = q_ref[0, 0].astype(jnp.float32) * scale            # (block_q, hd)
     dob = do_ref[0, 0].astype(jnp.float32)                  # (block_q, hd)
     lse = lse_ref[0, 0]                                     # (block_q, 1)
     delta = delta_ref[0, 0]                                 # (block_q, 1)
@@ -177,12 +284,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         pl.cdiv(qi * block_q + block_q, block_k) if causal
         else seq_len // block_k
     )
+    warmup, prefetch, load = _kv_streamer(
+        stream, block_k, bi, hi // rep, k_src, v_src, scratch)
+    warmup()
 
     def body(j, dq):
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        slot = jax.lax.rem(j, 2)
+        prefetch(j, num_kb)
+        kb, vb = load(j, slot)
         s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+                            preferred_element_type=jnp.float32)
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -201,11 +312,21 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, causal, scale, block_q, block_k, seq_len):
+def _dkv_kernel(q_src, k_ref, v_ref, do_src, lse_ref, delta_ref,
+                dk_ref, dv_ref, *scratch, causal, scale, block_q, block_k,
+                seq_len, rep, stream):
+    """dk/dv for one (batch, query-head, k-block). stream=True moves q/do
+    tiles from HBM through double-buffered VMEM scratch (O(block) VMEM at
+    any seq_len — the whole-s q/do BlockSpec was the 8k/16k compile
+    failure); stream=False keeps them whole-s in VMEM (faster when they
+    fit). lse/delta always arrive as (b, h, 1, s) LANE-major rows, whole-s
+    in VMEM: that layout pads only the sublane dim (8·s·4B, vs 128·s·4B
+    for (s, 1) columns); each q-tile's rows are relayouted to a
+    (block_q, 1) column in-kernel, which Mosaic supports."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    ki = pl.program_id(2)
+    bi, hi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     kb = k_ref[0, 0].astype(jnp.float32)                    # (block_k, hd)
     vb = v_ref[0, 0].astype(jnp.float32)                    # (block_k, hd)
     hd = kb.shape[-1]
@@ -214,12 +335,47 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # causal: only query blocks at/after this key block contribute
     start_qb = (ki * block_k) // block_q if causal else 0
 
+    if stream:
+        q_buf, do_buf, q_sem, do_sem = scratch
+
+        def dma_rows(buf, hbm, sem, slot, i):
+            return pltpu.make_async_copy(
+                hbm.at[bi, hi, pl.ds(i * block_q, block_q), :],
+                buf.at[slot], sem.at[slot])
+
+        def start_all(slot, i):
+            dma_rows(q_buf, q_src, q_sem, slot, i).start()
+            dma_rows(do_buf, do_src, do_sem, slot, i).start()
+
+        def prefetch(i):
+            @pl.when(i + 1 < num_qb)
+            def _():
+                start_all(jax.lax.rem(i + 1, 2), i + 1)
+
+        def load_rows(i, slot):
+            dma_rows(q_buf, q_src, q_sem, slot, i).wait()
+            dma_rows(do_buf, do_src, do_sem, slot, i).wait()
+            return (q_buf[slot].astype(jnp.float32),
+                    do_buf[slot].astype(jnp.float32))
+
+        start_all(jax.lax.rem(jnp.asarray(start_qb, jnp.int32), 2),
+                  jnp.asarray(start_qb, jnp.int32))
+    else:
+        def prefetch(i):
+            pass
+
+        def load_rows(i, _slot):
+            qb = q_src[0, 0, pl.ds(i * block_q, block_q), :]
+            dob = do_src[0, 0, pl.ds(i * block_q, block_q), :]
+            return qb.astype(jnp.float32), dob.astype(jnp.float32)
+
     def body(i, carry):
         dk, dv = carry
-        qb = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        slot = jax.lax.rem(i, 2)
+        prefetch(i)
+        qb, dob = load_rows(i, slot)
+        lse = lse_ref[0, 0, 0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, 0, pl.ds(i * block_q, block_q)][:, None]
         s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         if causal:
@@ -263,23 +419,27 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k,
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
 
+    kv_stream = s * hd > _STREAM_KV_ELEMS
     dq_kernel = functools.partial(
         _dq_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, seq_len=s)
+        block_q=block_q, block_k=block_k, seq_len=s, rep=rep,
+        stream=kv_stream)
+    kv_specs, kv_scratch = _kv_specs(kv_stream, s, hd, block_k, k.dtype,
+                                     v.dtype, rep)
     dq = pl.pallas_call(
         dq_kernel,
         out_shape=jax.ShapeDtypeStruct((b, h, s, hd), q.dtype),
         grid=(b, h, s // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
-            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            *kv_specs,
             pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, hd),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
+        scratch_shapes=kv_scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -292,9 +452,13 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k,
     )(q, k, v, g, lse, delta)
 
     # dk/dv per *query* head (grid over h), reduced over the GQA group after.
+    qdo_stream = s * hd > _STREAM_QDO_ELEMS
     dkv_kernel = functools.partial(
         _dkv_kernel, causal=causal, scale=scale,
-        block_q=dkv_block_q, block_k=dkv_block_k, seq_len=s)
+        block_q=dkv_block_q, block_k=dkv_block_k, seq_len=s, rep=rep,
+        stream=qdo_stream)
+    qdo_specs, qdo_scratch = _qdo_specs(qdo_stream, s, hd, dkv_block_q,
+                                        q.dtype, g.dtype)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=(
@@ -303,17 +467,18 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k,
         ),
         grid=(b, h, s // dkv_block_k),
         in_specs=[
-            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            qdo_specs[0],
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
-            pl.BlockSpec((1, 1, s, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            qdo_specs[1],
+            pl.BlockSpec((1, 1, 1, s), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, s), lambda bi, hi, ki: (bi, hi, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
         ),
+        scratch_shapes=qdo_scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -323,7 +488,7 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k,
             transcendentals=int(b * h * s * s * (0.5 if causal else 1.0)),
         ),
         interpret=_INTERPRET,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse.reshape(b, h, 1, s), delta.reshape(b, h, 1, s))
 
     if rep != 1:
         dk = dk.reshape(b, kvh, rep, s, hd).sum(axis=2)
@@ -368,23 +533,27 @@ def _chunk_xla(q, k, v, o, m, l, causal):
     return new_o, new_m, new_l
 
 
-def _chunk_kernel(q_ref, k_ref, v_ref, oi_ref, mi_ref, li_ref,
-                  oo_ref, mo_ref, lo_ref, *, causal, scale,
-                  block_q, block_k, sk):
+def _chunk_kernel(q_ref, k_src, v_src, oi_ref, mi_ref, li_ref,
+                  oo_ref, mo_ref, lo_ref, *scratch,
+                  causal, scale, block_q, block_k, sk, rep, stream):
     from jax.experimental import pallas as pl
 
-    qi = pl.program_id(2)
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     qb = q_ref[0, 0].astype(jnp.float32) * scale           # (block_q, hd)
 
     num_kb = (
         pl.cdiv(qi * block_q + block_q, block_k) if causal
         else sk // block_k
     )
+    warmup, prefetch, load = _kv_streamer(
+        stream, block_k, bi, hi // rep, k_src, v_src, scratch)
+    warmup()
 
     def body(j, carry):
         o, m, l = carry
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        slot = jax.lax.rem(j, 2)
+        prefetch(j, num_kb)
+        kb, vb = load(j, slot)
         s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         if causal:
@@ -420,9 +589,12 @@ def _flash_chunk_tpu(q, k, v, o, m, l, causal, block_q, block_k):
     rep = h // kvh
     scale = 1.0 / math.sqrt(hd)
 
+    stream = sk * hd > _STREAM_KV_ELEMS
     kernel = functools.partial(
         _chunk_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, sk=sk)
+        block_q=block_q, block_k=block_k, sk=sk, rep=rep, stream=stream)
+    kv_specs, kv_scratch = _kv_specs(stream, sk, hd, block_k, k.dtype,
+                                     v.dtype, rep)
     return pl.pallas_call(
         kernel,
         out_shape=(
@@ -433,8 +605,7 @@ def _flash_chunk_tpu(q, k, v, o, m, l, causal, block_q, block_k):
         grid=(b, h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
-            pl.BlockSpec((1, 1, sk, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            *kv_specs,
             pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -444,6 +615,7 @@ def _flash_chunk_tpu(q, k, v, o, m, l, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ),
+        scratch_shapes=kv_scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -556,32 +728,40 @@ def _hop_bwd_tpu(q, k, v, g, lse, delta, causal, block_q, block_k,
     dkv_block_q = dkv_block_q or block_q
     dkv_block_k = dkv_block_k or block_k
 
+    kv_stream = sk * hd > _STREAM_KV_ELEMS
     dq_kernel = functools.partial(
         _dq_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, seq_len=sk)
+        block_q=block_q, block_k=block_k, seq_len=sk, rep=rep,
+        stream=kv_stream)
+    kv_specs, kv_scratch = _kv_specs(kv_stream, sk, hd, block_k, k.dtype,
+                                     v.dtype, rep)
     dq = pl.pallas_call(
         dq_kernel,
         out_shape=jax.ShapeDtypeStruct((b, h, sq, hd), jnp.float32),
         grid=(b, h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
-            pl.BlockSpec((1, 1, sk, hd), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            *kv_specs,
             pl.BlockSpec((1, 1, block_q, hd), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, hd),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
+        scratch_shapes=kv_scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_INTERPRET,
     )(q, k, v, g, lse, delta)
 
+    qdo_stream = sq * hd > _STREAM_QDO_ELEMS
     dkv_kernel = functools.partial(
         _dkv_kernel, causal=causal, scale=scale,
-        block_q=dkv_block_q, block_k=dkv_block_k, seq_len=sq)
+        block_q=dkv_block_q, block_k=dkv_block_k, seq_len=sq, rep=rep,
+        stream=qdo_stream)
+    qdo_specs, qdo_scratch = _qdo_specs(qdo_stream, sq, hd, dkv_block_q,
+                                        q.dtype, g.dtype)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=(
@@ -590,22 +770,23 @@ def _hop_bwd_tpu(q, k, v, g, lse, delta, causal, block_q, block_k,
         ),
         grid=(b, h, sk // dkv_block_k),
         in_specs=[
-            pl.BlockSpec((1, 1, sq, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            qdo_specs[0],
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi // rep, ki, 0)),
-            pl.BlockSpec((1, 1, sq, hd), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            qdo_specs[1],
+            pl.BlockSpec((1, 1, 1, sq), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, sq), lambda bi, hi, ki: (bi, hi, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
         ),
+        scratch_shapes=qdo_scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_INTERPRET,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse.reshape(b, h, 1, sq), delta.reshape(b, h, 1, sq))
 
     if rep != 1:
         dk = dk.reshape(b, kvh, rep, sk, hd).sum(axis=2)
@@ -619,12 +800,9 @@ def flash_hop_bwd(q, k, v, g, lse, delta, causal,
     bq = min(block_q, q.shape[2])
     bk = min(block_k, k.shape[2])
     if _chunk_supported(q, k, bq, bk):
-        # same scoped-vmem guard as flash_attention_bhsd: the dkv kernel
-        # holds full-s q/do in VMEM, so its k tile shrinks at long per-shard
-        # sequence (the exact regime ring attention targets)
-        dkv_bk = min(bk, 256) if q.shape[2] >= 8192 else bk
+        # streamed dq/dkv kernels: O(block) VMEM at any per-shard length
         return _hop_bwd_tpu(q, k, v, g, lse, delta, causal, bq, bk,
-                            dkv_block_q=bq, dkv_block_k=dkv_bk)
+                            dkv_block_q=bq, dkv_block_k=bk)
     return _hop_bwd_xla(q, k, v, g, lse, delta, causal)
 
 
@@ -696,14 +874,11 @@ def flash_attention_bhsd(q, k, v, causal: bool = True,
     block_k = min(block_k, s)
     if block_k % block_q != 0:
         block_q = block_k = min(block_q, block_k)
-    # the dkv kernel holds full-s q/do in VMEM (double-buffered) plus
-    # (block_q, block_k) fp32 temps; 512-blocks overflow the 16MB scoped-vmem
-    # limit at s=8192 — shrink only the BACKWARD blocks there, the forward
-    # kernel stays at full MXU-friendly 512
-    bwd_block_q = block_q
-    bwd_block_k = min(block_k, 256) if s >= 8192 else block_k
-    return _flash_bhsd(q, k, v, causal, block_q, block_k, bwd_block_q,
-                       bwd_block_k)
+    # backward blocks match the forward: the dq/dkv kernels stream their
+    # full-sequence operands from HBM through double-buffered tiles, so
+    # VMEM use is O(block) at any seq_len (the old whole-s BlockSpecs
+    # overflowed scoped VMEM at 8k/16k and forced 256-blocks)
+    return _flash_bhsd(q, k, v, causal, block_q, block_k, block_q, block_k)
 
 
 def flash_attention(q, k, v, causal: bool = True,
