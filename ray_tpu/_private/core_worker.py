@@ -506,6 +506,12 @@ class CoreWorker:
         self._bg_futures: set = set()
         self._worker_clients: Dict[str, RpcClient] = {}
         self._owner_clients: Dict[str, RpcClient] = {}
+        # compiled-graph channel plane: rings THIS process reads, exposed
+        # for cross-node writers via rpc_chan_write (reference:
+        # torch_tensor_accelerator_channel.py — remote channel endpoints)
+        self._dag_channels: Dict[tuple, Any] = {}
+        self._dag_channel_locks: Dict[tuple, Any] = {}
+        self._dag_channel_seqs: Dict[tuple, int] = {}  # idempotency marks
         # executor state (workers only)
         self.executor: Optional["TaskExecutor"] = None
         self._function_cache: Dict[str, Any] = {}
@@ -2398,6 +2404,61 @@ class CoreWorker:
             await client.connect()
             self._worker_clients[address] = client
         return client
+
+    # ------------------------------------------------------------------
+    # compiled-graph channel plane (reference: experimental/channel/
+    # torch_tensor_accelerator_channel.py — cross-node channel endpoints)
+    # ------------------------------------------------------------------
+
+    def register_dag_channel(self, dag_id: str, edge: str, chan) -> None:
+        """Expose a locally-created ring so cross-node writers can reach it
+        through rpc_chan_write. Called from the reader's executor thread."""
+        self._dag_channels[(dag_id, edge)] = chan
+
+    def unregister_dag_channel(self, dag_id: str, edge: str) -> None:
+        self._dag_channels.pop((dag_id, edge), None)
+        self._dag_channel_locks.pop((dag_id, edge), None)
+        self._dag_channel_seqs.pop((dag_id, edge), None)
+
+    async def rpc_chan_write(self, conn_id: int, payload: dict) -> dict:
+        """Write one slot into a ring this process reads (the cross-node
+        half of a compiled-graph edge). Per-edge FIFO lock keeps slot order
+        equal to RPC arrival order even though writes block in a thread.
+
+        `seq` is the writer's per-edge slot counter and makes the write
+        IDEMPOTENT: the RPC client retries on lost connections, and a
+        retry of a write the ring already took must not land a second
+        copy (a duplicate slot would shift every later execution's value
+        on that edge). An edge has exactly one writer (SPSC), so a simple
+        last-applied watermark suffices."""
+        key = (payload["dag_id"], payload["edge"])
+        deadline = time.monotonic() + float(payload.get("open_timeout", 15))
+        while key not in self._dag_channels:
+            # the reader registers at executor-loop start; a writer racing
+            # ahead of it parks here rather than failing the edge
+            if time.monotonic() >= deadline:
+                return {"error": "no_such_channel"}
+            await asyncio.sleep(0.02)
+        lock = self._dag_channel_locks.get(key)
+        if lock is None:
+            lock = self._dag_channel_locks[key] = asyncio.Lock()
+        chan = self._dag_channels[key]
+        timeout = payload.get("timeout")
+        seq = payload.get("seq")
+        async with lock:
+            if seq is not None and seq <= self._dag_channel_seqs.get(key, -1):
+                return {"ok": True, "duplicate": True}
+            try:
+                await asyncio.to_thread(
+                    chan.write_bytes, payload["payload"],
+                    None if timeout is None else float(timeout))
+            except TimeoutError:
+                return {"error": "full"}
+            except ValueError as exc:  # oversized payload
+                return {"error": f"value:{exc}"}
+            if seq is not None:
+                self._dag_channel_seqs[key] = seq
+        return {"ok": True}
 
     # ------------------------------------------------------------------
     # actors (reference: actor_task_submitter.h:69, gcs_actor_manager.h:94)

@@ -22,9 +22,14 @@ Redesign for this framework:
     steps over the same channel plane (host tensors; device tensors take
     the XLA collective path inside jitted steps instead).
 
-Constraints (v1, matching the reference's single-node channel mode): all
-actors in one compiled DAG must live on the same node as the driver (the
-channel plane is the node's shm segment); methods must be synchronous.
+Cross-node DAGs (reference: channel/torch_tensor_accelerator_channel.py):
+each edge's ring lives in the READER's node store (readers create their
+own rings at executor-loop start); a writer on the same node opens the
+ring directly through the shared shm segment, a writer on another node
+ships slots over the worker RPC plane (RemoteChannel → rpc_chan_write on
+the reader's core worker), with the ring's futex-doorbell backpressure
+carried through the RPC reply. Methods must be synchronous; a compiled
+DAG does not survive actor restarts.
 """
 
 from __future__ import annotations
@@ -62,6 +67,10 @@ class _ActorPlan:
     steps: List[_Step] = field(default_factory=list)
     nslots: int = 8
     slot_size: int = 1 << 20
+    # out-edge → reader location {"node": node_id_hex, "address": rpc addr}:
+    # same-node edges open the reader's ring in the shared store; cross-node
+    # edges write through RemoteChannel → rpc_chan_write
+    edge_dests: Dict[str, dict] = field(default_factory=dict)
 
 
 def _reduce_vals(op: str, vals: List[Any]):
@@ -79,19 +88,49 @@ def _reduce_vals(op: str, vals: List[Any]):
     raise ValueError(f"unknown collective op {op!r}")
 
 
-def _open_channels(plan: _ActorPlan, edges: List[str], creator: bool):
+def _open_in_channels(plan: _ActorPlan, edges: List[str]):
+    """Create THIS process's read rings (the reader owns its rings) and
+    register them so cross-node writers can reach them via rpc_chan_write."""
     from ray_tpu._private.core_worker import get_core_worker
     from ray_tpu.experimental.channel import ShmChannel, channel_object_id
 
-    store = get_core_worker().store
-    if store is None:
+    cw = get_core_worker()
+    if cw.store is None:
         raise RuntimeError("compiled DAGs need a node-local shm store")
     chans = {}
     for e in edges:
         chans[e] = ShmChannel(
-            store, channel_object_id(plan.dag_id, e), creator=creator,
+            cw.store, channel_object_id(plan.dag_id, e), creator=True,
             nslots=plan.nslots, slot_size=plan.slot_size)
+        cw.register_dag_channel(plan.dag_id, e, chans[e])
     return chans
+
+
+def _open_writer(dag_id: str, edge: str, dest: dict, nslots: int,
+                 slot_size: int):
+    """Writer half of one edge: the reader's local ring when the reader
+    shares this node's store, RemoteChannel over the RPC plane otherwise.
+    Shared by actor executor loops and the driver's entry writers."""
+    from ray_tpu._private.core_worker import get_core_worker
+    from ray_tpu.experimental.channel import (RemoteChannel, ShmChannel,
+                                              channel_object_id)
+
+    cw = get_core_worker()
+    if dest.get("node", cw.node_id_hex) == cw.node_id_hex:
+        if cw.store is None:
+            raise RuntimeError("compiled DAGs need a node-local shm store")
+        return ShmChannel(
+            cw.store, channel_object_id(dag_id, edge), creator=False,
+            nslots=nslots, slot_size=slot_size)
+    return RemoteChannel(dag_id, edge, dest["address"], slot_size=slot_size)
+
+
+def _open_out_channels(plan: _ActorPlan, edges: List[str]):
+    return {
+        e: _open_writer(plan.dag_id, e, plan.edge_dests.get(e) or {},
+                        plan.nslots, plan.slot_size)
+        for e in edges
+    }
 
 
 def _plan_edges(plan: _ActorPlan) -> Tuple[List[str], List[str]]:
@@ -139,8 +178,13 @@ def _actor_loop(instance, plan: _ActorPlan):
     """Runs INSIDE the actor via __rt_call__ for the compiled DAG's
     lifetime. Returns per-loop stats at teardown."""
     in_edges, out_edges = _plan_edges(plan)
-    in_chans = _open_channels(plan, in_edges, creator=False)
-    out_chans = _open_channels(plan, out_edges, creator=False)
+    # create OWN read rings first (writers block-open them), then open
+    # writer halves toward each out-edge's reader. Everything after the
+    # in-ring creation runs under the cleanup `finally` — a failed
+    # out-open (dead peer, 30s open timeout) must not leak the pinned,
+    # registered in-rings for the process lifetime.
+    in_chans = _open_in_channels(plan, in_edges)
+    out_chans: Dict[str, Any] = {}
     executions = 0
     t_busy = 0.0
 
@@ -148,6 +192,7 @@ def _actor_loop(instance, plan: _ActorPlan):
         return in_chans[edge].read(timeout=None)
 
     try:
+        out_chans.update(_open_out_channels(plan, out_edges))
         while True:
             local_vals: Dict[int, Any] = {}
             chan_cache: Dict[str, Any] = {}
@@ -243,7 +288,13 @@ def _actor_loop(instance, plan: _ActorPlan):
                 break
             executions += 1
     finally:
-        for ch in list(in_chans.values()) + list(out_chans.values()):
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        for e, ch in in_chans.items():
+            cw.unregister_dag_channel(plan.dag_id, e)
+            ch.unpin()
+        for ch in out_chans.values():
             ch.unpin()
     return {"executions": executions, "busy_s": round(t_busy, 6)}
 
@@ -461,28 +512,80 @@ class CompiledDAG:
                 "compiled DAG must consume InputNode (every execution is "
                 "driven through the entry channels)")
 
-        # -- create ALL channels up front (driver is the creator) -------
+        # -- resolve actor locations (node + worker RPC address) --------
+        # Each edge's RING lives with its READER; writers on other nodes
+        # reach it through rpc_chan_write. Locations come from the control
+        # store's actor table, waiting out in-flight creations. A compiled
+        # DAG does not survive actor restarts (reference: aDAG tears down
+        # on actor death).
+        from ray_tpu._private import protocol as _pb
+
+        locs: Dict[str, dict] = {}
+        pending = set(self._actors)
+        deadline = time.monotonic() + 120
+        while pending:
+            for key in list(pending):
+                info = cw.run_sync(cw.control.call(
+                    "get_actor_info",
+                    {"actor_id": self._actors[key]._actor_id.binary()},
+                    timeout=10), timeout=20)
+                rec = info.get("actor")
+                if rec is None:
+                    raise ValueError(f"unknown actor {key[:8]} in DAG")
+                if rec.get("state") == _pb.ACTOR_DEAD:
+                    raise RuntimeError(
+                        f"actor {key[:8]} died before compile: "
+                        f"{rec.get('death_cause')}")
+                if rec.get("state") == _pb.ACTOR_ALIVE \
+                        and rec.get("worker_address"):
+                    locs[key] = {"node": rec["node_id"].hex(),
+                                 "address": rec["worker_address"]}
+                    pending.discard(key)
+            if pending:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{len(pending)} DAG actors not alive within 120s")
+                time.sleep(0.05)
+
+        driver_loc = {"node": cw.node_id_hex, "address": cw.address}
+        edge_reader: Dict[str, dict] = {e: driver_loc
+                                        for e in self._out_edges}
+        for key, plan in plans.items():
+            ins, _ = _plan_edges(plan)
+            for e in ins:
+                edge_reader[e] = locs[key]
+        for key, plan in plans.items():
+            _, outs = _plan_edges(plan)
+            plan.edge_dests = {e: edge_reader[e] for e in outs}
+
+        # -- driver's own read rings (results stream here) --------------
         from ray_tpu.experimental.channel import ShmChannel, channel_object_id
 
-        all_edges: List[str] = []
-        for plan in plans.values():
-            ins, outs = _plan_edges(plan)
-            all_edges.extend(ins)
-            all_edges.extend(outs)
-        all_edges.extend(self._entry_edges)
-        all_edges.extend(self._out_edges)
-        all_edges = list(dict.fromkeys(all_edges))
         self._channels: Dict[str, ShmChannel] = {}
-        for e in all_edges:
-            self._channels[e] = ShmChannel(
+        for e in self._out_edges:
+            ch = ShmChannel(
                 cw.store, channel_object_id(self.dag_id, e), creator=True,
                 nslots=self._nslots, slot_size=self._slot_size)
+            cw.register_dag_channel(self.dag_id, e, ch)
+            self._channels[e] = ch
+        # entry writers open LAZILY: the consumer actor creates its ring
+        # when its executor loop starts, and the local open block-waits
+        self._entry_dest = {e: edge_reader[e] for e in self._entry_edges}
+        self._entry_writers: Dict[str, Any] = {}
 
         # -- launch the per-actor executor loops ------------------------
         self._loop_refs = [
             self._actors[key].__rt_call__.remote(_actor_loop, plan)
             for key, plan in plans.items()
         ]
+
+    def _entry_writer(self, e: str):
+        w = self._entry_writers.get(e)
+        if w is None:
+            w = _open_writer(self.dag_id, e, self._entry_dest[e],
+                             self._nslots, self._slot_size)
+            self._entry_writers[e] = w
+        return w
 
     # -- runtime --------------------------------------------------------
 
@@ -515,7 +618,7 @@ class CompiledDAG:
         for i, e in enumerate(self._entry_edges):
             try:
                 # a full entry channel IS the pipeline backpressure
-                self._channels[e].write_bytes(payload, timeout=300)
+                self._entry_writer(e).write_bytes(payload, timeout=300)
             except Exception as exc:  # noqa: BLE001
                 if i == 0:
                     raise  # nothing fed yet — the DAG is still consistent
@@ -564,7 +667,7 @@ class CompiledDAG:
 
         for e in self._entry_edges:
             try:
-                self._channels[e].write(_STOP, timeout=30)
+                self._entry_writer(e).write(_STOP, timeout=30)
             except Exception:  # noqa: BLE001 — loop may already be dead
                 pass
         stats: List[dict] = []
@@ -575,6 +678,12 @@ class CompiledDAG:
                 "compiled DAG %s: executor loops did not stop cleanly (%s); "
                 "kill the stage actors to reclaim them", self.dag_id, exc)
         finally:
-            for ch in self._channels.values():
+            from ray_tpu._private.core_worker import get_core_worker
+
+            cw = get_core_worker()
+            for e, ch in self._channels.items():
+                cw.unregister_dag_channel(self.dag_id, e)
+                ch.unpin()
+            for ch in self._entry_writers.values():
                 ch.unpin()
         return stats

@@ -11,6 +11,13 @@ atomic publish, with no RPC, task submission, scheduling, or allocation.
 Ring capacity doubles as pipeline backpressure: `write` blocks when the
 consumer is `nslots` executions behind, exactly how the reference bounds
 in-flight compiled-DAG executions via its channel buffers.
+
+Blocking reads/writes park on a futex doorbell in the shared header
+(rt_chan_wait_readable / rt_chan_wait_writable) — no sleep-polling, so an
+idle compiled-DAG executor loop costs zero CPU and a hop wakes at kernel
+futex latency instead of a poll interval (the reference's channels block on
+OS primitives the same way). Waits are chunked so Python signal handlers
+(Ctrl-C) still run between kernel sleeps.
 """
 
 from __future__ import annotations
@@ -22,8 +29,9 @@ from typing import Any, Optional
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.native.build import lib_path
 
-_POLL_MIN = 20e-6   # 20µs floor: a hop is sub-ms, don't oversleep
-_POLL_MAX = 2e-3
+# futex waits release the GIL but block signal delivery for their duration;
+# cap each kernel sleep so KeyboardInterrupt lands within this bound
+_WAIT_CHUNK_S = 0.5
 
 
 class _Lib:
@@ -47,6 +55,12 @@ class _Lib:
             lib.rt_chan_close.argtypes = [ctypes.c_void_p]
             lib.rt_chan_readable.restype = ctypes.c_uint64
             lib.rt_chan_readable.argtypes = [ctypes.c_void_p]
+            lib.rt_chan_wait_readable.restype = ctypes.c_int
+            lib.rt_chan_wait_readable.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64]
+            lib.rt_chan_wait_writable.restype = ctypes.c_int
+            lib.rt_chan_wait_writable.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64]
             cls._instance = lib
         return cls._instance
 
@@ -78,14 +92,18 @@ class ShmChannel:
         self.oid = oid
         size = self._lib.rt_chan_required_size(nslots, slot_size)
         if creator:
-            store.create(oid, size)
-            store.seal(oid)
-            self._chan_off, chan_size = self._pin()
-            self._base = self._map_addr() + self._chan_off
-            rc = self._lib.rt_chan_init(self._base, chan_size, nslots,
-                                        slot_size)
+            view = store.create(oid, size)
+            # the ring header must be valid BEFORE seal publishes the
+            # object: a peer's open (get_blocking) returns the instant the
+            # seal lands, and an uninitialized header fails its magic check
+            addr = ctypes.addressof(ctypes.c_uint8.from_buffer(view))
+            rc = self._lib.rt_chan_init(addr, size, nslots, slot_size)
+            view.release()
             if rc != 0:
                 raise RuntimeError(f"channel init failed rc={rc}")
+            store.seal(oid)
+            self._chan_off, _ = self._pin()
+            self._base = self._map_addr() + self._chan_off
             self.slot_size = slot_size
         else:
             got = store.get_blocking(oid, timeout=30)
@@ -151,14 +169,23 @@ class ShmChannel:
             raise ValueError(f"payload of {n} bytes exceeds channel slot size")
         return True
 
+    def _wait(self, waiter, deadline: Optional[float]) -> bool:
+        """One parked doorbell wait (chunked); False once the deadline has
+        passed. `waiter` is rt_chan_wait_readable/_writable."""
+        if deadline is None:
+            waiter(self._base, int(_WAIT_CHUNK_S * 1e6))
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        waiter(self._base, int(min(remaining, _WAIT_CHUNK_S) * 1e6))
+        return True
+
     def write_bytes(self, payload, timeout: Optional[float] = None) -> None:
         deadline = None if timeout is None else time.monotonic() + timeout
-        delay = _POLL_MIN
         while not self.try_write_bytes(payload):
-            if deadline is not None and time.monotonic() >= deadline:
+            if not self._wait(self._lib.rt_chan_wait_writable, deadline):
                 raise TimeoutError("channel full (consumer stalled?)")
-            time.sleep(delay)
-            delay = min(delay * 2, _POLL_MAX)
 
     def try_read_bytes(self) -> Optional[bytes]:
         ln = ctypes.c_uint64()
@@ -174,15 +201,12 @@ class ShmChannel:
 
     def read_bytes(self, timeout: Optional[float] = None) -> bytes:
         deadline = None if timeout is None else time.monotonic() + timeout
-        delay = _POLL_MIN
         while True:
             data = self.try_read_bytes()
             if data is not None:
                 return data
-            if deadline is not None and time.monotonic() >= deadline:
+            if not self._wait(self._lib.rt_chan_wait_readable, deadline):
                 raise TimeoutError("channel empty (producer stalled?)")
-            time.sleep(delay)
-            delay = min(delay * 2, _POLL_MAX)
 
     # -- object API -----------------------------------------------------
 
@@ -205,3 +229,94 @@ class ShmChannel:
 
     def unpin(self) -> None:
         self._store.release(self.oid)
+
+
+class RemoteChannel:
+    """Writer half of a compiled-DAG edge whose ring lives on ANOTHER
+    node: payload bytes ship over the worker RPC plane into the reader
+    process, which lands them in its local shm ring (rpc_chan_write on
+    the reader's core worker). Same write/write_bytes surface as
+    ShmChannel — the executor loop can't tell the difference. Reference:
+    python/ray/experimental/channel/torch_tensor_accelerator_channel.py
+    (cross-node channel endpoints), redesigned for the RPC plane.
+
+    Backpressure carries through: the reader-side write blocks on the
+    ring's futex doorbell up to `timeout`, and a full ring surfaces here
+    as the same TimeoutError a local writer would see."""
+
+    def __init__(self, dag_id: str, edge: str, address: str,
+                 slot_size: int = 1 << 20):
+        from ray_tpu._private.core_worker import get_core_worker
+
+        self._cw = get_core_worker()
+        self._dag_id = dag_id
+        self._edge = edge
+        self._address = address
+        self.slot_size = slot_size
+        # per-edge slot counter: makes chan_write idempotent under RPC
+        # retries (a duplicate slot would shift every later execution)
+        self._seq = 0
+
+    async def _write_async(self, payload: bytes, timeout: Optional[float]):
+        client = await self._cw._worker_client(self._address)
+        rpc_timeout = 30.0 if timeout is None else timeout + 30.0
+        return await client.call("chan_write", {
+            "dag_id": self._dag_id,
+            "edge": self._edge,
+            "payload": payload,
+            "seq": self._seq,
+            # the reader registers its ring at executor-loop start, which
+            # can queue behind earlier work on that actor — wait at least
+            # as long as a same-node writer's 30s blocking open would
+            "open_timeout": 60.0,
+            # cap the remote blocking write so the RPC reply (and our
+            # rpc_timeout above) always outlives it
+            "timeout": 25.0 if timeout is None else timeout,
+        }, timeout=rpc_timeout)
+
+    def write_bytes(self, payload, timeout: Optional[float] = None) -> None:
+        if len(payload) > self.slot_size:
+            raise ValueError(
+                f"payload of {len(payload)} bytes exceeds channel slot "
+                f"size {self.slot_size}")
+        payload = bytes(payload)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        conn_retries = 3
+        while True:
+            remaining = (None if deadline is None
+                         else max(0.1, deadline - time.monotonic()))
+            try:
+                res = self._cw.run_sync(
+                    self._write_async(payload, remaining),
+                    timeout=(remaining or 30.0) + 60.0)
+            except Exception as exc:  # noqa: BLE001 — transport failure
+                # the write may or may not have landed; the seq watermark
+                # makes a retry safe (duplicate slots are dropped)
+                conn_retries -= 1
+                if conn_retries < 0:
+                    raise RuntimeError(
+                        f"remote channel {self._dag_id}:{self._edge} @ "
+                        f"{self._address}: transport failed ({exc})") from exc
+                time.sleep(0.2)
+                continue
+            err = res.get("error")
+            if err is None:
+                self._seq += 1  # slot landed (or deduped): next slot
+                return
+            if err == "full":
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise TimeoutError("channel full (consumer stalled?)")
+                continue  # timeout=None: keep blocking like a local writer
+            if err.startswith("value:"):
+                raise ValueError(err[len("value:"):])
+            raise RuntimeError(
+                f"remote channel {self._dag_id}:{self._edge} @ "
+                f"{self._address}: {err}")
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        from ray_tpu._private import serialization as ser
+
+        self.write_bytes(ser.serialize(value).to_bytes(), timeout)
+
+    def unpin(self) -> None:
+        pass  # the ring is pinned by its reader

@@ -16,15 +16,30 @@
 // commits with a length; the reader acquires the slot pointer and releases it
 // after deserializing. memory_order_release on publish / acquire on consume
 // pairs make the payload bytes visible before the sequence number.
+//
+// Blocking waits ride a FUTEX DOORBELL in the shared header instead of
+// sleep-polling (reference: its channels block on OS primitives —
+// shared_memory_channel.py reads park in plasma): commit/close ring
+// `write_ding`, release rings `read_ding`, and a blocked peer FUTEX_WAITs on
+// the ding word. Wakes are issued only when the waiter count is nonzero, so
+// the uncontended hot path stays syscall-free. An idle compiled-DAG executor
+// parked in rt_chan_wait_readable costs zero CPU.
 
 #include <atomic>
+#include <cerrno>
+#include <climits>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <new>
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
 
 namespace {
 
-constexpr uint64_t kChanMagic = 0x52544348414E0001ULL;  // "RTCHAN" v1
+constexpr uint64_t kChanMagic = 0x52544348414E0002ULL;  // "RTCHAN" v2 (futex)
 
 struct ChannelHeader {
   uint64_t magic;
@@ -33,7 +48,34 @@ struct ChannelHeader {
   std::atomic<uint64_t> write_seq;  // slots produced
   std::atomic<uint64_t> read_seq;   // slots consumed
   std::atomic<uint64_t> closed;     // writer hung up (reader sees EOF)
+  // doorbells (32-bit: futex words must be 4 bytes)
+  std::atomic<uint32_t> write_ding;     // bumped on commit/close
+  std::atomic<uint32_t> read_ding;      // bumped on release
+  std::atomic<uint32_t> read_waiters;   // readers parked on write_ding
+  std::atomic<uint32_t> write_waiters;  // writers parked on read_ding
 };
+
+int futex_wait(std::atomic<uint32_t>* word, uint32_t expected,
+               int64_t timeout_us) {
+  struct timespec ts;
+  struct timespec* tsp = nullptr;
+  if (timeout_us >= 0) {
+    ts.tv_sec = timeout_us / 1000000;
+    ts.tv_nsec = (timeout_us % 1000000) * 1000;
+    tsp = &ts;
+  }
+  long rc = syscall(SYS_futex, reinterpret_cast<uint32_t*>(word), FUTEX_WAIT,
+                    expected, tsp, nullptr, 0);
+  if (rc == -1 && errno == ETIMEDOUT) return -1;
+  // 0 (woken), EAGAIN (value already changed), EINTR (signal): let the
+  // caller re-check the ring — all are "maybe ready"
+  return 0;
+}
+
+void futex_wake_all(std::atomic<uint32_t>* word) {
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(word), FUTEX_WAKE, INT_MAX,
+          nullptr, nullptr, 0);
+}
 
 struct Slot {
   uint64_t len;
@@ -68,6 +110,10 @@ int rt_chan_init(void* base, uint64_t region_size, uint64_t nslots,
   h->write_seq.store(0, std::memory_order_relaxed);
   h->read_seq.store(0, std::memory_order_relaxed);
   h->closed.store(0, std::memory_order_relaxed);
+  h->write_ding.store(0, std::memory_order_relaxed);
+  h->read_ding.store(0, std::memory_order_relaxed);
+  h->read_waiters.store(0, std::memory_order_relaxed);
+  h->write_waiters.store(0, std::memory_order_relaxed);
   return 0;
 }
 
@@ -94,6 +140,9 @@ int rt_chan_commit(void* base, uint64_t len) {
   uint64_t w = h->write_seq.load(std::memory_order_relaxed);
   slot_at(h, w)->len = len;
   h->write_seq.store(w + 1, std::memory_order_release);
+  h->write_ding.fetch_add(1, std::memory_order_release);
+  if (h->read_waiters.load(std::memory_order_acquire) != 0)
+    futex_wake_all(&h->write_ding);
   return 0;
 }
 
@@ -116,12 +165,51 @@ int rt_chan_release(void* base) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
   uint64_t r = h->read_seq.load(std::memory_order_relaxed);
   h->read_seq.store(r + 1, std::memory_order_release);
+  h->read_ding.fetch_add(1, std::memory_order_release);
+  if (h->write_waiters.load(std::memory_order_acquire) != 0)
+    futex_wake_all(&h->read_ding);
   return 0;
 }
 
 void rt_chan_close(void* base) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
   h->closed.store(1, std::memory_order_release);
+  // close must reach parked readers even with no payload in flight
+  h->write_ding.fetch_add(1, std::memory_order_release);
+  futex_wake_all(&h->write_ding);
+}
+
+// Park until the ring is (probably) readable: data available or closed.
+// Returns 0 = re-check now (data/closed/spurious wake), -1 = timed out.
+// timeout_us < 0 waits indefinitely. Callers always loop over
+// try-acquire, so a spurious 0 is harmless.
+int rt_chan_wait_readable(void* base, int64_t timeout_us) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  uint32_t ding = h->write_ding.load(std::memory_order_acquire);
+  uint64_t r = h->read_seq.load(std::memory_order_relaxed);
+  if (h->write_seq.load(std::memory_order_acquire) != r ||
+      h->closed.load(std::memory_order_acquire))
+    return 0;
+  h->read_waiters.fetch_add(1, std::memory_order_acq_rel);
+  // A commit between the ding load and the kernel's futex compare bumps
+  // write_ding, so FUTEX_WAIT returns EAGAIN instead of sleeping — no
+  // lost-wakeup window.
+  int rc = futex_wait(&h->write_ding, ding, timeout_us);
+  h->read_waiters.fetch_sub(1, std::memory_order_acq_rel);
+  return rc;
+}
+
+// Park until the ring has (probably) a free slot. Same contract as
+// rt_chan_wait_readable.
+int rt_chan_wait_writable(void* base, int64_t timeout_us) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  uint32_t ding = h->read_ding.load(std::memory_order_acquire);
+  uint64_t w = h->write_seq.load(std::memory_order_relaxed);
+  if (w - h->read_seq.load(std::memory_order_acquire) < h->nslots) return 0;
+  h->write_waiters.fetch_add(1, std::memory_order_acq_rel);
+  int rc = futex_wait(&h->read_ding, ding, timeout_us);
+  h->write_waiters.fetch_sub(1, std::memory_order_acq_rel);
+  return rc;
 }
 
 uint64_t rt_chan_readable(void* base) {

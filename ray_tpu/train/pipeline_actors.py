@@ -263,16 +263,21 @@ class CompiledActorPipeline:
 
     def __init__(self, cfg, n_stages: int, n_microbatches: int,
                  learning_rate: float = 3e-4, seed: int = 0,
-                 slot_size: int = 8 << 20):
+                 slot_size: int = 8 << 20,
+                 stage_options: Optional[List[dict]] = None):
         from ray_tpu.dag import InputNode, MultiOutputNode
 
         self.S = S = n_stages
         self.M = M = n_microbatches
-        self.stages = [
-            PipelineStage.remote(cfg, s, n_stages, seed=seed,
-                                 learning_rate=learning_rate)
-            for s in range(n_stages)
-        ]
+        self.stages = []
+        for s in range(n_stages):
+            klass = PipelineStage
+            if stage_options and stage_options[s]:
+                # e.g. label_selector pinning stages to nodes: cross-node
+                # activation/grad edges then ride RemoteChannel
+                klass = PipelineStage.options(**stage_options[s])
+            self.stages.append(klass.remote(
+                cfg, s, n_stages, seed=seed, learning_rate=learning_rate))
         fwd: Dict[tuple, Any] = {}
         bwd: Dict[tuple, Any] = {}
         with InputNode() as inp:
