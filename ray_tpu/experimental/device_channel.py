@@ -1,0 +1,79 @@
+"""Device-native channel: a compiled-graph edge that carries jax.Arrays
+chip-to-chip through XLA collective-permute instead of host serialization.
+
+Reference surface: python/ray/experimental/channel/
+torch_tensor_accelerator_channel.py (NCCL p2p channels between accelerator
+workers inside compiled DAGs). TPU redesign: there is no out-of-band
+device-to-device DMA outside a mesh program — inter-chip movement belongs
+to XLA collectives — so a device edge is a RENDEZVOUS: both endpoint
+processes enter the same jitted collective-permute step and the payload
+rides ICI when the endpoints share a slice (DCN across slices), never
+touching host memory. The host shm/RPC channel plane remains the fallback
+for edges that leave the gang (ray_tpu/experimental/channel.py).
+
+Contract: write(src side) and read(dst side) are the two halves of ONE
+collective call, so the endpoints must invoke them in matching order —
+exactly what a compiled DAG's static per-actor schedules guarantee
+(reference: compiled_dag_node.py orders NCCL sends/recvs the same way).
+The reader declares shape/dtype up front (channels are typed, like the
+reference's TorchTensorType annotation), so no metadata round-trip is
+needed at transfer time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+
+class DeviceChannel:
+    """One directed device edge between two members of an XLA collective
+    gang (ray_tpu.util.collective, backend="xla").
+
+    Every rank of the group participates in the underlying permute (SPMD
+    collectives are group-wide); use dedicated 2-member groups per edge —
+    the natural shape for pipeline-stage handoffs — so a transfer only
+    synchronizes its endpoints.
+    """
+
+    def __init__(self, group_name: str, src_rank: int, dst_rank: int,
+                 shape: Tuple[int, ...], dtype: Any):
+        if src_rank == dst_rank:
+            raise ValueError("device channel endpoints must differ")
+        self.group_name = group_name
+        self.src_rank = src_rank
+        self.dst_rank = dst_rank
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+    def _permute(self, contribution):
+        from ray_tpu.util import collective as col
+
+        return col.permute(contribution,
+                           [(self.src_rank, self.dst_rank)],
+                           group_name=self.group_name)
+
+    def write(self, arr) -> None:
+        """Producer half: contribute the payload. Blocks until the
+        consumer enters its matching read (collective semantics — this IS
+        the channel's backpressure)."""
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(arr, self.dtype)
+        if tuple(arr.shape) != self.shape:
+            raise ValueError(
+                f"device channel is typed {self.shape}/{self.dtype}; "
+                f"got {tuple(arr.shape)}/{arr.dtype}")
+        self._permute(arr)
+
+    def read(self):
+        """Consumer half: contribute zeros, receive the producer's
+        payload as a device array."""
+        import jax.numpy as jnp
+
+        out = self._permute(jnp.zeros(self.shape, self.dtype))
+        return out
+
+
+__all__ = ["DeviceChannel"]

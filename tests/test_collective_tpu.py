@@ -255,3 +255,51 @@ def test_reducescatter_output_never_replicated_and_permute(ray_init):
         assert replicated is False, "reduce-scatter output was replicated"
         # permute [(0,1),(1,0)]: each rank receives the OTHER rank's value
         assert perm_out == [float(1 - rank)] * 2, (rank, perm_out)
+
+
+def test_device_channel_stage_handoff(ray_init):
+    """DeviceChannel: a compiled-graph-style stage handoff riding the
+    collective device plane (reference: torch_tensor_accelerator_channel) —
+    producer writes, consumer reads, payload arrives as a device array
+    with no host object-plane hop."""
+
+    @ray_tpu.remote(num_cpus=1)
+    class Stage:
+        def __init__(self, rank):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            self.rank = rank
+
+        def run(self):
+            import jax
+            import numpy as np
+
+            from ray_tpu.experimental.device_channel import DeviceChannel
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(2, self.rank, backend="xla",
+                                      group_name="edge01")
+            ch = DeviceChannel("edge01", src_rank=0, dst_rank=1,
+                               shape=(4, 8), dtype=np.float32)
+            if self.rank == 0:
+                # producer: 3 sequential transfers (channel order = call
+                # order, the compiled-schedule contract)
+                for i in range(3):
+                    ch.write(np.full((4, 8), float(i + 1), np.float32))
+                col.destroy_collective_group("edge01")
+                return None
+            got = []
+            for _ in range(3):
+                out = ch.read()
+                assert isinstance(out, jax.Array)
+                got.append(float(np.asarray(out)[0, 0]))
+            col.destroy_collective_group("edge01")
+            return got
+
+    stages = [Stage.remote(r) for r in range(2)]
+    results = ray_tpu.get([s.run.remote() for s in stages], timeout=300)
+    assert results[1] == [1.0, 2.0, 3.0]
+    for s in stages:
+        ray_tpu.kill(s)
