@@ -112,7 +112,16 @@ class DashboardActor:
     async def _index(self, request):
         from aiohttp import web
 
-        return web.Response(text=_PAGE, content_type="text/html")
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "static", "index.html")
+        try:
+            with open(path, encoding="utf-8") as f:
+                page = f.read()
+        except OSError:  # packaged without assets: minimal inline fallback
+            page = _PAGE
+        return web.Response(text=page, content_type="text/html")
 
     async def _resolve_node(self, node_hex: str) -> dict:
         """Find a LIVE node by full id or unique prefix (>= 8 chars)."""
@@ -329,12 +338,67 @@ class DashboardActor:
         return web.json_response(await self._control("get_cluster_load"))
 
     async def _metrics(self, request):
+        """User metrics + built-in system series (rt_node_*, rt_tasks_*,
+        rt_actors_*) in one Prometheus exposition — the scrape target the
+        bundled Grafana dashboard reads (reference: dashboard/modules/
+        metrics/ ships Prometheus+Grafana configs the same way)."""
         from aiohttp import web
 
         from ray_tpu.util.metrics import render_prometheus
 
         reply = await self._control("get_metrics")
-        return web.Response(text=render_prometheus(reply["workers"]),
+        lines = [render_prometheus(reply["workers"]).rstrip()]
+
+        # system series are best-effort: a transient control-store error on
+        # any of them must not 500 the scrape and drop the user metrics
+        async def _system_series():
+            out = []
+            try:
+                stats = (await self._control("get_node_stats"))["stats"]
+            except Exception:  # noqa: BLE001
+                stats = {}
+            gauges = {"cpu_percent": "rt_node_cpu_percent",
+                      "mem_percent": "rt_node_mem_percent",
+                      "store_bytes": "rt_node_store_bytes"}
+            for skey, mname in gauges.items():
+                rows = [(n, s[skey]) for n, s in stats.items() if skey in s]
+                if not rows:
+                    continue
+                out.append(f"# TYPE {mname} gauge")
+                for node, val in sorted(rows):
+                    out.append(f'{mname}{{node="{node[:12]}"}} {val}')
+
+            nodes = (await self._control("get_all_nodes"))["nodes"]
+            alive = sum(1 for n in nodes if n["state"] == "ALIVE")
+            out.append("# TYPE rt_nodes_alive gauge")
+            out.append(f"rt_nodes_alive {alive}")
+
+            actors = (await self._control("list_actors"))["actors"]
+            acounts: Dict[str, int] = {}
+            for a in actors:
+                acounts[str(a["state"])] = acounts.get(str(a["state"]), 0) + 1
+            out.append("# TYPE rt_actors_total gauge")
+            for st, n in sorted(acounts.items()):
+                out.append(f'rt_actors_total{{state="{st}"}} {n}')
+
+            evs = await self._control("list_task_events", {"limit": 0})
+            latest: Dict[bytes, str] = {}
+            for ev in evs["events"]:
+                latest[ev["task_id"]] = ev["event"]
+            tcounts: Dict[str, int] = {}
+            for st in latest.values():
+                tcounts[st] = tcounts.get(st, 0) + 1
+            out.append("# TYPE rt_tasks_total gauge")
+            for st, n in sorted(tcounts.items()):
+                out.append(f'rt_tasks_total{{state="{st}"}} {n}')
+            return out
+
+        try:
+            lines.extend(await _system_series())
+        except Exception:  # noqa: BLE001 — user metrics still render
+            pass
+
+        return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
     async def stop(self) -> bool:

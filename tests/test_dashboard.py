@@ -73,3 +73,50 @@ def test_dashboard_endpoints(ray_init):
     load = httpx.get(f"{url}/api/cluster_load", timeout=30).json()
     assert "pending_total" in load and len(load["nodes"]) == 1
     ray_tpu.kill(a)
+
+
+def test_web_frontend_and_metrics_export(ray_init):
+    """The static SPA (reference: dashboard/client React app) + the
+    Grafana-ready system metrics: DOM structure, every API route the page
+    fetches, and the rt_* Prometheus series."""
+    import json
+    import os
+    import re
+
+    import httpx
+
+    url = start_dashboard(port=18265)
+
+    page = httpx.get(f"{url}/", timeout=30).text
+    # nav + renderers for every view the SPA declares
+    for view in ("overview", "nodes", "actors", "jobs", "tasks",
+                 "placement_groups", "events"):
+        assert re.search(rf'"{view}"|async {view}\(', page), view
+    assert 'id="nav"' in page and 'id="main"' in page
+
+    # every /api path the page references answers with parseable JSON
+    for path in set(re.findall(r'get\("([a-z_]+)"\)', page)):
+        r = httpx.get(f"{url}/api/{path}", timeout=30)
+        assert r.status_code == 200, (path, r.status_code)
+        r.json()
+
+    metrics = httpx.get(f"{url}/metrics", timeout=30).text
+    assert "rt_nodes_alive 1" in metrics
+    assert "rt_tasks_total{" in metrics
+    assert "rt_actors_total{" in metrics
+
+    # the bundled Grafana dashboard parses and its panels query only
+    # series the endpoint exports
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "ray_tpu", "dashboard", "metrics_export")
+    with open(os.path.join(root, "grafana_dashboard.json")) as f:
+        dash = json.load(f)
+    exported = set(re.findall(r"^(rt_\w+)", metrics, re.M))
+    for panel in dash["panels"]:
+        for target in panel.get("targets", []):
+            series = re.findall(r"(rt_\w+)", target["expr"])
+            assert series, target
+            for s in series:
+                assert s in exported or s.startswith("rt_node_"), s
+    with open(os.path.join(root, "prometheus.yml")) as f:
+        assert "metrics_path: /metrics" in f.read()
