@@ -200,9 +200,92 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """Config-driven cluster launch (reference: `ray up`,
+    autoscaler/_private/commands.py). Blocks hosting the autoscaler loop —
+    the reconciler AND the provisioned-resource handles live in this
+    process, so exiting it must (and does) tear the cluster down: Ctrl-C
+    or a `down` from another shell (which SIGTERMs this pid) both run the
+    full shutdown, terminating autoscaler-launched workers/slices too."""
+    from ray_tpu.autoscaler.launcher import (cluster_up, load_cluster_config,
+                                             save_launch_state)
+
+    cfg = load_cluster_config(args.config)
+    cluster = cluster_up(cfg)
+    state = _load_state()
+    nodes = state.get("nodes", [])
+    entry = {"pids": [p.pid for p in cluster.head_procs],
+             "session_dir": cluster.session_dir,
+             "address": cluster.control_address, "head": True,
+             "up_pid": os.getpid()}
+    nodes.append(entry)
+    _save_state({"address": cluster.control_address, "nodes": nodes})
+    if args.state_file:
+        save_launch_state(cluster, args.state_file)
+    print(f"cluster {cfg['cluster_name']} up")
+    print(f"  address: {cluster.control_address}")
+    print(f"  connect: ray_tpu.init(address="
+          f"{cluster.control_address!r})")
+    print("  stop:    Ctrl-C here, or `down` from another shell")
+
+    def _teardown():
+        print("shutting down cluster")
+        cluster.shutdown()  # terminates provisioned workers/slices too
+        state = _load_state()
+        remaining = [n for n in state.get("nodes", [])
+                     if n.get("up_pid") != os.getpid()]
+        if remaining:
+            _save_state({"address": remaining[-1]["address"],
+                         "nodes": remaining})
+        else:
+            try:
+                os.unlink(STATE_FILE)
+            except OSError:
+                pass
+
+    def _on_sigterm(_sig, _frame):
+        _teardown()
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    try:
+        while True:
+            time.sleep(5)
+    except KeyboardInterrupt:
+        _teardown()
+        return 0
+
+
+def cmd_down(args) -> int:
+    """Tear down clusters: `up` processes get SIGTERM (their handler runs
+    the full shutdown incl. provisioned cloud resources), `start` nodes'
+    process groups are killed directly (reference: `ray down`)."""
+    state = _load_state()
+    for node in state.get("nodes", []):
+        pid = node.get("up_pid")
+        if pid:
+            try:
+                os.kill(pid, signal.SIGTERM)
+                print(f"signalled `up` process {pid} to tear down")
+            except (ProcessLookupError, PermissionError):
+                pass
+    time.sleep(2)  # give the up processes their clean shutdown window
+    return cmd_stop(args)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ray_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser(
+        "up", help="launch a cluster from a YAML config (head + autoscaler; "
+                   "blocks — Ctrl-C or `down` tears it down)")
+    sp.add_argument("config")
+    sp.add_argument("--state-file", default="")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a cluster started by `up`")
+    sp.set_defaults(fn=cmd_down)
 
     sp = sub.add_parser("start", help="start a head or worker node")
     sp.add_argument("--head", action="store_true")

@@ -1,0 +1,177 @@
+"""GCP TPU-VM provider + YAML cluster launcher, driven offline through
+FakeGcpTransport (VERDICT r4 next #5; reference:
+python/ray/autoscaler/_private/gcp/node_provider.py + commands.py `ray up`,
+tested the way fake_multi_node tests the cloud path)."""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import node as node_mod
+from ray_tpu.autoscaler import Autoscaler, AutoscalingConfig, SliceSpec
+from ray_tpu.autoscaler.gcp import FakeGcpTransport, TpuVmNodeProvider
+
+
+def test_provider_rest_surface():
+    """Provider unit: node + slice lifecycles issue the right TPU/GCE REST
+    calls and poll operations to done."""
+    t = FakeGcpTransport(op_latency=2)
+    p = TpuVmNodeProvider(
+        project="proj", zone="us-central2-b",
+        control_address="127.0.0.1:1", transport=t, cluster_name="t")
+
+    h = p.create_node({"CPU": 4.0})
+    assert t.instances[h["name"]]["labels"]["rt-kind"] == "worker"
+    meta = {i["key"]: i["value"]
+            for i in t.instances[h["name"]]["metadata"]["items"]}
+    assert meta["rt-control-address"] == "127.0.0.1:1"
+    assert json.loads(meta["rt-resources"]) == {"CPU": 4.0}
+    p.terminate_node(h)
+    assert not t.instances
+
+    s = p.create_slice("v5e-16", SliceSpec(
+        hosts=4, resources_per_host={"CPU": 8.0, "TPU": 4.0}))
+    node = t.tpu_nodes[s["slice_name"]]
+    assert node["acceleratorType"] == "v5litepod-16"
+    assert node["metadata"]["rt-hosts"] == "4"
+    assert len(s["nodes"]) == 4
+    p.terminate_slice(s)
+    assert not t.tpu_nodes
+    # a host count that contradicts the accelerator topology fails fast
+    with pytest.raises(ValueError, match="4 hosts"):
+        p.create_slice("v5e-16", SliceSpec(hosts=2))
+    # every create/delete polled its operation at least twice (latency=2)
+    ops = [u for m, u in t.calls if "/operations/" in u]
+    assert len(ops) >= 8
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=1)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _daemon_boot(control_address, session_dir):
+    """The FakeGcpTransport boot hook: does what a TPU-VM startup script
+    does — start one node daemon per host with the slice labels — and
+    returns a cleanup callable."""
+    from ray_tpu._private import protocol as pb
+
+    def boot(name, kind, labels, metadata):
+        procs = []
+        if kind == "gce":
+            # worker VM: metadata carried as GCE metadata items upstream;
+            # the fake hands the label dict + no items, so re-derive from
+            # the instance the transport recorded is unnecessary — boot
+            # with a plain CPU shape
+            proc, _ = node_mod.start_node_daemon(
+                control_address, session_dir, resources={"CPU": 2.0})
+            procs.append(proc)
+        else:
+            hosts = int(metadata.get("rt-hosts", 1))
+            resources = json.loads(metadata.get("rt-resources", "{}"))
+            slice_name = metadata.get("rt-slice-name", name)
+            pod_type = labels.get("rt-pod-type", "")
+            for hidx in range(hosts):
+                r = dict(resources)
+                if hidx == 0:
+                    r[f"TPU-{pod_type}-head"] = 1.0
+                proc, _ = node_mod.start_node_daemon(
+                    control_address, session_dir, resources=r,
+                    labels={
+                        "tpu-slice-name": slice_name,
+                        "tpu-pod-type": pod_type,
+                        pb.TPU_COORD_LABEL: f"0,{hidx}",
+                    })
+                procs.append(proc)
+
+        def cleanup():
+            for pr in procs:
+                node_mod.kill_process(pr)
+
+        return cleanup
+
+    return boot
+
+
+def test_autoscaler_provisions_tpu_slice_through_fake_cloud(ray_init):
+    """E2E: a pending slice placement group drives the autoscaler through
+    TpuVmNodeProvider -> (fake) TPU API -> booted hosts join -> the PG
+    schedules. Same code path a real cluster takes, minus HTTP."""
+    from ray_tpu.tpu.slice import slice_placement_group
+
+    t = FakeGcpTransport(
+        boot=_daemon_boot(ray_init["address"], ray_init["session_dir"]))
+    provider = TpuVmNodeProvider(
+        project="proj", zone="us-central2-b",
+        control_address=ray_init["address"], transport=t,
+        cluster_name="e2e")
+    scaler = Autoscaler(provider, AutoscalingConfig(
+        min_workers=0, max_workers=0, idle_timeout_s=3600,
+        poll_period_s=0.3,
+        slice_types={"v5e-8": SliceSpec(
+            hosts=2, resources_per_host={"CPU": 1.0, "TPU": 4.0})},
+        max_slices=1,
+    )).start()
+    try:
+        spg = slice_placement_group(pod_type="v5e-8", num_slices=1,
+                                    chips_per_host=4, hosts_per_slice=2)
+        assert spg.ready(timeout=120), "slice PG never became ready"
+        assert len(t.tpu_nodes) == 1
+        (name, node), = t.tpu_nodes.items()
+        assert node["labels"]["rt-pod-type"] == "v5e-8"
+        from ray_tpu.util.state import list_nodes
+
+        labeled = [n for n in list_nodes()
+                   if n["labels"].get("tpu-pod-type") == "v5e-8"]
+        assert len(labeled) == 2
+        spg.remove()
+    finally:
+        scaler.stop()
+        assert not t.tpu_nodes, "teardown must delete the TPU node"
+
+
+def test_launcher_yaml_up_down(tmp_path):
+    """`rt up` path: YAML -> head + autoscaler -> tasks run -> down."""
+    import yaml
+
+    from ray_tpu.autoscaler.launcher import cluster_up, load_cluster_config
+
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text(yaml.safe_dump({
+        "cluster_name": "yamltest",
+        "provider": {"type": "local"},
+        "head": {"resources": {"CPU": 2}},
+        "workers": {"resources": {"CPU": 2}, "min_workers": 0,
+                    "max_workers": 1, "idle_timeout_s": 3600},
+    }))
+    cfg = load_cluster_config(str(cfg_path))
+    assert cfg["cluster_name"] == "yamltest"
+    ray_tpu.shutdown()  # drop the module fixture's connection first
+    cluster = cluster_up(cfg)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(41), timeout=60) == 42
+    finally:
+        cluster.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_launcher_rejects_bad_config(tmp_path):
+    from ray_tpu.autoscaler.launcher import load_cluster_config
+
+    p = tmp_path / "bad.yaml"
+    p.write_text("provider: {type: local}\n")
+    with pytest.raises(ValueError, match="cluster_name"):
+        load_cluster_config(str(p))
+    p2 = tmp_path / "bad2.yaml"
+    p2.write_text("cluster_name: x\nprovider: {type: gcp}\n")
+    from ray_tpu.autoscaler.launcher import cluster_up
+
+    with pytest.raises(ValueError, match="project"):
+        cluster_up(load_cluster_config(str(p2)), connect=False)
