@@ -74,6 +74,7 @@ def bench_actor_calls_sync(ray_tpu, n):
             ray_tpu.get(a.m.remote(), timeout=60)
 
     dt = timed(run)
+    ray_tpu.kill(a)  # release the CPU for later benches
     return {"bench": "actor_calls_sync", "value": round(n / dt, 1), "unit": "calls/s"}
 
 
@@ -92,6 +93,7 @@ def bench_actor_calls_async(ray_tpu, n):
         ray_tpu.get([a.m.remote() for _ in range(n)], timeout=120)
 
     dt = timed(run)
+    ray_tpu.kill(a)  # release the CPU for later benches
     return {"bench": "actor_calls_async", "value": round(n / dt, 1), "unit": "calls/s"}
 
 
@@ -101,20 +103,26 @@ def bench_queued_task_depth(ray_tpu, n):
     probe from release/benchmarks scaled to this VM — ray_perf has no
     direct counterpart; reports sustained drain rate at depth)."""
 
+    import resource
+
     @ray_tpu.remote
     def tag(i):
         return i
 
     ray_tpu.get(tag.remote(0), timeout=60)
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     t0 = time.perf_counter()
     refs = [tag.remote(i) for i in range(n)]
     t_submit = time.perf_counter() - t0
-    out = ray_tpu.get(refs, timeout=1200)
+    out = ray_tpu.get(refs, timeout=3600)
     dt = time.perf_counter() - t0
+    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     assert out == list(range(n)), "queued-task drain corrupted results"
     return {"bench": f"queued_tasks_{n}", "value": round(n / dt, 1),
             "unit": "tasks/s",
-            "submit_rate": round(n / max(t_submit, 1e-9), 1)}
+            "submit_rate": round(n / max(t_submit, 1e-9), 1),
+            "driver_peak_rss_mb": round(rss1, 1),
+            "rss_delta_mb": round(rss1 - rss0, 1)}
 
 
 def bench_many_args(ray_tpu, n_args):
@@ -221,6 +229,8 @@ def bench_collective_allreduce(ray_tpu, mb: int, reps: int = 4):
     world = 2
     members = [Member.remote(r, world) for r in range(world)]
     rates = ray_tpu.get([m.run.remote(mb, reps) for m in members], timeout=300)
+    for m in members:
+        ray_tpu.kill(m)
     return {"bench": "collective_allreduce_2proc", "value": round(min(rates), 1),
             "unit": "MB/s"}
 
@@ -243,9 +253,14 @@ def main():
         results.append(bench_put_small(ray_tpu, 200 * scale))
         results.extend(bench_put_get_gigabytes(ray_tpu, 40 * scale))
         results.append(bench_task_arg_passthrough(ray_tpu, 16))
-        results.append(bench_collective_allreduce(ray_tpu, 8 * scale))
-        results.append(bench_queued_task_depth(ray_tpu, 4000 * scale))
-        results.append(bench_many_args(ray_tpu, 1000 * scale))
+        results.append(bench_collective_allreduce(ray_tpu, 8 * scale,
+                                                  reps=6))
+        # full mode probes the release/benchmarks envelope: 10k-arg task,
+        # then 100k queued with bounded driver memory (reference:
+        # release/benchmarks/README.md:27-33). args before depth: the 100k
+        # run leaves warm state that skews the arg probe
+        results.append(bench_many_args(ray_tpu, 2000 * scale))
+        results.append(bench_queued_task_depth(ray_tpu, 20000 * scale))
     finally:
         for r in results:
             print(json.dumps(r))

@@ -1046,6 +1046,89 @@ class CoreWorker:
             return {"error": "object not found at owner"}
         return {"data": None, "location": loc}
 
+    async def rpc_get_objects_batch(self, conn_id: int, payload: dict) -> dict:
+        """Batched get_object: one RPC for a many-arg task's refs instead
+        of one round trip per ref (reference: the 10k-args-per-task
+        envelope, release/benchmarks/README.md:27 — per-message overhead
+        dominates tiny-arg resolution without this)."""
+        oids = payload["object_ids"]
+        await asyncio.gather(*[self.memory_store.wait_future(o)
+                               for o in oids])
+        out = []
+        for oid in oids:
+            if oid in self.memory_store.objects:
+                data, meta = self.memory_store.objects[oid]
+                out.append({"data": data, "meta": meta})
+                continue
+            loc = self.memory_store.locations.get(oid)
+            out.append({"error": "object not found at owner"}
+                       if loc is None else {"data": None, "location": loc})
+        return {"objects": out}
+
+    async def resolve_args_batch(self, wire_args: list) -> list:
+        """Executor-side arg resolution with owner-fetch batching: refs
+        owned elsewhere and absent from the local store group into
+        get_objects_batch calls per owner; inline/local/owned args keep the
+        resolve_arg fast paths."""
+        results: list = [None] * len(wire_args)
+        local_idx: list = []
+        by_owner: Dict[str, list] = {}
+        for i, a in enumerate(wire_args):
+            if "inline" in a:
+                results[i] = ser.deserialize(a["inline"], copy_buffers=True)
+                continue
+            ref = ObjectRef(ObjectID(a["ref"]), a["owner"],
+                            a["owner_worker_id"], _register=False)
+            if self.owns(ref) or (
+                    self.store is not None
+                    and self.store.contains(ref.object_id())):
+                local_idx.append(i)
+            else:
+                by_owner.setdefault(a["owner"], []).append((i, a))
+
+        async def fetch_group(owner: str, items: list):
+            chunk = 2048
+            for c0 in range(0, len(items), chunk):
+                part = items[c0:c0 + chunk]
+                ref0 = ObjectRef(ObjectID(part[0][1]["ref"]), owner,
+                                 part[0][1]["owner_worker_id"],
+                                 _register=False)
+                try:
+                    client = await self._owner_client(owner)
+                    reply = await client.call("get_objects_batch", {
+                        "object_ids": [a["ref"] for _i, a in part],
+                    }, timeout=None)
+                except RpcError as e:
+                    raise ObjectLostError(
+                        ref0.hex(),
+                        f"owner at {owner} unreachable: {e}") from e
+                store_resident = []
+                for (i, a), rep in zip(part, reply["objects"]):
+                    if rep.get("error"):
+                        raise ObjectLostError(
+                            ObjectID(a["ref"]).hex(), rep["error"])
+                    if rep.get("data") is not None:
+                        results[i] = self._materialize(
+                            rep["data"], rep["meta"], copy_buffers=True)
+                    else:
+                        # store-resident value: the single-ref path handles
+                        # location reads + lineage reconstruction
+                        store_resident.append((i, a))
+                if store_resident:
+                    vals = await asyncio.gather(
+                        *[self.resolve_arg(a) for _i, a in store_resident])
+                    for (i, _a), v in zip(store_resident, vals):
+                        results[i] = v
+
+        local_vals = await asyncio.gather(
+            *[self.resolve_arg(wire_args[i]) for i in local_idx])
+        for i, v in zip(local_idx, local_vals):
+            results[i] = v
+        await asyncio.gather(
+            *[fetch_group(owner, items)
+              for owner, items in by_owner.items()])
+        return results
+
     async def rpc_wait_object(self, conn_id: int, payload: dict) -> dict:
         await self.memory_store.wait_future(payload["object_id"])
         return {"ok": True}
@@ -1528,14 +1611,23 @@ class CoreWorker:
                                  stream_backpressure: int = -1,
                                  concurrency_group: str = "",
                                  concurrent: bool = False):
-        """Loop-thread-safe actor submission: the sequence number is taken
-        synchronously (ordering is decided here), arg serialization and
-        delivery continue in a spawned task."""
+        """NON-BLOCKING actor submission from ANY thread: args serialize
+        on the calling thread (errors raise at the .remote() call site,
+        before a sequence slot is taken), the sequence number is assigned
+        under the lock (ordering is decided here), and delivery continues
+        on the event loop. This is the `.remote()` hot path — a driver
+        thread must not round-trip through the loop per call (that
+        serializes "async" submission behind a thread hop and caps
+        pipelined throughput at the hop rate; same design as
+        submit_task_fast for plain tasks)."""
+        wire_args, pyrefs, pending = self.serialize_args_sync(args, kwargs)
         st = self._actor_state(actor_id)
         if concurrent:
             st.concurrent = True
+        with self._lock:
+            seq = self._next_seq(st)
         task_id = TaskID.for_actor_task(
-            self.job_id, ActorID(actor_id), self.current_task_id, self._next_seq(st)
+            self.job_id, ActorID(actor_id), self.current_task_id, seq
         )
         spec = TaskSpec(
             trace_ctx=_trace_inject(),
@@ -1543,12 +1635,12 @@ class CoreWorker:
             job_id=self.job_id,
             kind=pb.TASK_KIND_ACTOR_TASK,
             method_name=method_name,
-            args=[],
+            args=wire_args,
             num_returns=num_returns,
             owner_worker_id=self.worker_id.binary(),
             owner_address=self.address,
             actor_id=ActorID(actor_id),
-            seq_no=st.seq,
+            seq_no=seq,
             incarnation=st.incarnation,
             name=method_name,
             stream_backpressure=stream_backpressure,
@@ -1562,12 +1654,18 @@ class CoreWorker:
             self._streams[task_id.binary()] = StreamState(task_id.binary())
 
         async def finish():
-            wire_args = await self.serialize_args(args, kwargs)
-            pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
-            spec.args = wire_args
+            for oid, sobj in pending:
+                await self._complete_put(oid, sobj)
             await self._submit_actor_with_retries(st, spec, max_task_retries, pyrefs)
 
-        atask = spawn(self._guard_submit(spec, finish()))
+        guarded = self._guard_submit(spec, finish())
+        if self._loop_running_here():
+            atask = spawn(guarded)
+        else:
+            # foreign (driver) thread: hand off without waiting; the
+            # concurrent.Future supports the same cancel/done-callback
+            # surface _track_submission needs
+            atask = asyncio.run_coroutine_threadsafe(guarded, self.loop)
         self._track_submission(spec, atask)
         if spec.is_streaming:
             return ObjectRefGenerator(self, task_id.binary())
@@ -2629,56 +2727,13 @@ class CoreWorker:
                 raise ActorUnavailableError("timed out waiting for actor to start")
             await asyncio.sleep(0.1)
 
-    async def submit_actor_task(
-        self,
-        actor_id: bytes,
-        method_name: str,
-        args: tuple,
-        kwargs: dict,
-        num_returns: int = 1,
-        max_task_retries: int = 0,
-        stream_backpressure: int = -1,
-        concurrency_group: str = "",
-        concurrent: bool = False,
-    ):
-        st = self._actor_state(actor_id)
-        if concurrent:
-            st.concurrent = True
-        # serialize BEFORE taking the sequence number: a failed serialization
-        # must not consume a slot (ordered actors stall on sequence holes)
-        wire_args = await self.serialize_args(args, kwargs)
-        pyrefs = [a.pop("_pyref") for a in wire_args if "_pyref" in a]
-        task_id = TaskID.for_actor_task(
-            self.job_id, ActorID(actor_id), self.current_task_id, self._next_seq(st)
-        )
-        spec = TaskSpec(
-            trace_ctx=_trace_inject(),
-            task_id=task_id,
-            job_id=self.job_id,
-            kind=pb.TASK_KIND_ACTOR_TASK,
-            method_name=method_name,
-            args=wire_args,
-            num_returns=num_returns,
-            owner_worker_id=self.worker_id.binary(),
-            owner_address=self.address,
-            actor_id=ActorID(actor_id),
-            seq_no=st.seq,
-            incarnation=st.incarnation,
-            name=method_name,
-            stream_backpressure=stream_backpressure,
-            concurrency_group=concurrency_group,
-        )
-        refs = [
-            ObjectRef(oid, self.address, self.worker_id.binary())
-            for oid in spec.return_ids()
-        ]
-        if spec.is_streaming:
-            self._streams[task_id.binary()] = StreamState(task_id.binary())
-        atask = spawn(self._submit_actor_with_retries(st, spec, max_task_retries, pyrefs))
-        self._track_submission(spec, atask)
-        if spec.is_streaming:
-            return ObjectRefGenerator(self, task_id.binary())
-        return refs
+    async def submit_actor_task(self, actor_id: bytes, method_name: str,
+                                args: tuple, kwargs: dict, **opts):
+        """Thin async shim over the one real submission path (the nowait
+        one) — kept for API compatibility; a second seq-minting path would
+        have to stay lock-consistent with it for nothing."""
+        return self.submit_actor_task_nowait(
+            actor_id, method_name, args, kwargs, **opts)
 
     def _next_seq(self, st: ActorHandleState) -> int:
         st.seq += 1
@@ -2739,9 +2794,12 @@ class CoreWorker:
                     # the actor restarted since this spec was stamped: its
                     # fresh executor numbers from 1, so re-stamp into the
                     # current incarnation's sequence (order across a crash is
-                    # best-effort, as in the reference's restart epoch)
+                    # best-effort, as in the reference's restart epoch).
+                    # _next_seq under the lock: driver threads mint seqs
+                    # concurrently via submit_actor_task_nowait
                     spec.incarnation = st.incarnation
-                    spec.seq_no = self._next_seq(st)
+                    with self._lock:
+                        spec.seq_no = self._next_seq(st)
                 if st.client is None:
                     st.client = RpcClient(st.address, name="to-actor", retries=0)
                     await st.client.connect()

@@ -374,7 +374,7 @@ class TaskExecutor:
     async def _resolve_args(self, wire_args) -> Tuple[tuple, dict]:
         if not wire_args:
             return (), {}
-        resolved = await asyncio.gather(*[self.cw.resolve_arg(a) for a in wire_args])
+        resolved = await self.cw.resolve_args_batch(wire_args)
         args, kwargs = [], {}
         for wire, value in zip(wire_args, resolved):
             if wire.get("kw") is not None:

@@ -108,26 +108,17 @@ class ActorHandle:
         cw = get_core_worker()
         streaming = num_returns == "streaming"
         wire_returns = NUM_RETURNS_STREAMING if streaming else num_returns
-        if cw._loop_running_here():
-            # inside an async actor: non-blocking submission (run_sync would
-            # deadlock the shared event loop)
-            result = cw.submit_actor_task_nowait(
-                self._actor_id.binary(), method_name, args, kwargs,
-                num_returns=wire_returns,
-                max_task_retries=self._max_task_retries,
-                concurrency_group=concurrency_group,
-                concurrent=self._concurrent,
-            )
-        else:
-            result = cw.run_sync(
-                cw.submit_actor_task(
-                    self._actor_id.binary(), method_name, args, kwargs,
-                    num_returns=wire_returns,
-                    max_task_retries=self._max_task_retries,
-                    concurrency_group=concurrency_group,
-                    concurrent=self._concurrent,
-                )
-            )
+        # non-blocking from every context: seq assignment happens on the
+        # calling thread (ordering decided here), serialization + delivery
+        # continue on the event loop. A per-call blocking loop hop would
+        # cap pipelined submission at the thread-handoff rate.
+        result = cw.submit_actor_task_nowait(
+            self._actor_id.binary(), method_name, args, kwargs,
+            num_returns=wire_returns,
+            max_task_retries=self._max_task_retries,
+            concurrency_group=concurrency_group,
+            concurrent=self._concurrent,
+        )
         if streaming:
             return result
         return result[0] if num_returns == 1 else result

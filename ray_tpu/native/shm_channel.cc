@@ -222,4 +222,24 @@ uint64_t rt_chan_slot_size(void* base) {
   return reinterpret_cast<ChannelHeader*>(base)->slot_size;
 }
 
+// Touch every payload page of every slot in THIS process's mapping so the
+// first real transfer doesn't eat a minor fault per 4KB (shmem THP is
+// commonly disabled). write=1 does a read-modify-write (installs writable
+// PTEs for the producer side); only safe while the ring carries no
+// committed slots.
+void rt_chan_prefault(void* base, int write) {
+  auto* h = reinterpret_cast<ChannelHeader*>(base);
+  for (uint64_t i = 0; i < h->nslots; i++) {
+    auto* p = reinterpret_cast<volatile uint8_t*>(slot_at(h, i)) +
+              sizeof(Slot);
+    for (uint64_t off = 0; off < h->slot_size; off += 4096) {
+      if (write) {
+        p[off] = p[off];
+      } else {
+        (void)p[off];
+      }
+    }
+  }
+}
+
 }  // extern "C"

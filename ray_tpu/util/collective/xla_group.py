@@ -77,6 +77,11 @@ class XlaCollectiveGroup:
             )
         self.mesh = self._build_mesh()
         self._register_p2p()
+        # shm fast path state (same-node host collectives; see _shm_allreduce)
+        self._shm_chans: Optional[dict] = None
+        self._shm_chan_size = 0
+        self._shm_gen = 0
+        self._same_node: Optional[bool] = None
 
     # ------------------------------------------------------------------
     # bootstrap
@@ -170,9 +175,125 @@ class XlaCollectiveGroup:
         if cw is None:
             return
         self._kv_put(f"{self.group_name}:member:{self.rank}", cw.address.encode())
+        self._kv_put(f"{self.group_name}:node:{self.rank}",
+                     cw.node_id_hex.encode())
         cw.server.register(
             f"collective_p2p:{self.group_name}", self._handle_p2p
         )
+
+    # ------------------------------------------------------------------
+    # shm fast path: same-node host collectives through the node's object
+    # store (zero-copy reads) instead of gloo's localhost TCP — the host-
+    # plane analogue of the reference's shared-memory Gloo transport. The
+    # device (jax.Array) path keeps the mesh collectives: on TPU those
+    # ride ICI, which no host plane should intercept.
+    # ------------------------------------------------------------------
+
+    def _all_same_node(self) -> bool:
+        if self._same_node is None:
+            cw = self._kv()
+            if cw is None or cw.store is None or self.world_size == 1:
+                self._same_node = False
+            else:
+                try:
+                    nodes = {
+                        self._kv_get(f"{self.group_name}:node:{r}",
+                                     timeout=30)
+                        for r in range(self.world_size)
+                    }
+                    self._same_node = len(nodes) == 1
+                except Exception:  # noqa: BLE001 — fall back to the mesh
+                    self._same_node = False
+        return self._same_node
+
+    def _shm_chan_oid(self, src: int, dst: int, gen: int):
+        import hashlib
+
+        from ray_tpu._private.ids import ObjectID
+
+        digest = hashlib.sha256(
+            f"colchan:{self.group_name}:{src}->{dst}:{gen}".encode()
+        ).digest()
+        return ObjectID(digest[:24])
+
+    def _shm_chan_pairs(self, nbytes: int):
+        """Lazily build (and resize in lockstep) the per-peer SPSC channel
+        pairs. Fixed ring slots mean payload pages fault ONCE and stay hot
+        — per-call store objects re-fault every 4KB page every round
+        (shmem THP is usually off), which caps bandwidth well below
+        memcpy."""
+        from ray_tpu._private.core_worker import get_core_worker
+        from ray_tpu.experimental.channel import ShmChannel
+
+        size = max(1 << 16, 1 << (nbytes - 1).bit_length())
+        if self._shm_chans is not None and self._shm_chan_size >= size:
+            return self._shm_chans
+        store = get_core_worker().store
+        if self._shm_chans is not None:
+            for ch in self._shm_chans["in"].values():
+                ch.unpin()
+            for ch in self._shm_chans["out"].values():
+                ch.unpin()
+        self._shm_gen += 1
+        self._shm_chan_size = size
+        gen = self._shm_gen
+        peers = [r for r in range(self.world_size) if r != self.rank]
+        # reader creates its inbound rings; writers block-open them (the
+        # same ownership rule as the compiled-DAG channel plane)
+        inbound = {
+            r: ShmChannel(store, self._shm_chan_oid(r, self.rank, gen),
+                          creator=True, nslots=2, slot_size=size)
+            for r in peers
+        }
+        outbound = {
+            r: ShmChannel(store, self._shm_chan_oid(self.rank, r, gen),
+                          creator=False, nslots=2, slot_size=size)
+            for r in peers
+        }
+        for ch in inbound.values():
+            ch.prefault(write=False)
+        for ch in outbound.values():
+            ch.prefault(write=True)
+        self._shm_chans = {"in": inbound, "out": outbound}
+        return self._shm_chans
+
+    def _shm_allreduce(self, x: np.ndarray, op: str):
+        """Same-node host allreduce over per-peer shm channel rings:
+        one slot memcpy out, one zero-copy read + accumulate per peer —
+        memcpy-speed, no serialization, no RPC, no per-call allocation."""
+        x = np.ascontiguousarray(x)
+        chans = self._shm_chan_pairs(x.nbytes)
+        for r, ch in chans["out"].items():
+            slot = ch.reserve_view(x.nbytes, timeout=120)
+            np.copyto(np.frombuffer(slot, dtype=x.dtype).reshape(x.shape), x)
+            slot.release()
+            ch.commit(x.nbytes)
+        npop = {"sum": np.add, "prod": np.multiply,
+                "max": np.maximum, "min": np.minimum}[op]
+        # Combine in FIXED global rank order: float reduction is not
+        # associative, and every rank must return bit-identical results or
+        # lockstep replicas silently drift (the mesh path guarantees the
+        # same). One slot view is held per inbound channel (distinct
+        # rings), so all contributions can be viewed at once; the first
+        # combine allocates `out` in a single fused pass.
+        held = []
+        vals = []
+        for r in range(self.world_size):
+            if r == self.rank:
+                vals.append(x)
+                continue
+            ch = chans["in"][r]
+            pview = ch.read_view(timeout=120)
+            vals.append(np.frombuffer(pview, dtype=x.dtype).reshape(x.shape))
+            held.append((pview, ch))
+        out = npop(vals[0], vals[1])
+        for v in vals[2:]:
+            npop(out, v, out=out)
+        del vals
+        for pview, ch in held:
+            pview.release()
+            ch.consume()
+        return out
 
     async def _handle_p2p(self, conn_id, payload):
         q = self._p2p_queues.setdefault(payload["src"], asyncio.Queue())
@@ -274,6 +395,34 @@ class XlaCollectiveGroup:
         x, dev = self._resolve_input(x)
         if self.world_size == 1:
             return x if dev else np.asarray(x)
+        import jax
+
+        if (not dev or jax.default_backend() == "cpu") \
+                and self._all_same_node():
+            # Host-memory payload on a co-located group: zero-copy through
+            # the node's shm store beats gloo's loopback TCP several-fold.
+            # CPU-backend "device" arrays are host memory, so they take
+            # this path too; on TPU the device path stays ICI mesh
+            # collectives. Mixed host/device inputs across ranks are not
+            # allowed (the paths would deadlock) — the collective contract
+            # already requires symmetric calls.
+            if dev:
+                try:  # CPU jax array -> numpy without a copy
+                    xh = np.from_dlpack(x)
+                except Exception:  # noqa: BLE001
+                    xh = np.asarray(x)
+            else:
+                xh = x
+            out = self._shm_allreduce(
+                np.asarray(xh), {"product": "prod"}.get(op, op))
+            if not dev:
+                return out
+            try:  # wrap without a copy (out is freshly allocated)
+                import jax.numpy as jnp
+
+                return jnp.from_dlpack(out)
+            except Exception:  # noqa: BLE001
+                return jax.device_put(out)
         reducer = _REDUCERS[op]
         garr, mesh = self._global_stack(x, dev)
         return self._run_sharded(
@@ -411,6 +560,17 @@ class XlaCollectiveGroup:
 
     def destroy(self):
         import jax
+
+        # unpin shm-path channel rings (the reader-created inbound rings
+        # become evictable once the writer side unpins too)
+        if self._shm_chans is not None:
+            for ch in list(self._shm_chans["in"].values()) + list(
+                    self._shm_chans["out"].values()):
+                try:
+                    ch.unpin()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._shm_chans = None
 
         # only the group that initialized the process-global distributed
         # runtime may tear it down — other live groups share it

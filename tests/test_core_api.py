@@ -299,3 +299,32 @@ def test_cluster_resources(ray_init):
     total = ray_tpu.cluster_resources()
     assert total.get("CPU") == 4.0
     assert len(ray_tpu.nodes()) == 1
+
+
+def test_many_ref_args_resolve_batched(ray_init):
+    """The 10k-args-per-task envelope (reference:
+    release/benchmarks/README.md:27): driver-owned tiny refs resolve on
+    the executor through one batched owner fetch per chunk, mixed freely
+    with inline values and error refs."""
+    import time
+
+    @ray_tpu.remote
+    def consume(*parts):
+        return sum(p for p in parts if isinstance(p, int))
+
+    n = 2000
+    refs = [ray_tpu.put(i) for i in range(n)]
+    t0 = time.perf_counter()
+    total = ray_tpu.get(consume.remote(*refs, 1000), timeout=300)
+    dt = time.perf_counter() - t0
+    assert total == n * (n - 1) // 2 + 1000
+    assert dt < 10, f"{n}-arg resolution took {dt:.1f}s"
+
+    # an error ref in the batch fails the task with the original error
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("arg exploded")
+
+    bad = boom.remote()
+    with pytest.raises(ray_tpu.TaskError, match="arg exploded"):
+        ray_tpu.get(consume.remote(refs[0], bad), timeout=120)
