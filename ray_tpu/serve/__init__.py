@@ -141,9 +141,26 @@ def start_grpc(grpc_host: str = "127.0.0.1", grpc_port: int = 9000) -> str:
     return _start(grpc_host, grpc_port)
 
 
-def start(http_host: str = "127.0.0.1", http_port: int = 8000) -> str:
-    """Start the HTTP ingress; returns its base URL (reference:
-    serve.start(http_options=...))."""
+def start(http_host: str = "127.0.0.1", http_port: int = 8000,
+          proxy_location: str = "head") -> str:
+    """Start the HTTP ingress; returns a base URL (reference:
+    serve.start(http_options=..., proxy_location=...)).
+
+    proxy_location="head" (default): one proxy on this node, fixed port —
+    the dev mode. "every_node": the controller maintains one proxy PER
+    ALIVE node (reference: proxy.py one-proxy-per-node + proxy_state.py),
+    healing the fleet as nodes come and go; requests can enter through any
+    node (front them with any TCP load balancer). With http_port=0 each
+    fleet proxy binds an ephemeral port (required when several daemons
+    share one test host); see serve.proxy_urls() for the full map."""
+    if proxy_location == "every_node":
+        controller = get_or_create_controller()
+        urls = ray_tpu.get(
+            controller.ensure_proxies.remote(http_host, http_port),
+            timeout=120)
+        if not urls:
+            raise RuntimeError("no alive nodes to host serve proxies")
+        return sorted(urls.values())[0]
     from ray_tpu.serve._http import PROXY_NAME, HttpProxy
 
     try:
@@ -154,6 +171,13 @@ def start(http_host: str = "127.0.0.1", http_port: int = 8000) -> str:
             max_concurrency=256,
         ).remote(host=http_host, port=http_port)
     return ray_tpu.get(proxy.ready.remote(), timeout=60)
+
+
+def proxy_urls() -> Dict[str, str]:
+    """{node_id_hex: url} for the per-node proxy fleet (empty in the
+    single-proxy dev mode)."""
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.proxy_urls.remote(), timeout=30)
 
 
 def delete(name: str):

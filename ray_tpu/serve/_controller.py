@@ -34,6 +34,12 @@ class ServeController:
         # replicas of a deployment a concurrent delete just tore down,
         # leaking detached actors that pin node resources forever.
         self._scale_lock = asyncio.Lock()
+        # per-node proxy fleet (None = single-proxy dev mode)
+        self._proxy_cfg: Optional[dict] = None
+        self._proxies: Dict[str, dict] = {}  # node hex -> {actor, url}
+        # ensure_proxies and the reconcile tick both mutate the fleet; two
+        # interleaved creates for one node would race on the named actor
+        self._proxy_lock = asyncio.Lock()
 
     async def _ensure_loop(self):
         t = self._reconcile_task
@@ -180,6 +186,7 @@ class ServeController:
 
     async def shutdown(self) -> bool:
         self._running = False
+        await self.shutdown_proxies()
         for name in list(self.deployments):
             await self.delete_deployment(name)
         return True
@@ -273,6 +280,92 @@ class ServeController:
 
                     logging.getLogger(__name__).exception(
                         "reconcile of %s failed", name)
+            if self._proxy_cfg is not None:
+                try:
+                    await self._reconcile_proxies()
+                except Exception:  # noqa: BLE001 — heal next tick
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "proxy-fleet reconcile failed")
+
+    # -- per-node proxy fleet (reference: proxy.py:1031 one proxy per
+    # node + proxy_state.py's controller-side fleet state) ---------------
+
+    async def ensure_proxies(self, host: str = "127.0.0.1",
+                             port: int = 0) -> dict:
+        """Switch the ingress to a per-node fleet: one HTTP proxy pinned
+        to every ALIVE node, healed as nodes come and go. port=0 gives
+        each proxy an ephemeral port (several proxies share a host in
+        tests); a fixed port maps one-to-one on real multi-host clusters.
+        Returns {node_id_hex: url}."""
+        self._proxy_cfg = {"host": host, "port": port}
+        await self._reconcile_proxies()
+        return await self.proxy_urls()
+
+    async def proxy_urls(self) -> dict:
+        return {n: p["url"] for n, p in self._proxies.items()}
+
+    async def _reconcile_proxies(self):
+        from ray_tpu._private.core_worker import get_core_worker
+        from ray_tpu.serve._http import HttpProxy
+
+        async with self._proxy_lock:
+            cfg = self._proxy_cfg
+            if cfg is None:
+                return
+            cw = get_core_worker()
+            reply = await cw.control.call("get_all_nodes", {}, timeout=10)
+            alive = {n["node_id"].hex() for n in reply["nodes"]
+                     if n["state"] == "ALIVE"}
+            # forget (and reap) proxies on dead nodes
+            for node in list(self._proxies):
+                if node not in alive:
+                    p = self._proxies.pop(node)
+                    try:
+                        await cw.kill_actor(
+                            p["actor"]._actor_id.binary(), no_restart=True)
+                    except Exception:  # noqa: BLE001 — died with its node
+                        pass
+            for node in alive:
+                if node in self._proxies:
+                    continue
+                proxy = HttpProxy.options(
+                    name=f"serve-http-proxy:{node[:12]}",
+                    namespace=SERVE_NAMESPACE, lifetime="detached",
+                    max_concurrency=256,
+                    scheduling_strategy=f"node:{node}",
+                ).remote(host=cfg["host"], port=cfg["port"])
+                try:
+                    # bounded: a wedged bind must not freeze the shared
+                    # reconcile loop (deployment scaling rides it too)
+                    url = await asyncio.wait_for(
+                        proxy.ready.remote(), timeout=60)
+                except Exception:  # noqa: BLE001 — reap; retry next tick
+                    try:
+                        await cw.kill_actor(
+                            proxy._actor_id.binary(), no_restart=True)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    continue
+                self._proxies[node] = {"actor": proxy, "url": url}
+
+    async def shutdown_proxies(self):
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        for p in self._proxies.values():
+            try:
+                await p["actor"].stop.remote()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                await cw.kill_actor(
+                    p["actor"]._actor_id.binary(), no_restart=True)
+            except Exception:  # noqa: BLE001
+                pass
+        self._proxies = {}
+        self._proxy_cfg = None
 
     async def _reconcile_deployment(self, name: str, d: dict):
         async with self._scale_lock:

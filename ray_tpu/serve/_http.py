@@ -57,6 +57,10 @@ class HttpProxy:
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
         await self._site.start()
+        if self.port == 0:
+            # ephemeral bind (proxy fleets on one test host can't share a
+            # fixed port): report the real one
+            self.port = self._runner.addresses[0][1]
         return True
 
     async def ready(self) -> str:
@@ -64,6 +68,11 @@ class HttpProxy:
             self._started = asyncio.ensure_future(self._start())
         await self._started
         return f"http://{self.host}:{self.port}"
+
+    async def node(self) -> str:
+        from ray_tpu._private.core_worker import get_core_worker
+
+        return get_core_worker().node_id_hex
 
     async def _routes(self, request):
         from aiohttp import web
