@@ -6,6 +6,8 @@ from ray_tpu.rllib import connectors
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, ReplayBuffer
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import PPOLearner, compute_gae
+from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner import VTraceLearner
 from ray_tpu.rllib.ppo import PPO, PPOConfig

@@ -83,14 +83,7 @@ class IMPALA:
         obs_dim = int(np.prod(probe.observation_space.shape))
         num_actions = int(probe.action_space.n)
         probe.close()
-        self.learner = VTraceLearner(
-            obs_dim, num_actions, hidden=tuple(config.hidden), lr=config.lr,
-            gamma=config.gamma,
-            rho_bar=config.vtrace_clip_rho_threshold,
-            c_bar=config.vtrace_clip_c_threshold,
-            vf_coeff=config.vf_loss_coeff,
-            entropy_coeff=config.entropy_coeff, seed=config.seed,
-        )
+        self.learner = self._make_learner(config, obs_dim, num_actions)
         self.env_runners = [
             EnvRunner.remote(
                 config.env_name, seed=config.seed + 1000 * (i + 1),
@@ -154,6 +147,16 @@ class IMPALA:
             "num_episodes": len(returns),
             **metrics,
         }
+
+    def _make_learner(self, config, obs_dim: int, num_actions: int):
+        return VTraceLearner(
+            obs_dim, num_actions, hidden=tuple(config.hidden), lr=config.lr,
+            gamma=config.gamma,
+            rho_bar=config.vtrace_clip_rho_threshold,
+            c_bar=config.vtrace_clip_c_threshold,
+            vf_coeff=config.vf_loss_coeff,
+            entropy_coeff=config.entropy_coeff, seed=config.seed,
+        )
 
     def get_weights(self):
         return self.learner.get_weights()

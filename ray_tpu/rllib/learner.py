@@ -246,6 +246,118 @@ def _vtrace_update(params, opt_state, batch, *, tx, gamma, rho_bar, c_bar,
     }
 
 
+def _appo_loss(params, target_params, batch, *, gamma, rho_bar, c_bar,
+               clip_param, vf_coeff, entropy_coeff):
+    """APPO loss (reference: rllib/algorithms/appo/torch/appo_torch_learner
+    .py): PPO's clipped surrogate on V-TRACE advantages, with the V-trace
+    targets computed from a lagging TARGET value network — the combination
+    that keeps clipping meaningful when fragments arrive asynchronously
+    off-policy."""
+    obs = batch["obs"]
+    next_obs = batch["next_obs"]
+    logits = policy_logits(params, obs)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    v = value_fn(params, obs)
+    tv = jax.lax.stop_gradient(value_fn(target_params, obs))
+    tnext_v = jax.lax.stop_gradient(value_fn(target_params, next_obs))
+    not_term = 1.0 - batch["terminated"]
+    not_cut = 1.0 - batch["cut"]
+    rho = jnp.minimum(jnp.exp(logp - batch["logp"]), rho_bar)
+    c = jnp.minimum(rho, c_bar)
+    rho_sg = jax.lax.stop_gradient(rho)
+    delta = rho_sg * (batch["rewards"] + gamma * tnext_v * not_term - tv)
+
+    def back(carry, x):
+        d, c_t, disc = x
+        carry = d + disc * c_t * carry
+        return carry, carry
+
+    _, vs_minus_v = jax.lax.scan(
+        back, 0.0,
+        (delta, jax.lax.stop_gradient(c), gamma * not_cut),
+        reverse=True,
+    )
+    vs = tv + vs_minus_v
+    vs_next = jnp.where(
+        not_cut.astype(bool),
+        jnp.concatenate([vs[1:], tnext_v[-1:]]),
+        tnext_v,
+    )
+    pg_adv = jax.lax.stop_gradient(
+        rho_sg * (batch["rewards"] + gamma * vs_next * not_term - tv))
+    ratio = jnp.exp(logp - batch["logp"])
+    surr = jnp.minimum(
+        ratio * pg_adv,
+        jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * pg_adv)
+    pg_loss = -surr.mean()
+    vf_loss = 0.5 * ((v - vs) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    return total, (pg_loss, vf_loss, entropy)
+
+
+def _appo_update(params, target_params, opt_state, batch, *, tx, gamma,
+                 rho_bar, c_bar, clip_param, vf_coeff, entropy_coeff):
+    (loss, aux), grads = jax.value_and_grad(_appo_loss, has_aux=True)(
+        params, target_params, batch, gamma=gamma, rho_bar=rho_bar,
+        c_bar=c_bar, clip_param=clip_param, vf_coeff=vf_coeff,
+        entropy_coeff=entropy_coeff)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    pg, vf, ent = aux
+    return params, opt_state, {
+        "total_loss": loss, "policy_loss": pg, "vf_loss": vf, "entropy": ent,
+    }
+
+
+class APPOLearner:
+    """APPO learner (reference: appo.py — async PPO): clipped-surrogate
+    updates per arriving fragment, V-trace advantages against a target
+    value network refreshed every `target_update_freq` updates."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *,
+                 hidden: Tuple[int, ...] = (64, 64), lr: float = 5e-4,
+                 gamma: float = 0.99, rho_bar: float = 1.0, c_bar: float = 1.0,
+                 clip_param: float = 0.2, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, target_update_freq: int = 8,
+                 seed: int = 0):
+        key = jax.random.PRNGKey(seed)
+        kp, kv = jax.random.split(key)
+        self.params = {
+            "pi": init_mlp(kp, [obs_dim, *hidden, num_actions]),
+            "vf": init_mlp(kv, [obs_dim, *hidden, 1]),
+        }
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+        self.target_update_freq = target_update_freq
+        self._updates = 0
+        self._update_jit = jax.jit(functools.partial(
+            _appo_update, tx=self.tx, gamma=gamma, rho_bar=rho_bar,
+            c_bar=c_bar, clip_param=clip_param, vf_coeff=vf_coeff,
+            entropy_coeff=entropy_coeff,
+        ))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self.params, self.opt_state, metrics = self._update_jit(
+            self.params, self.target_params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in batch.items()},
+        )
+        self._updates += 1
+        if self._updates % self.target_update_freq == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights: Any):
+        self.params = jax.device_put(weights)
+
+
 class VTraceLearner:
     """IMPALA learner: one SGD step per arriving fragment, with V-trace
     off-policy correction (reference: impala TorchLearner loss)."""

@@ -21,6 +21,7 @@ import ray_tpu
 from ray_tpu.tune._scheduler import (
     CONTINUE,
     EXPLOIT,
+    PB2,
     STOP,
     ASHAScheduler,
     FIFOScheduler,
@@ -302,6 +303,7 @@ class Tuner:
 __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
+    "PB2",
     "PopulationBasedTraining",
     "get_checkpoint",
     "Result",

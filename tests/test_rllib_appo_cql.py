@@ -1,0 +1,114 @@
+"""APPO (async clipped PPO) + offline CQL learning tests (VERDICT r4 next
+#10; reference: rllib/algorithms/appo/appo.py, rllib/algorithms/cql/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import APPO, APPOConfig, CQLConfig, CQLLearner
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_appo_learner_improves_cartpole(ray_init):
+    """APPO must learn CartPole through the async IMPALA pipeline with the
+    clipped-surrogate/V-trace loss."""
+    algo = (
+        APPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, rollout_fragment_length=256)
+        .training(lr=3e-3, entropy_coeff=0.01, clip_param=0.3,
+                  train_batches_per_iteration=8)
+        .build()
+    )
+    try:
+        first = algo.train()
+        best = first["episode_return_mean"]
+        for _ in range(14):
+            m = algo.train()
+            if np.isfinite(m["episode_return_mean"]):
+                best = max(best, m["episode_return_mean"])
+            if best > 120:
+                break
+        assert best > 120, f"APPO never learned: best={best}"
+        assert m["env_steps_per_s"] > 0
+    finally:
+        algo.stop()
+
+
+def _collect_transitions(n, seed=0, eps=0.3):
+    """Mixed-quality CartPole transitions (expert + noise) — the offline
+    regime CQL is built for."""
+    import gymnasium as gym
+
+    rng = np.random.default_rng(seed)
+    env = gym.make("CartPole-v1")
+    rows = []
+    obs, _ = env.reset(seed=seed)
+    for _ in range(n):
+        # angle+velocity balance heuristic, epsilon-corrupted
+        a = int(obs[2] + 0.5 * obs[3] > 0)
+        if rng.random() < eps:
+            a = int(rng.integers(2))
+        nobs, r, term, trunc, _ = env.step(a)
+        rows.append({"obs": np.asarray(obs, np.float32), "action": a,
+                     "reward": float(r),
+                     "next_obs": np.asarray(nobs, np.float32),
+                     "terminated": float(term)})
+        obs = nobs
+        if term or trunc:
+            obs, _ = env.reset()
+    env.close()
+    return rows
+
+
+def test_cql_learns_policy_from_offline_data(ray_init):
+    """CQL trains a usable greedy policy purely from logged transitions,
+    and the conservative penalty actually shrinks over training."""
+    import ray_tpu.data as rtd
+
+    rows = _collect_transitions(6000)
+    ds = rtd.from_items(rows, parallelism=4)
+    algo = (
+        CQLConfig()
+        .environment("CartPole-v1")
+        .offline_data(ds)
+        .training(lr=1e-3, cql_alpha=0.5, train_batch_size=256,
+                  hidden=[64, 64], target_update_freq=100)
+        .build()
+    )
+    m0 = algo.train()
+    for _ in range(7):
+        m = algo.train()
+    assert m["cql_penalty"] < m0["cql_penalty"], (m0, m)
+    ev = algo.evaluate(num_episodes=3)
+    # random scores ~20; the heuristic behind the data ~100+
+    assert ev["episode_return_mean"] > 60, ev
+
+
+def test_cql_penalty_suppresses_ood_actions():
+    """Unit: with a dataset that only ever takes action 0, the conservative
+    penalty must drive Q(s, 1) below Q(s, 0) even though action 1's TD
+    target would otherwise look attractive."""
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(512, 4)).astype(np.float32)
+    batch = {
+        "obs": obs,
+        "action": np.zeros(512, np.int64),
+        "reward": np.ones(512, np.float32),
+        "next_obs": rng.normal(size=(512, 4)).astype(np.float32),
+        "terminated": np.zeros(512, np.float32),
+    }
+    learner = CQLLearner(4, 2, hidden=(32,), lr=1e-2, cql_alpha=2.0,
+                         target_update_freq=50, seed=1)
+    for _ in range(60):
+        learner.update(batch)
+    from ray_tpu.rllib.learner import mlp_apply
+
+    q = np.asarray(mlp_apply(learner.params["q1"], batch["obs"]))
+    assert (q[:, 0] > q[:, 1]).mean() > 0.95, q[:5]
