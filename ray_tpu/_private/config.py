@@ -154,6 +154,8 @@ _flag("actor_creation_timeout_s", 120.0, "Control store waits this long for a da
 _flag("lease_request_timeout_s", 30.0, "Per-attempt deadline on a worker-lease RPC; timed-out requests are retried idempotently by request key (a lease may legitimately stay queued across many attempts).")
 _flag("placement_group_timeout_s", 60.0, "Placement group scheduling deadline before marked unschedulable.")
 _flag("actor_ordering_gap_timeout_s", 120.0, "Ordered actor task fails (never reorders) after waiting this long for a missing predecessor sequence number. Generous: a predecessor may be legitimately slow to ARRIVE (its args still computing upstream in an actor DAG, first-call jit compiles); the timeout only exists to reclaim liveness when a caller died mid-retry and the hole is permanent.")
+_flag("borrow_reaper_strikes", 3, "Consecutive failed liveness probes before a borrower is declared dead (one missed ping may just be a stalled event loop).")
+_flag("borrow_reaper_period_s", 30.0, "Owner-side borrower liveness probe period: borrows held by unreachable borrower processes are dropped so their objects can free (reference: reference_counter borrower-death cleanup).")
 _flag("object_spill_enabled", True, "Spill cold sealed objects to disk under store memory pressure (reference: raylet local_object_manager spilling).")
 _flag("object_spill_high_water", 0.7, "Store fullness fraction that triggers spilling.")
 _flag("object_spill_low_water", 0.5, "Spill until store fullness drops below this fraction.")

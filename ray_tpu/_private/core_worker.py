@@ -171,12 +171,27 @@ class ReferenceCounter:
     Reference: src/ray/core_worker/reference_counter.h:44. Owned objects are
     freed when (local refs == 0) and (known borrowers == 0); borrower
     processes notify the owner on first deserialization and on release.
-    """
+
+    Borrows are TRANSITIVE BY CONSTRUCTION: a ref forwarded B -> C makes C
+    register with the OWNER directly (the owner address rides inside every
+    serialized ref), so chained borrowers need no per-hop protocol — the
+    piece of the reference's 2.6k-line borrow machinery that exists to
+    merge borrower lists up the chain is structural here. The no-premature-
+    free invariant across the forwarding window holds because the
+    forwarding task's submission pins the ref (serialize_args `_pyref`)
+    until the task completed, which is after the receiver registered.
+
+    Owner-side borrows are keyed by borrower ADDRESS so borrows held by
+    DEAD borrower processes can be reconciled: a borrower that dies
+    without remove_borrow would otherwise pin the object forever
+    (reference: reference_counter borrower-death cleanup via pubsub;
+    here a slow reaper probes borrower liveness over the RPC plane)."""
 
     def __init__(self, cw: "CoreWorker"):
         self.cw = cw
         self.local_counts: Dict[bytes, int] = {}
-        self.borrower_counts: Dict[bytes, int] = {}  # for owned objects
+        # owned objects: oid -> {borrower_address: count}
+        self.borrower_counts: Dict[bytes, Dict[str, int]] = {}
         self.borrowed_owners: Dict[bytes, str] = {}  # oid -> owner address
         self._lock = threading.Lock()
 
@@ -202,7 +217,7 @@ class ReferenceCounter:
                 return
         if self.cw.owns(ref):
             with self._lock:
-                if self.borrower_counts.get(key, 0) > 0:
+                if self.borrower_counts.get(key):
                     return
             await self.cw.free_owned_object(ref.object_id())
         else:
@@ -222,21 +237,50 @@ class ReferenceCounter:
             )
 
     # owner side
-    def add_borrower(self, oid: bytes):
+    def add_borrower(self, oid: bytes, borrower: str = ""):
         with self._lock:
-            self.borrower_counts[oid] = self.borrower_counts.get(oid, 0) + 1
+            per = self.borrower_counts.setdefault(oid, {})
+            per[borrower] = per.get(borrower, 0) + 1
 
-    def remove_borrower(self, oid: bytes):
+    def remove_borrower(self, oid: bytes, borrower: str = ""):
         drop = False
         with self._lock:
-            n = self.borrower_counts.get(oid, 0) - 1
+            per = self.borrower_counts.get(oid)
+            if per is None:
+                return
+            n = per.get(borrower, 0) - 1
             if n <= 0:
+                per.pop(borrower, None)
+            else:
+                per[borrower] = n
+            if not per:
                 self.borrower_counts.pop(oid, None)
                 drop = self.local_counts.get(oid, 0) == 0
-            else:
-                self.borrower_counts[oid] = n
         if drop:
             self.cw.schedule(self.cw.free_owned_object(ObjectID(oid)))
+
+    def drop_borrower_process(self, borrower: str) -> int:
+        """Reconcile every borrow held by a (dead) borrower process; frees
+        objects whose last reference that was. Returns how many borrows
+        were dropped."""
+        to_free = []
+        dropped = 0
+        with self._lock:
+            for oid in list(self.borrower_counts):
+                per = self.borrower_counts[oid]
+                if borrower in per:
+                    dropped += per.pop(borrower)
+                    if not per:
+                        self.borrower_counts.pop(oid, None)
+                        if self.local_counts.get(oid, 0) == 0:
+                            to_free.append(oid)
+        for oid in to_free:
+            self.cw.schedule(self.cw.free_owned_object(ObjectID(oid)))
+        return dropped
+
+    def borrower_addresses(self) -> set:
+        with self._lock:
+            return {b for per in self.borrower_counts.values() for b in per}
 
 
 class MemoryStore:
@@ -540,12 +584,59 @@ class CoreWorker:
         )
         self._telemetry_task = spawn(self._telemetry_loop())
         self._lease_sweep_task = spawn(self._lease_pool_sweep())
+        self._borrow_reaper_task = spawn(self._borrow_reaper_loop())
         if self.mode == MODE_WORKER:
             # fate-share with the node daemon (reference: workers die with
             # their raylet — agent_manager/worker fate-sharing). An orphaned
             # worker that outlives its daemon would keep accepting pushes
             # and store returns into a store no daemon serves.
             self._fate_task = spawn(self._daemon_fate_watch())
+
+    async def rpc_ping(self, conn_id: int, payload: dict) -> dict:
+        return {"ok": True}
+
+    async def _borrow_reaper_loop(self):
+        """Owner-side borrower-death reconciliation (reference:
+        reference_counter.h borrower cleanup, driven there by pubsub worker-
+        failure notices): probe each borrower address; an unreachable
+        borrower's borrows are dropped so its objects can free instead of
+        leaking for the owner's lifetime. Probes are cheap (one ping per
+        distinct borrower per period) and only run while borrows exist."""
+        period = GLOBAL_CONFIG.get("borrow_reaper_period_s")
+        strikes = GLOBAL_CONFIG.get("borrow_reaper_strikes")
+        failures: Dict[str, int] = {}
+        while not self._closed:
+            await asyncio.sleep(period)
+            live = self.ref_counter.borrower_addresses()
+            for addr in list(failures):
+                if addr not in live:
+                    failures.pop(addr, None)
+            for addr in live:
+                if self._closed:
+                    return
+                try:
+                    client = await self._owner_client(addr)
+                    await client.call("ping", {}, timeout=5)
+                    failures.pop(addr, None)
+                except Exception:  # noqa: BLE001 — maybe gone, maybe slow
+                    # One missed ping is NOT death: a borrower stalled in a
+                    # GIL-bound task or a long compile must not have its
+                    # borrows reaped (premature free). Declare death only
+                    # after consecutive failed probes, and only THEN retire
+                    # the pooled client (closing it earlier would fail
+                    # in-flight RPCs to a live peer).
+                    failures[addr] = failures.get(addr, 0) + 1
+                    if failures[addr] < strikes:
+                        continue
+                    failures.pop(addr, None)
+                    dropped = self.ref_counter.drop_borrower_process(addr)
+                    if dropped:
+                        logger.info(
+                            "reaped %d borrow(s) held by dead borrower %s",
+                            dropped, addr)
+                    dead = self._owner_clients.pop(addr, None)
+                    if dead is not None:
+                        spawn(dead.close())
 
     async def _telemetry_loop(self):
         """Flush buffered task events + metric snapshots to the control
@@ -608,6 +699,8 @@ class CoreWorker:
             self._telemetry_task.cancel()
         if getattr(self, "_lease_sweep_task", None) is not None:
             self._lease_sweep_task.cancel()
+        if getattr(self, "_borrow_reaper_task", None) is not None:
+            self._borrow_reaper_task.cancel()
         # return every cached lease so the daemons free the capacity now
         # (snapshot: an in-flight submit can insert a pool key mid-await)
         for pool in list(self._lease_pools.values()):
@@ -1134,11 +1227,13 @@ class CoreWorker:
         return {"ok": True}
 
     async def rpc_add_borrow(self, conn_id: int, payload: dict) -> dict:
-        self.ref_counter.add_borrower(payload["object_id"])
+        self.ref_counter.add_borrower(payload["object_id"],
+                                      payload.get("borrower", ""))
         return {"ok": True}
 
     async def rpc_remove_borrow(self, conn_id: int, payload: dict) -> dict:
-        self.ref_counter.remove_borrower(payload["object_id"])
+        self.ref_counter.remove_borrower(payload["object_id"],
+                                         payload.get("borrower", ""))
         return {"ok": True}
 
     # ------------------------------------------------------------------
@@ -1317,7 +1412,13 @@ class CoreWorker:
             return
         try:
             client = await self._owner_client(owner_address)
-            await client.call(method, {"object_id": oid}, timeout=10)
+            await client.call(method, {
+                "object_id": oid,
+                # borrow bookkeeping is keyed by borrower identity so the
+                # owner can reconcile borrows of DEAD borrowers (reference:
+                # reference_counter.h borrower death cleanup)
+                "borrower": self.address,
+            }, timeout=10)
         except Exception:  # noqa: BLE001 — owner may be gone; borrow bookkeeping is moot
             pass
 
