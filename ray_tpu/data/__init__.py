@@ -17,6 +17,7 @@ from ray_tpu.data.datasource import (
     read_images,
     read_json,
     read_parquet,
+    read_sql,
 )
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "from_items",
     "from_numpy",
     "read_parquet",
+    "read_sql",
     "read_csv",
     "read_json",
     "read_binary_files",

@@ -188,25 +188,19 @@ def _ppo_update(params, opt_state, rng, batch, *, tx, clip, vf_coeff,
 # ---------------------------------------------------------------------------
 
 
-def _vtrace_loss(params, batch, *, gamma, rho_bar, c_bar, vf_coeff,
-                 entropy_coeff):
-    obs = batch["obs"]
-    next_obs = batch["next_obs"]
-    logits = policy_logits(params, obs)
-    logp_all = jax.nn.log_softmax(logits)
-    logp = jnp.take_along_axis(
-        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
-    )[:, 0]
-    v = value_fn(params, obs)
-    next_v = value_fn(params, next_obs)
+def _vtrace_targets(logp, v_src, next_v_src, batch, *, gamma, rho_bar,
+                    c_bar):
+    """Shared V-trace recursion: given behavior-corrected log-probs and
+    the (stop-gradiented) value source, return (vs, pg_adv, rho_sg). The
+    value source is the LIVE network for IMPALA and the lagging TARGET
+    network for APPO — everything else is identical and must stay so."""
     not_term = 1.0 - batch["terminated"]
     not_cut = 1.0 - batch["cut"]  # chain break: terminal OR truncation
     rho = jnp.minimum(jnp.exp(logp - batch["logp"]), rho_bar)
     c = jnp.minimum(rho, c_bar)
     rho_sg = jax.lax.stop_gradient(rho)
-    v_sg = jax.lax.stop_gradient(v)
-    next_v_sg = jax.lax.stop_gradient(next_v)
-    delta = rho_sg * (batch["rewards"] + gamma * next_v_sg * not_term - v_sg)
+    delta = rho_sg * (batch["rewards"] + gamma * next_v_src * not_term
+                      - v_src)
 
     def back(carry, x):
         d, c_t, disc = x
@@ -218,14 +212,31 @@ def _vtrace_loss(params, batch, *, gamma, rho_bar, c_bar, vf_coeff,
         (delta, jax.lax.stop_gradient(c), gamma * not_cut),
         reverse=True,
     )
-    vs = v_sg + vs_minus_v
+    vs = v_src + vs_minus_v
     # vs_{t+1}: next step's vs inside a chain; bootstrap value at a cut
     vs_next = jnp.where(
         not_cut.astype(bool),
-        jnp.concatenate([vs[1:], next_v_sg[-1:]]),
-        next_v_sg,
+        jnp.concatenate([vs[1:], next_v_src[-1:]]),
+        next_v_src,
     )
-    pg_adv = rho_sg * (batch["rewards"] + gamma * vs_next * not_term - v_sg)
+    pg_adv = rho_sg * (batch["rewards"] + gamma * vs_next * not_term
+                       - v_src)
+    return vs, pg_adv, rho_sg
+
+
+def _vtrace_loss(params, batch, *, gamma, rho_bar, c_bar, vf_coeff,
+                 entropy_coeff):
+    obs = batch["obs"]
+    logits = policy_logits(params, obs)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    v = value_fn(params, obs)
+    next_v = value_fn(params, batch["next_obs"])
+    vs, pg_adv, _rho = _vtrace_targets(
+        logp, jax.lax.stop_gradient(v), jax.lax.stop_gradient(next_v),
+        batch, gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
     pg_loss = -(pg_adv * logp).mean()
     vf_loss = 0.5 * ((v - vs) ** 2).mean()
     entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
@@ -254,7 +265,6 @@ def _appo_loss(params, target_params, batch, *, gamma, rho_bar, c_bar,
     that keeps clipping meaningful when fragments arrive asynchronously
     off-policy."""
     obs = batch["obs"]
-    next_obs = batch["next_obs"]
     logits = policy_logits(params, obs)
     logp_all = jax.nn.log_softmax(logits)
     logp = jnp.take_along_axis(
@@ -262,32 +272,11 @@ def _appo_loss(params, target_params, batch, *, gamma, rho_bar, c_bar,
     )[:, 0]
     v = value_fn(params, obs)
     tv = jax.lax.stop_gradient(value_fn(target_params, obs))
-    tnext_v = jax.lax.stop_gradient(value_fn(target_params, next_obs))
-    not_term = 1.0 - batch["terminated"]
-    not_cut = 1.0 - batch["cut"]
-    rho = jnp.minimum(jnp.exp(logp - batch["logp"]), rho_bar)
-    c = jnp.minimum(rho, c_bar)
-    rho_sg = jax.lax.stop_gradient(rho)
-    delta = rho_sg * (batch["rewards"] + gamma * tnext_v * not_term - tv)
-
-    def back(carry, x):
-        d, c_t, disc = x
-        carry = d + disc * c_t * carry
-        return carry, carry
-
-    _, vs_minus_v = jax.lax.scan(
-        back, 0.0,
-        (delta, jax.lax.stop_gradient(c), gamma * not_cut),
-        reverse=True,
-    )
-    vs = tv + vs_minus_v
-    vs_next = jnp.where(
-        not_cut.astype(bool),
-        jnp.concatenate([vs[1:], tnext_v[-1:]]),
-        tnext_v,
-    )
-    pg_adv = jax.lax.stop_gradient(
-        rho_sg * (batch["rewards"] + gamma * vs_next * not_term - tv))
+    tnext_v = jax.lax.stop_gradient(
+        value_fn(target_params, batch["next_obs"]))
+    vs, pg_adv, _rho = _vtrace_targets(
+        logp, tv, tnext_v, batch, gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
+    pg_adv = jax.lax.stop_gradient(pg_adv)
     ratio = jnp.exp(logp - batch["logp"])
     surr = jnp.minimum(
         ratio * pg_adv,
