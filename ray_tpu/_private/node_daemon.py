@@ -609,9 +609,13 @@ class NodeDaemon:
         os.makedirs(cache_root, exist_ok=True)
         python_exe = sys.executable
         pip = runtime_env.get("pip")
+        uv = runtime_env.get("uv")
         if pip:
             python_exe = await asyncio.to_thread(
                 ensure_venv, list(pip), cache_root)
+        elif uv:
+            python_exe = await asyncio.to_thread(
+                ensure_venv, list(uv), cache_root, "uv")
         cwd = None
         wd_uri = runtime_env.get("working_dir_uri")
         if wd_uri:

@@ -155,3 +155,103 @@ def test_working_dir_isolation_concurrent(ray_init, tmp_path):
     (ta, cwd_a), (tb, cwd_b) = ray_tpu.get([ra, rb], timeout=300)
     assert (ta, tb) == ("alpha", "beta")
     assert cwd_a != cwd_b
+
+
+def test_uv_env_builds_and_isolates(ray_init, tmp_path):
+    """`uv` runtime envs ride the same content-addressed venv machinery
+    through the uv resolver (reference: the uv runtime-env plugin)."""
+    import shutil
+
+    if shutil.which("uv") is None:
+        pytest.skip("no uv on this machine")
+    pkg = _make_pkg(tmp_path, 7)
+
+    @ray_tpu.remote
+    def probe():
+        import conflictlib
+
+        return conflictlib.VERSION
+
+    assert ray_tpu.get(
+        probe.options(runtime_env={"uv": [pkg]}).remote(), timeout=300) == 7
+    # pip and uv of the same package are DIFFERENT env keys (different
+    # resolvers must not share a venv cache entry)
+    from ray_tpu._private.runtime_env_mgr import env_isolation_key
+
+    assert env_isolation_key({"uv": [pkg]}) != env_isolation_key({"pip": [pkg]})
+    with pytest.raises(ValueError, match="not both"):
+        import asyncio as _aio
+
+        from ray_tpu._private.core_worker import get_core_worker
+        from ray_tpu._private.runtime_env_mgr import prepare_runtime_env
+
+        cw = get_core_worker()
+        cw.run_sync(prepare_runtime_env({"pip": [pkg], "uv": [pkg]}, cw))
+
+
+def test_custom_runtime_env_plugin(ray_init):
+    """A registered plugin's prepare/setup hooks run around user code."""
+    from ray_tpu.runtime_env import (RuntimeEnvPlugin,
+                                     register_runtime_env_plugin,
+                                     unregister_runtime_env_plugin)
+
+    class Banner(RuntimeEnvPlugin):
+        name = "banner"
+
+        async def prepare(self, value, runtime_env, cw):
+            return f"prepared:{value}"
+
+        async def setup(self, value, runtime_env, cw):
+            import os
+
+            os.environ["RT_TEST_BANNER"] = value
+
+    register_runtime_env_plugin(Banner())
+    try:
+        @ray_tpu.remote
+        def read_banner():
+            import os
+
+            return os.environ.get("RT_TEST_BANNER", "")
+
+        out = ray_tpu.get(
+            read_banner.options(
+                runtime_env={"banner": "hello"}).remote(), timeout=60)
+        assert out == "prepared:hello"
+    finally:
+        unregister_runtime_env_plugin("banner")
+
+
+def test_isolating_plugin_gets_dedicated_workers(ray_init):
+    """A plugin marked isolating=True pools workers per VALUE: two tasks
+    with different plugin values land in different processes."""
+    from ray_tpu.runtime_env import (RuntimeEnvPlugin,
+                                     register_runtime_env_plugin,
+                                     unregister_runtime_env_plugin)
+
+    class Flavor(RuntimeEnvPlugin):
+        name = "flavor"
+        isolating = True
+
+        async def setup(self, value, runtime_env, cw):
+            import os
+
+            # irreversible process state — the reason isolation exists
+            os.environ.setdefault("RT_TEST_FLAVOR", value)
+
+    register_runtime_env_plugin(Flavor())
+    try:
+        @ray_tpu.remote
+        def flavor_and_pid():
+            import os
+
+            return os.environ["RT_TEST_FLAVOR"], os.getpid()
+
+        (f1, p1), (f2, p2) = ray_tpu.get([
+            flavor_and_pid.options(runtime_env={"flavor": "sweet"}).remote(),
+            flavor_and_pid.options(runtime_env={"flavor": "salty"}).remote(),
+        ], timeout=120)
+        assert {f1, f2} == {"sweet", "salty"}, (f1, f2)
+        assert p1 != p2, "conflicting plugin values shared one process"
+    finally:
+        unregister_runtime_env_plugin("flavor")
