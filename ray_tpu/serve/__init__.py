@@ -98,9 +98,16 @@ def deployment(_target=None, *, name: Optional[str] = None,
 
 
 def run(dep: Deployment, *, wait_for_ready: bool = True,
-        timeout: float = 120.0) -> DeploymentHandle:
+        timeout: float = 120.0,
+        _local_testing_mode: bool = False):
     """Deploy (or redeploy) and return a routing handle (reference:
-    serve.run)."""
+    serve.run). `_local_testing_mode=True` constructs the callable
+    IN-PROCESS and returns a LocalHandle — deployment logic becomes unit-
+    testable without a cluster (reference: local_testing_mode.py)."""
+    if _local_testing_mode:
+        from ray_tpu.serve._local import run_local
+
+        return run_local(dep)
     import cloudpickle
 
     controller = get_or_create_controller()

@@ -25,6 +25,7 @@ from ray_tpu.tune._scheduler import (
     STOP,
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune._search import (
@@ -180,8 +181,9 @@ class Tuner:
 
         cfg = self._cfg
         scheduler = cfg.scheduler or FIFOScheduler()
-        if (isinstance(scheduler, (ASHAScheduler, PopulationBasedTraining))
-                and scheduler.metric is None):
+        # any scheduler exposing metric/mode inherits the TuneConfig's when
+        # unset (ASHA, PBT/PB2, MedianStopping, custom schedulers alike)
+        if getattr(scheduler, "metric", "absent") is None:
             scheduler.metric = cfg.metric
             scheduler.mode = cfg.mode
         variants = list(generate_variants(
@@ -303,6 +305,7 @@ class Tuner:
 __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
+    "MedianStoppingRule",
     "PB2",
     "PopulationBasedTraining",
     "get_checkpoint",

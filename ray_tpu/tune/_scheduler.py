@@ -76,6 +76,53 @@ class ASHAScheduler:
         return CONTINUE
 
 
+class MedianStoppingRule:
+    """Median stopping (reference: python/ray/tune/schedulers/
+    median_stopping_rule.py, from Vizier): after a grace period, stop a
+    trial whose best result so far is worse than the MEDIAN of the running
+    averages of every other trial at comparable time — cheap, threshold-
+    free early stopping for large sweeps."""
+
+    def __init__(self, metric: str = None, mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[tuple]] = {}  # tid -> [(t, signed v)]
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        sign = 1.0 if self.mode == "max" else -1.0
+        self._history.setdefault(trial_id, []).append(
+            (float(t), sign * float(value)))
+        if t < self.grace_period:
+            return CONTINUE
+        # compare at COMPARABLE time: other trials' running means over
+        # results up to THIS trial's progress — a late starter must be
+        # judged against what the cohort looked like at the same step,
+        # not against their fully-trained tails
+        import numpy as np
+
+        means = []
+        for tid, hist in self._history.items():
+            if tid == trial_id:
+                continue
+            upto = [v for (ht, v) in hist if ht <= t]
+            if upto:
+                means.append(float(np.mean(upto)))
+        if len(means) < self.min_samples:
+            return CONTINUE
+        median_of_means = float(np.median(means))
+        best = max(v for (_ht, v) in self._history[trial_id])
+        return STOP if best < median_of_means else CONTINUE
+
+
 class PopulationBasedTraining:
     """PBT (reference: python/ray/tune/schedulers/pbt.py): every
     perturbation_interval, trials in the bottom quantile EXPLOIT a top-

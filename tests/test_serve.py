@@ -584,3 +584,44 @@ def test_version_pinned_redeploy_rescales_in_place(ray_init):
     time.sleep(0.5)
     assert handle.remote().result(timeout=60) == "third"
     assert handle.method("incr").remote().result(timeout=60) == 1
+
+
+def test_local_testing_mode_no_cluster():
+    """Deployment logic runs in-process with the DeploymentHandle surface
+    — no controller, replicas, or cluster (reference:
+    serve/_private/local_testing_mode.py). NB: deliberately does NOT use
+    the ray_init fixture."""
+
+    @serve.deployment
+    class Calc:
+        def __init__(self, base=10):
+            self.base = base
+
+        def __call__(self, x):
+            return self.base + x
+
+        def double(self, x):
+            return 2 * x
+
+        def stream_to(self, n):
+            for i in range(n):
+                yield i
+
+        def boom(self):
+            raise ValueError("local boom")
+
+    h = serve.run(Calc.bind(base=100), _local_testing_mode=True)
+    assert h.remote(5).result() == 105
+    assert h.method("double").remote(21).result() == 42
+    items = [r.result() for r in
+             h.method("stream_to").options(stream=True).remote(3)]
+    assert items == [0, 1, 2]
+    with pytest.raises(ValueError, match="local boom"):
+        h.method("boom").remote().result()
+
+    @serve.deployment
+    def plain(x):
+        return x * 3
+
+    h2 = serve.run(plain.bind(), _local_testing_mode=True)
+    assert h2.remote(7).result() == 21

@@ -254,3 +254,26 @@ def test_pb2_scheduler_unit():
                                 "acc": step * lr})
     proposals = [pb2._explore({"lr": 0.1})["lr"] for _ in range(8)]
     assert sum(p > 0.5 for p in proposals) >= 6, proposals
+
+
+def test_median_stopping_rule_unit():
+    """MedianStoppingRule: a trial whose best result is below the median
+    of the other trials' running means stops after the grace period."""
+    from ray_tpu.tune._scheduler import CONTINUE, STOP, MedianStoppingRule
+
+    rule = MedianStoppingRule(metric="acc", mode="max", grace_period=2,
+                              min_samples_required=3)
+    # three healthy trials improving steadily
+    for step in range(1, 5):
+        for tid, slope in (("a", 1.0), ("b", 0.9), ("c", 0.8)):
+            assert rule.on_result(
+                tid, {"training_iteration": step, "acc": slope * step}
+            ) == CONTINUE
+    # a straggler far below the median: continues through grace, then stops
+    assert rule.on_result("d", {"training_iteration": 1, "acc": 0.01}) \
+        == CONTINUE
+    assert rule.on_result("d", {"training_iteration": 3, "acc": 0.02}) \
+        == STOP
+    # a strong newcomer is kept
+    assert rule.on_result("e", {"training_iteration": 3, "acc": 50.0}) \
+        == CONTINUE
