@@ -233,6 +233,8 @@ class Dataset:
         # (actor-pool stages split the chain)
         self._pre_stages: List[_Stage] = list(_pre_stages or [])
         self._refs = _refs  # cached materialized block refs
+        # global row cap from limit(); blocks are cut wherever they surface
+        self._row_limit: Optional[int] = None
 
     def _stages(self) -> List[_Stage]:
         stages = list(self._pre_stages)
@@ -244,9 +246,12 @@ class Dataset:
 
     def _chain(self, kind: str, fn: Callable) -> "Dataset":
         if self._refs is not None:
-            return Dataset(list(self._refs), [(kind, fn)])
-        return Dataset(list(self._producers), self._ops + [(kind, fn)],
-                       _pre_stages=self._pre_stages)
+            out = Dataset(list(self._refs), [(kind, fn)])
+        else:
+            out = Dataset(list(self._producers), self._ops + [(kind, fn)],
+                          _pre_stages=self._pre_stages)
+        out._row_limit = self._row_limit
+        return out
 
     def map_batches(self, fn: Any, *, concurrency: Optional[int] = None,
                     fn_constructor_args: tuple = (),
@@ -321,11 +326,29 @@ class Dataset:
         re-executes the plan (and re-creates actor pools). Call
         materialize() first to pin block refs for repeated reads — the
         aggregate/sort/shuffle paths do so internally via _block_refs."""
+        budget = self._row_limit
+
+        def cut(blocks):
+            nonlocal budget
+            for block in blocks:
+                if budget is None:
+                    yield block
+                    continue
+                if budget <= 0:
+                    return  # global limit reached: stop pulling upstream
+                rows = block_num_rows(block)
+                if rows > budget:
+                    yield Dataset._truncate_block(block, budget)
+                    budget = 0
+                    return
+                budget -= rows
+                yield block
+
         import ray_tpu
 
         if self._refs is not None:
-            for ref in self._refs:
-                yield ray_tpu.get(ref, timeout=600)
+            yield from cut(
+                ray_tpu.get(ref, timeout=600) for ref in self._refs)
             return
         if window is None:
             from ray_tpu.data.context import DataContext
@@ -336,7 +359,7 @@ class Dataset:
         ex = StreamingExecutorV2(
             self._producers, self._stages(), window=window)
         try:
-            yield from ex
+            yield from cut(ex)
         finally:
             self._last_stats = getattr(ex, "last_stats", None)
 
@@ -345,8 +368,31 @@ class Dataset:
         # (sum then mean then std; schema after count) must not re-execute
         # the whole plan per call
         refs = self.materialize()._refs
+        if self._row_limit is not None:
+            refs = self._cut_refs(refs, self._row_limit)
+            self._row_limit = None  # the cut is baked into the refs now
         self._refs = refs
         return refs
+
+    def _cut_refs(self, refs: List[Any], n: int) -> List[Any]:
+        """Global limit over materialized blocks: keep whole blocks up to
+        the boundary, slice the boundary block remotely, drop the rest."""
+        from ray_tpu.remote_function import RemoteFunction
+
+        counts = self._block_row_counts(refs)
+        out: List[Any] = []
+        remaining = n
+        cut = RemoteFunction(Dataset._truncate_block)
+        for ref, c in zip(refs, counts):
+            if remaining <= 0:
+                break
+            if c <= remaining:
+                out.append(ref)
+                remaining -= c
+            else:
+                out.append(cut.remote(ref, remaining))
+                remaining = 0
+        return out
 
     # -- consumption ----------------------------------------------------
 
@@ -360,6 +406,49 @@ class Dataset:
         return sum(
             block_num_rows(b) for b in ray_tpu.get(refs, timeout=600)
         )
+
+    def limit(self, n: int) -> "Dataset":
+        """Truncate to the first `n` rows (reference: Dataset.limit +
+        the logical optimizer's limit pushdown). Two halves: a per-block
+        cap PUSHES DOWN into the fused task chain (downstream ops in the
+        chain never see rows the limit would drop), and the GLOBAL cut is
+        enforced wherever blocks surface — _block_refs, iter_blocks,
+        take/count — via the propagated row-limit mark."""
+        if n < 0:
+            raise ValueError("limit must be >= 0")
+
+        def _truncate(block: Block) -> Block:
+            if isinstance(block, dict):
+                return {c: v[:n] for c, v in block.items()}
+            return list(block)[:n]
+
+        out = self._chain("map_batches", _truncate)
+        prev = getattr(self, "_row_limit", None)
+        out._row_limit = n if prev is None else min(prev, n)
+        return out
+
+    @staticmethod
+    def _truncate_block(block: Block, n: int) -> Block:
+        if isinstance(block, dict):
+            return {c: np.asarray(v)[:n] for c, v in block.items()}
+        return list(block)[:n]
+
+    def explain(self) -> str:
+        """Human-readable logical plan: the fused stage chain this dataset
+        executes (reference: the logical plan the data optimizer prints).
+        One "tasks[...]" stage = ONE fused remote task per block."""
+        lines = [f"Dataset({len(self._producers)} blocks"
+                 f"{', materialized' if self._refs is not None else ''})"]
+        for kind, *rest in self._stages():
+            if kind == "tasks":
+                ops = rest[0]
+                names = [op for op, _fn in ops] or ["read"]
+                lines.append(f"  tasks[fused: {' -> '.join(names)}]")
+            else:
+                _cls, _args, _kwargs, conc = rest
+                lines.append(f"  actors[{_cls.__name__}, "
+                             f"concurrency={conc}]")
+        return "\n".join(lines)
 
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []

@@ -239,3 +239,33 @@ def test_global_aggregates(ray_init):
     assert ds.max("id") == 99
     assert ds.mean("id") == pytest.approx(49.5)
     assert ds.std("id") == pytest.approx(np.std(np.arange(100), ddof=1))
+
+
+def test_limit_pushdown_and_explain(ray_init):
+    """limit(n) returns exactly the first n rows (global cut), the
+    per-block cap pushes down BEFORE later fused ops (they never see more
+    than n rows per block), and explain() renders the fused plan
+    (reference: the data logical optimizer's limit pushdown)."""
+    import ray_tpu.data as rtd
+
+    ds = rtd.range(1000, parallelism=4)
+
+    def check_and_double(b):
+        import numpy as np
+
+        ids = np.asarray(b["id"])
+        # the pushdown contract: this op runs AFTER the per-block cap, so
+        # a 250-row source block must arrive truncated
+        assert len(ids) <= 3, f"pushdown failed: saw {len(ids)} rows"
+        return {"id": ids, "twice": ids * 2}
+
+    limited = ds.limit(3).map_batches(check_and_double)
+    plan = limited.explain()
+    assert "fused" in plan and "map_batches -> map_batches" in plan, plan
+    rows = limited.take_all()
+    assert [r["id"] for r in rows] == [0, 1, 2]  # exactly n rows, in order
+    assert all(r["twice"] == 2 * r["id"] for r in rows)
+    assert limited.count() == 3
+    assert len(ds.limit(0).take_all()) == 0
+    # limits compose: the tighter one wins
+    assert ds.limit(10).limit(4).count() == 4
