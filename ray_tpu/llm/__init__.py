@@ -213,7 +213,15 @@ class LLMEngine:
                                            seed: Optional[int] = None):
         """Decode side of prefill/decode disaggregation: admit with KV
         block contents transferred from a remote PrefillWorker (reference:
-        serving_patterns/prefill_decode + vLLM KV transfer connectors)."""
+        serving_patterns/prefill_decode + vLLM KV transfer connectors).
+
+        `kv` may be the PrefillWorker's result dict (the ingress passes
+        the prefill task's REF, so the blocks move owner -> this engine
+        over the object plane directly — zero-copy shm when co-located —
+        without materializing in the ingress process) or a bare
+        (k, v, last_logits) tuple."""
+        if isinstance(kv, dict):
+            kv = (kv["k"], kv["v"], kv["last_logits"])
         if self._t0 is None:
             self._t0 = time.monotonic()
         gen = self.engine.generate_stream(

@@ -155,16 +155,21 @@ class PrefillDecodeIngress:
             payload.get("temperature", self.config.temperature))
         full_key = hashlib.blake2b(
             np.asarray(ids, np.int32).tobytes(), digest_size=8).hexdigest()
-        kv = self._pf_cache.get(full_key)
-        if kv is not None:
+        # the cache holds the prefill task's REF, never the blocks: the KV
+        # moves prefill-worker -> decode-engine over the object plane
+        # (zero-copy shm when co-located, chunked pull across nodes)
+        # without ever materializing in this ingress process — the r4
+        # review's "full KV through the host plane per request" hop is gone
+        kv_ref = self._pf_cache.get(full_key)
+        if kv_ref is not None:
             self._pf_cache.move_to_end(full_key)
             self.prefill_cache_hits += 1
         else:
             pf = self.prefill_workers[
                 self._pf_rr % len(self.prefill_workers)]
             self._pf_rr += 1
-            kv = await pf.prefill.remote(ids)
-            self._pf_cache[full_key] = kv
+            kv_ref = pf.prefill.remote(ids)
+            self._pf_cache[full_key] = kv_ref
             while len(self._pf_cache) > self._pf_cache_size:
                 self._pf_cache.popitem(last=False)
         i, _ = self.router.pick(ids)
@@ -172,11 +177,17 @@ class PrefillDecodeIngress:
             toks: List[int] = []
             gen = self.decoders[i].completions_stream_prefilled.options(
                 num_returns="streaming").remote(
-                ids, (kv["k"], kv["v"], kv["last_logits"]),
+                ids, kv_ref,
                 max_tokens=max_new, temperature=temperature,
                 seed=self.config.seed)
             async for ref in gen:
                 toks.append(await ref)
+        except Exception:
+            # a failed prefill ref must not poison the cache: retries of
+            # the SAME prompt would keep hitting the dead ref until 32
+            # other prompts evicted it
+            self._pf_cache.pop(full_key, None)
+            raise
         finally:
             self.router.done(i)
         return {

@@ -64,8 +64,13 @@ class LocalHandle:
     def method(self, name: str) -> "LocalHandle":
         return LocalHandle(self._instance, name, self._stream)
 
-    def options(self, *, stream: bool = False, **_ignored) -> "LocalHandle":
-        return LocalHandle(self._instance, self._method_name, stream)
+    def options(self, *, stream: Optional[bool] = None,
+                **_ignored) -> "LocalHandle":
+        # merge semantics like the real DeploymentHandle: unset fields keep
+        # the handle's current values across chained options() calls
+        return LocalHandle(
+            self._instance, self._method_name,
+            self._stream if stream is None else stream)
 
     def remote(self, *args, **kwargs):
         target = getattr(self._instance, self._method_name, None)

@@ -181,10 +181,12 @@ class Tuner:
 
         cfg = self._cfg
         scheduler = cfg.scheduler or FIFOScheduler()
-        # any scheduler exposing metric/mode inherits the TuneConfig's when
-        # unset (ASHA, PBT/PB2, MedianStopping, custom schedulers alike)
+        # any scheduler exposing metric/mode inherits the TuneConfig's for
+        # fields the user left UNSET — an explicitly-passed scheduler mode
+        # must never be clobbered by TuneConfig's default
         if getattr(scheduler, "metric", "absent") is None:
             scheduler.metric = cfg.metric
+        if getattr(scheduler, "mode", "absent") is None:
             scheduler.mode = cfg.mode
         variants = list(generate_variants(
             self._param_space, cfg.num_samples, seed=cfg.seed))

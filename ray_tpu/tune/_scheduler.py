@@ -27,7 +27,9 @@ class FIFOScheduler:
 class ASHAScheduler:
     """Asynchronous successive halving (reference: async_hyperband.py:65)."""
 
-    def __init__(self, metric: str = None, mode: str = "min",
+    _default_mode = "min"
+
+    def __init__(self, metric: str = None, mode: Optional[str] = None,
                  max_t: int = 100, grace_period: int = 1,
                  reduction_factor: float = 4,
                  time_attr: str = "training_iteration"):
@@ -56,7 +58,7 @@ class ASHAScheduler:
             return CONTINUE
         if t >= self.max_t:
             return STOP  # ran to completion
-        sign = 1.0 if self.mode == "min" else -1.0
+        sign = 1.0 if (self.mode or self._default_mode) == "min" else -1.0
         reached = self._reached.setdefault(trial_id, set())
         for m in self.milestones:
             if t >= m and m not in reached:
@@ -83,7 +85,9 @@ class MedianStoppingRule:
     averages of every other trial at comparable time — cheap, threshold-
     free early stopping for large sweeps."""
 
-    def __init__(self, metric: str = None, mode: str = "min",
+    _default_mode = "min"
+
+    def __init__(self, metric: str = None, mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  grace_period: int = 1, min_samples_required: int = 3):
         self.metric = metric
@@ -98,7 +102,7 @@ class MedianStoppingRule:
         value = metrics.get(self.metric)
         if t is None or value is None:
             return CONTINUE
-        sign = 1.0 if self.mode == "max" else -1.0
+        sign = 1.0 if (self.mode or self._default_mode) == "max" else -1.0
         self._history.setdefault(trial_id, []).append(
             (float(t), sign * float(value)))
         if t < self.grace_period:
@@ -130,7 +134,9 @@ class PopulationBasedTraining:
     hyperparameters: continuous ranges scale by 0.8/1.2, categorical lists
     resample), continuing training from the donor's state."""
 
-    def __init__(self, metric: str = None, mode: str = "max",
+    _default_mode = "max"
+
+    def __init__(self, metric: str = None, mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  perturbation_interval: int = 4,
                  hyperparam_mutations: Optional[Dict[str, Any]] = None,
@@ -183,7 +189,7 @@ class PopulationBasedTraining:
     def _quantiles(self):
         if len(self._scores) < 2:
             return [], []
-        sign = 1.0 if self.mode == "max" else -1.0
+        sign = 1.0 if (self.mode or self._default_mode) == "max" else -1.0
         ranked = sorted(self._scores, key=lambda tid: sign * self._scores[tid])
         n = max(1, int(len(ranked) * self.quantile))
         return ranked[:n], ranked[-n:]
@@ -224,7 +230,7 @@ class PB2(PopulationBasedTraining):
     (low, high). The GP is exact (RBF kernel) over the bounded history the
     schedule produces — population x intervals points, trivially small."""
 
-    def __init__(self, metric: str = None, mode: str = "max",
+    def __init__(self, metric: str = None, mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  perturbation_interval: int = 4,
                  hyperparam_bounds: Optional[Dict[str, tuple]] = None,
@@ -261,7 +267,8 @@ class PB2(PopulationBasedTraining):
             self._max_t_seen = max(self._max_t_seen, float(t))
             prev = self._prev_score.get(trial_id)
             if prev is not None:
-                sign = 1.0 if self.mode == "max" else -1.0
+                sign = 1.0 if (self.mode or self._default_mode) == "max" \
+                    else -1.0
                 self._data.append(
                     (float(t), self._configs.get(trial_id, {}),
                      sign * (float(value) - prev)))
