@@ -235,34 +235,85 @@ def bench_collective_allreduce(ray_tpu, mb: int, reps: int = 4):
             "unit": "MB/s"}
 
 
+def run_suite(ray_tpu, scale: int, results: list):
+    results.append(bench_tasks_sync(ray_tpu, 100 * scale))
+    results.append(bench_tasks_async(ray_tpu, 200 * scale))
+    results.append(bench_actor_calls_sync(ray_tpu, 200 * scale))
+    results.append(bench_actor_calls_async(ray_tpu, 400 * scale))
+    results.append(bench_put_small(ray_tpu, 200 * scale))
+    results.extend(bench_put_get_gigabytes(ray_tpu, 40 * scale))
+    results.append(bench_task_arg_passthrough(ray_tpu, 16))
+    results.append(bench_collective_allreduce(ray_tpu, 8 * scale, reps=6))
+    # full mode probes the release/benchmarks envelope: 10k-arg task,
+    # then 100k queued with bounded driver memory (reference:
+    # release/benchmarks/README.md:27-33). args before depth: the 100k
+    # run leaves warm state that skews the arg probe
+    results.append(bench_many_args(ray_tpu, 2000 * scale))
+    results.append(bench_queued_task_depth(ray_tpu, 20000 * scale))
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument(
+        "--fastpath", choices=["on", "off", "both"], default=None,
+        help="A/B the native control-plane fast path: 'on'/'off' pin the "
+        "native_fastpath flag for one run; 'both' runs the core task "
+        "benches once per mode — each in a FRESH subprocess so neither "
+        "allocator/RSS state nor warm pools leak across the comparison — "
+        "and emits one JSON line per bench per mode, tagged with a "
+        "'fastpath' column.")
+    parser.add_argument(
+        "--core-only", action="store_true",
+        help="only the task/actor throughput + queue-depth benches "
+        "(the probes the fast path targets)")
     args = parser.parse_args()
+
+    if args.fastpath == "both":
+        import os
+        import subprocess
+        import sys
+
+        for mode in ("off", "on"):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--fastpath", mode, "--core-only"]
+            if args.quick:
+                cmd.append("--quick")
+            proc = subprocess.run(cmd, text=True, capture_output=True)
+            sys.stdout.write(proc.stdout)
+            if proc.returncode != 0:
+                sys.stderr.write(proc.stderr[-2000:])
+                sys.exit(proc.returncode)
+        return
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=4)
     scale = 1 if args.quick else 5
     results = []
+    system_config = {}
+    if args.fastpath is not None:
+        system_config["native_fastpath"] = args.fastpath == "on"
+    ray_tpu.init(num_cpus=4, system_config=system_config)
+    if args.fastpath is not None:
+        from ray_tpu._private import fastpath as _fp
+
+        print(json.dumps({
+            "bench": "fastpath_mode", "value": args.fastpath,
+            "unit": "flag", "extension_loaded": _fp.enabled(),
+        }))
     try:
-        results.append(bench_tasks_sync(ray_tpu, 100 * scale))
-        results.append(bench_tasks_async(ray_tpu, 200 * scale))
-        results.append(bench_actor_calls_sync(ray_tpu, 200 * scale))
-        results.append(bench_actor_calls_async(ray_tpu, 400 * scale))
-        results.append(bench_put_small(ray_tpu, 200 * scale))
-        results.extend(bench_put_get_gigabytes(ray_tpu, 40 * scale))
-        results.append(bench_task_arg_passthrough(ray_tpu, 16))
-        results.append(bench_collective_allreduce(ray_tpu, 8 * scale,
-                                                  reps=6))
-        # full mode probes the release/benchmarks envelope: 10k-arg task,
-        # then 100k queued with bounded driver memory (reference:
-        # release/benchmarks/README.md:27-33). args before depth: the 100k
-        # run leaves warm state that skews the arg probe
-        results.append(bench_many_args(ray_tpu, 2000 * scale))
-        results.append(bench_queued_task_depth(ray_tpu, 20000 * scale))
+        if args.core_only:
+            results.append(bench_tasks_sync(ray_tpu, 100 * scale))
+            results.append(bench_tasks_async(ray_tpu, 200 * scale))
+            results.append(bench_actor_calls_async(ray_tpu, 400 * scale))
+            results.append(bench_queued_task_depth(ray_tpu, 20000 * scale))
+        else:
+            run_suite(ray_tpu, scale, results)
     finally:
+        tag = args.fastpath
         for r in results:
+            if tag is not None:
+                r["fastpath"] = tag
             print(json.dumps(r))
         ray_tpu.shutdown()
 

@@ -179,6 +179,8 @@ _flag("lease_pool_max_idle", 16, "Max granted-but-idle leases cached per schedul
 _flag("push_batch_max", 64, "Max task specs coalesced into one push_task_batch RPC to a leased worker (reference: normal_task_submitter.h:226 pipelined PushNormalTask — amortizes per-RPC framing and event-loop wakeups across queued same-shaped tasks).")
 _flag("push_feeders_per_key", 16, "Max concurrent lease-holding batch feeders per scheduling key; each feeder drains the key's ready queue onto one leased worker at a time.")
 _flag("device_object_transport", True, "Keep jax.Arrays HBM-resident through the object plane: same-process consumers get the original device array back (no h2d), others rebuild from host-staged bytes (reference: python/ray/experimental/rdt).")
+_flag("native_fastpath", True, "Use the C++ submission/completion engine (native/fastpath.cc: templated spec encoding, lock-free submission ring, batched frame build + reply splitting) on the control-plane hot path (reference: the _raylet.pyx submit_task seam). Falls back to the pure-Python path when the build fails or no compiler exists — set 0 to force the fallback.")
+_flag("fastpath_ring_slots", 65536, "Capacity of each lock-free submission ring (one ring per scheduling key); a full ring overflows gracefully onto the Python queue.")
 
 # --- chaos / fault injection (day 1, per SURVEY §4) ---
 _flag("testing_event_loop_delay_us", "", "Inject delays into event-loop handlers. Format: 'method:min_us:max_us,...' ('*' matches all). Mirrors RAY_testing_asio_delay_us.")

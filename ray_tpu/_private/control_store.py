@@ -203,6 +203,15 @@ class ControlStore:
         # snapshots (reference: GcsTaskManager, metrics agent)
         self.task_events: "collections.deque[dict]" = collections.deque()
         self.metrics_by_worker: Dict[bytes, dict] = {}
+        # worker-process liveness records (reference: the GCS workers table
+        # + worker-failure pubsub): live worker/driver RPC addresses with
+        # their host node, plus a bounded set of authoritatively-dead
+        # addresses. Borrow reapers consult these instead of trusting ping
+        # timeouts (a stalled-but-alive borrower must keep its borrows).
+        self.worker_addresses: Dict[str, str] = {}  # address -> node_id hex
+        self.worker_addr_by_id: Dict[bytes, str] = {}
+        self.dead_worker_addresses: "collections.OrderedDict[str, float]" = (
+            collections.OrderedDict())
         # per-node scheduling load from heartbeats (autoscaler demand)
         self.node_load: Dict[bytes, dict] = {}
         # per-node physical stats from heartbeats (dashboard reporter)
@@ -401,6 +410,13 @@ class ControlStore:
         if client:
             await client.close()
         logger.warning("node %s marked DEAD: %s", info.node_id.hex()[:8], reason)
+        # every worker/driver process registered on the node died with it:
+        # record their addresses so borrow reapers can reconcile
+        node_hex = info.node_id.hex()
+        for addr, nhex in list(self.worker_addresses.items()):
+            if nhex == node_hex:
+                self.worker_addresses.pop(addr, None)
+                self._mark_worker_dead(addr)
         self._event("node", "DEAD", reason, node_id=info.node_id.hex())
         self._persist("node", info.to_wire())
         self.pubsub.publish("nodes", info.to_wire())
@@ -591,6 +607,75 @@ class ControlStore:
         return {"ok": True}
 
     # ------------------------------------------------------------------
+    # worker liveness records (reference: the GCS workers table + worker-
+    # failure pubsub — reference_counter's borrower cleanup keys off these
+    # authoritative notices, never off ping timeouts)
+    # ------------------------------------------------------------------
+
+    def _mark_worker_dead(self, address: str):
+        self.dead_worker_addresses[address] = time.time()
+        self.dead_worker_addresses.move_to_end(address)
+        while len(self.dead_worker_addresses) > 65536:
+            self.dead_worker_addresses.popitem(last=False)
+        # drop the id index entries too (node-death and job-finish paths
+        # bypass rpc_report_worker_death's by-id pop): the control store
+        # must not grow a stale entry per worker/driver forever
+        stale = [wid for wid, addr in self.worker_addr_by_id.items()
+                 if addr == address]
+        for wid in stale:
+            self.worker_addr_by_id.pop(wid, None)
+
+    async def rpc_register_worker(self, conn_id: int, payload: dict) -> dict:
+        """Every core worker (driver or worker) announces its RPC address
+        and host node at startup."""
+        addr = payload.get("address", "")
+        if addr:
+            self.worker_addresses[addr] = payload.get("node_id", "")
+            # a recycled address re-registering proves the process slot is
+            # live again; clear any stale death record
+            self.dead_worker_addresses.pop(addr, None)
+            wid = payload.get("worker_id")
+            if wid:
+                self.worker_addr_by_id[wid] = addr
+            job = self.jobs.get(payload.get("job_id", b""))
+            if job is not None and payload.get("mode") == "driver":
+                # add_job ran before the driver's RPC server existed; fill
+                # the address in so finish_job can record the driver's death
+                job["driver_address"] = addr
+        return {"ok": True}
+
+    async def rpc_report_worker_death(self, conn_id: int, payload: dict) -> dict:
+        """A node daemon observed one of its worker processes exit."""
+        addr = payload.get("address") or self.worker_addr_by_id.pop(
+            payload.get("worker_id", b""), None)
+        if addr:
+            self.worker_addresses.pop(addr, None)
+            self._mark_worker_dead(addr)
+        return {"ok": True}
+
+    async def rpc_check_worker_liveness(self, conn_id: int, payload: dict) -> dict:
+        """Authoritative death lookup for a worker/driver RPC address:
+        dead=True only when the process's exit (or its node's death) was
+        actually recorded — an unreachable-but-undeclared address stays
+        not-dead (the caller must keep waiting, not free)."""
+        addr = payload["address"]
+        if addr in self.dead_worker_addresses:
+            return {"known": True, "dead": True}
+        node_hex = self.worker_addresses.get(addr)
+        if node_hex is not None:
+            if node_hex:
+                try:
+                    info = self.nodes.get(bytes.fromhex(node_hex))
+                except ValueError:
+                    info = None
+                if info is not None and info.state == pb.NODE_DEAD:
+                    self.worker_addresses.pop(addr, None)
+                    self._mark_worker_dead(addr)
+                    return {"known": True, "dead": True}
+            return {"known": True, "dead": False}
+        return {"known": False, "dead": False}
+
+    # ------------------------------------------------------------------
     # KV service (reference: gcs_service.proto InternalKV :633)
     # ------------------------------------------------------------------
 
@@ -701,6 +786,12 @@ class ControlStore:
                         else str(payload["job_id"]))
             self._persist("job", {"job": job})
             self.pubsub.publish("jobs", job)
+            # the driver process is going away with its job: record its
+            # address so owners can reconcile borrows it still held
+            drv = job.get("driver_address")
+            if drv:
+                self.worker_addresses.pop(drv, None)
+                self._mark_worker_dead(drv)
             # Kill detached-from-driver resources: actors owned by the job.
             for rec in list(self.actors.values()):
                 if (

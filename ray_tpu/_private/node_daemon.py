@@ -644,7 +644,18 @@ class NodeDaemon:
         except (ProcessLookupError, PermissionError):
             pass
         self._forget_worker(w)
+        # intentional kills must reach the death records too: owners' borrow
+        # reapers free this worker's borrows only on an authoritative notice
+        spawn(self._report_worker_death_quiet(w))
         logger.info("killed worker %s: %s", w.worker_id.hex()[:8], reason)
+
+    async def _report_worker_death_quiet(self, w: WorkerHandle):
+        try:
+            await self.control.call(
+                "report_worker_death",
+                {"worker_id": w.worker_id.binary()}, timeout=10)
+        except Exception:  # noqa: BLE001 — control store may be restarting
+            logger.debug("report_worker_death failed", exc_info=True)
 
     def _forget_worker(self, w: WorkerHandle):
         self.workers.pop(w.worker_id.binary(), None)
@@ -685,6 +696,9 @@ class NodeDaemon:
         if w.lease_id is not None:
             self._release_lease(w.lease_id)
         self._release_actor_resources(w)
+        # authoritative death record: owners' borrow reapers free this
+        # worker's borrows only once the exit is recorded here
+        await self._report_worker_death_quiet(w)
         if w.actor_id is not None:
             try:
                 await self.control.call(

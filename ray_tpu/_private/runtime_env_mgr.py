@@ -37,6 +37,15 @@ KV_NS = "runtime_env"
 # fields whose values require a dedicated worker process
 _ISOLATING_FIELDS = ("pip", "uv", "working_dir_uri", "plugin_iso")
 
+# every field the framework itself understands: user-facing inputs plus the
+# wire-form fields prepare_runtime_env generates (a prepared env may be
+# passed back in, e.g. an actor restart re-preparing its creation spec)
+_BUILTIN_FIELDS = frozenset({
+    "pip", "uv", "working_dir", "py_modules", "env_vars",
+    "working_dir_uri", "py_module_uris", "env_key", "namespace",
+    "detached", "plugin_iso", "_plugins",
+})
+
 
 # ---------------------------------------------------------------------------
 # plugin architecture (reference: _private/runtime_env/ARCHITECTURE.md —
@@ -211,9 +220,21 @@ _REMOTE_WD_CACHE: Dict[str, str] = {}
 async def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
                               cw) -> Optional[Dict[str, Any]]:
     """Driver side: upload local dirs as content-addressed zips; return the
-    wire form ({..._uri} instead of local paths)."""
+    wire form ({..._uri} instead of local paths). Unknown fields — matching
+    neither a builtin nor a registered plugin — fail the submission here
+    with a clear error instead of being silently dropped (a typo'd 'pipp'
+    must not no-op; reference: the runtime-env plugin manager rejects
+    unknown fields the same way)."""
     if not runtime_env:
         return runtime_env
+    unknown = [k for k in runtime_env
+               if k not in _BUILTIN_FIELDS and k not in _PLUGINS]
+    if unknown:
+        known = sorted(k for k in _BUILTIN_FIELDS if not k.startswith("_"))
+        raise ValueError(
+            f"unknown runtime_env field(s) {sorted(unknown)!r}: each field "
+            f"must be a builtin ({', '.join(known)}) or a registered "
+            "runtime-env plugin (register_runtime_env_plugin)")
     out = dict(runtime_env)
 
     async def upload(path: str) -> str:
@@ -347,6 +368,11 @@ async def setup_runtime_env(runtime_env: Optional[Dict[str, Any]], cw,
         if plugin is None:
             import cloudpickle
 
+            # Trust note: the plugin object ships BY VALUE from the driver
+            # and is unpickled+executed here during worker bootstrap. That
+            # matches the trust model of task shipping (drivers already run
+            # arbitrary code on workers via cloudpickled functions), but it
+            # does widen what runs before any user task starts.
             plugin = cloudpickle.loads(blob)
         await plugin.setup(runtime_env.get(name), runtime_env, cw)
     cache_root = os.path.join(

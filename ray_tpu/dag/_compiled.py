@@ -291,8 +291,22 @@ def _actor_loop(instance, plan: _ActorPlan):
         from ray_tpu._private.core_worker import get_core_worker
 
         cw = get_core_worker()
+        # Teardown order matters (ADVICE r5 #3): close each read ring FIRST
+        # so an in-flight rpc_chan_write blocked on a full ring fails fast,
+        # then unregister under the per-edge lock (no writer still holds the
+        # chan), and only THEN release the pin — never unpin shm a racing
+        # writer could still memcpy into.
+        for ch in in_chans.values():
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001 — store already torn down
+                pass
         for e, ch in in_chans.items():
-            cw.unregister_dag_channel(plan.dag_id, e)
+            try:
+                cw.run_sync(
+                    cw.quiesce_dag_channel(plan.dag_id, e), timeout=30)
+            except Exception:  # noqa: BLE001 — never leak the registration
+                cw.unregister_dag_channel(plan.dag_id, e)
             ch.unpin()
         for ch in out_chans.values():
             ch.unpin()
@@ -681,8 +695,20 @@ class CompiledDAG:
             from ray_tpu._private.core_worker import get_core_worker
 
             cw = get_core_worker()
+            # same close → quiesce-unregister → unpin order as the executor
+            # loops (see _actor_loop): an in-flight rpc_chan_write must fail
+            # fast and drain before the ring's pin drops
+            for ch in self._channels.values():
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001 — store already torn down
+                    pass
             for e, ch in self._channels.items():
-                cw.unregister_dag_channel(self.dag_id, e)
+                try:
+                    cw.run_sync(
+                        cw.quiesce_dag_channel(self.dag_id, e), timeout=30)
+                except Exception:  # noqa: BLE001 — never leak the registration
+                    cw.unregister_dag_channel(self.dag_id, e)
                 ch.unpin()
             for ch in self._entry_writers.values():
                 ch.unpin()

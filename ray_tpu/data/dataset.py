@@ -235,6 +235,11 @@ class Dataset:
         self._refs = _refs  # cached materialized block refs
         # global row cap from limit(); blocks are cut wherever they surface
         self._row_limit: Optional[int] = None
+        # limit FENCE: when a row-count-changing op is chained after
+        # limit(), this dataset's ops apply to the PARENT's stream-order-cut
+        # output (never to rows past the global budget) instead of fusing
+        # into the per-block chain — see _chain
+        self._limit_src: Optional["Dataset"] = None
 
     def _stages(self) -> List[_Stage]:
         stages = list(self._pre_stages)
@@ -245,11 +250,23 @@ class Dataset:
     # -- transforms (lazy) ---------------------------------------------
 
     def _chain(self, kind: str, fn: Callable) -> "Dataset":
+        if self._row_limit is not None and kind in (
+                "filter", "flat_map", "map_batches"):
+            # A row-count-changing op chained after limit(): the per-block
+            # cap + surface cut would let this op see rows past the global
+            # budget (and keep post-limit rows the cut can't tell apart).
+            # Fence the plan: the parent's stream-order cut runs first, and
+            # this op applies only to the capped stream. ("map" is 1:1, so
+            # it keeps riding the fused chain + surface cut.)
+            out = Dataset([], [(kind, fn)])
+            out._limit_src = self
+            return out
         if self._refs is not None:
             out = Dataset(list(self._refs), [(kind, fn)])
         else:
             out = Dataset(list(self._producers), self._ops + [(kind, fn)],
                           _pre_stages=self._pre_stages)
+            out._limit_src = self._limit_src
         out._row_limit = self._row_limit
         return out
 
@@ -266,6 +283,12 @@ class Dataset:
         AUTOSCALING in the streaming executor (reference:
         actor_pool_map_operator.py + actor_autoscaler)."""
         if concurrency is not None or isinstance(fn, type):
+            if self._refs is None and (
+                    self._limit_src is not None
+                    or self._row_limit is not None):
+                # actor stages can change row counts too: bake the
+                # stream-order cut before the pool sees any block
+                self._block_refs()
             base = self._refs if self._refs is not None else self._producers
             pre = [] if self._refs is not None else self._pre_stages
             ops = [] if self._refs is not None else self._ops
@@ -300,6 +323,17 @@ class Dataset:
         ObjectRefs (repeat consumption is free)."""
         if self._refs is not None:
             return self
+        if self._limit_src is not None:
+            # limit fence: bake the parent's stream-order cut into refs,
+            # then run this dataset's post-limit ops over those (≤ n rows).
+            # A limit chained AFTER the fence must propagate so its global
+            # cut bakes too (_block_refs applies it), not just the fused
+            # per-block cap.
+            base = self._limit_src._block_refs()
+            mid = Dataset(list(base), list(self._ops))
+            mid._row_limit = self._row_limit
+            refs = mid._block_refs()
+            return Dataset(refs, [], _refs=refs)
         import ray_tpu
         from ray_tpu._private.core_worker import ObjectRef
 
@@ -350,6 +384,14 @@ class Dataset:
             yield from cut(
                 ray_tpu.get(ref, timeout=600) for ref in self._refs)
             return
+        if self._limit_src is not None:
+            # limit fence: the parent applies its own stream-order cut (and
+            # stops pulling upstream once the budget is spent); this
+            # dataset's ops only ever see rows within the global limit
+            yield from cut(
+                _apply_ops(block, self._ops)
+                for block in self._limit_src.iter_blocks(window=window))
+            return
         if window is None:
             from ray_tpu.data.context import DataContext
 
@@ -397,6 +439,8 @@ class Dataset:
     # -- consumption ----------------------------------------------------
 
     def num_blocks(self) -> int:
+        if self._limit_src is not None and self._refs is None:
+            return self._limit_src.num_blocks()
         return len(self._producers)
 
     def count(self) -> int:
@@ -410,10 +454,12 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         """Truncate to the first `n` rows (reference: Dataset.limit +
         the logical optimizer's limit pushdown). Two halves: a per-block
-        cap PUSHES DOWN into the fused task chain (downstream ops in the
-        chain never see rows the limit would drop), and the GLOBAL cut is
-        enforced wherever blocks surface — _block_refs, iter_blocks,
-        take/count — via the propagated row-limit mark."""
+        cap PUSHES DOWN into the fused task chain, and the GLOBAL cut is
+        enforced in stream order wherever blocks surface — _block_refs,
+        iter_blocks, take/count — via the propagated row-limit mark.
+        Chaining a row-count-changing op (filter/flat_map/map_batches)
+        after limit() fences the plan at the limit (see _chain), so such
+        ops never observe rows beyond the global budget."""
         if n < 0:
             raise ValueError("limit must be >= 0")
 
@@ -436,9 +482,16 @@ class Dataset:
     def explain(self) -> str:
         """Human-readable logical plan: the fused stage chain this dataset
         executes (reference: the logical plan the data optimizer prints).
-        One "tasks[...]" stage = ONE fused remote task per block."""
-        lines = [f"Dataset({len(self._producers)} blocks"
-                 f"{', materialized' if self._refs is not None else ''})"]
+        One "tasks[...]" stage = ONE fused remote task per block; a
+        "limit[...]" line marks a stream-order fence (ops below it only see
+        rows within the global budget)."""
+        if self._limit_src is not None and self._refs is None:
+            lines = self._limit_src.explain().splitlines()
+            lines.append("  limit[stream-order fence: "
+                         f"{self._limit_src._row_limit} rows]")
+        else:
+            lines = [f"Dataset({len(self._producers)} blocks"
+                     f"{', materialized' if self._refs is not None else ''})"]
         for kind, *rest in self._stages():
             if kind == "tasks":
                 ops = rest[0]
@@ -705,6 +758,9 @@ class Dataset:
         def items(ds: "Dataset") -> List[Any]:
             if ds._refs is not None:
                 return list(ds._refs)
+            if ds._limit_src is not None or ds._row_limit is not None:
+                # limit semantics can't ride a fused closure: bake the cut
+                return list(ds._block_refs())
             stages = ds._stages()
             if stages == [("tasks", [])]:
                 return list(ds._producers)

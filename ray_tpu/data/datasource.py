@@ -177,6 +177,37 @@ def _read_image_chunk(files: List[str], size, mode: str):
     return [{"image": a} for a in arrays]
 
 
+def _validate_sql_identifier(name: str) -> str:
+    """Quote `partition_column` as a SQL identifier. Only plain identifiers
+    (letters/digits/underscore, possibly dotted) are accepted — the column
+    name is spliced into the query text, so anything else is rejected
+    rather than passed through."""
+    import re
+
+    if not isinstance(name, str) or not re.fullmatch(
+            r"[A-Za-z_][A-Za-z0-9_]*(\.[A-Za-z_][A-Za-z0-9_]*)?", name):
+        raise ValueError(
+            f"partition_column {name!r} is not a plain SQL identifier "
+            "(letters, digits, underscores, optional single dot)")
+    # standard SQL double-quoting; the dotted form quotes each part
+    return ".".join('"%s"' % part for part in name.split("."))
+
+
+def _validate_sql_bound(value, which: str) -> float:
+    """Range bounds must be real numbers: they are spliced as numeric
+    literals (paramstyle varies across DB-API drivers), and range
+    partitioning itself is numeric-only."""
+    import numbers
+
+    if isinstance(value, bool) or not isinstance(value, numbers.Real):
+        raise TypeError(
+            f"read_sql {which} must be a real number for numeric range "
+            f"partitioning, got {type(value).__name__}: {value!r}. "
+            "String/timestamp/date partition columns are not supported — "
+            "partition on a numeric key (e.g. an integer id) instead.")
+    return float(value)
+
+
 def read_sql(sql: str, connection_factory, *, parallelism: int = 1,
              partition_column: Optional[str] = None,
              lower_bound=None, upper_bound=None) -> Dataset:
@@ -188,9 +219,17 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = 1,
     INSIDE the read tasks, so the connection never pickles. With
     `partition_column` + bounds, `parallelism` tasks each read one range
     slice of the query (the standard JDBC-style range split); otherwise one
-    task reads the whole result."""
+    task reads the whole result.
+
+    Range partitioning is NUMERIC-ONLY: `partition_column` must hold real
+    numbers and `lower_bound`/`upper_bound` must be numbers (they become
+    numeric literals in the generated predicates). The column name must be
+    a plain identifier; it is validated and quoted before being spliced
+    into the query."""
     if parallelism > 1 and partition_column is None:
         raise ValueError("parallel read_sql needs partition_column + bounds")
+    if partition_column is not None:
+        partition_column = _validate_sql_identifier(partition_column)
 
     def _read_range(lo, hi):
         conn = connection_factory()
@@ -224,6 +263,12 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = 1,
         return Dataset([functools.partial(_read_range, None, None)])
     if lower_bound is None or upper_bound is None:
         raise ValueError("parallel read_sql needs lower_bound/upper_bound")
+    lower_bound = _validate_sql_bound(lower_bound, "lower_bound")
+    upper_bound = _validate_sql_bound(upper_bound, "upper_bound")
+    if upper_bound < lower_bound:
+        raise ValueError(
+            f"read_sql upper_bound ({upper_bound}) must be >= lower_bound "
+            f"({lower_bound})")
     span = (float(upper_bound) - float(lower_bound)) / parallelism
     producers = []
     for i in builtins.range(parallelism):

@@ -161,6 +161,10 @@ class ShmChannel:
                 f"payload of {n} bytes exceeds channel slot size "
                 f"{self.slot_size}")
         off = self._lib.rt_chan_reserve(self._base)
+        if off == -3:
+            # ring closed (reader tore down, or writer hang-up): writes must
+            # fail fast instead of blocking into freed/teardown state
+            raise EOFError("channel closed")
         if off < 0:
             return False
         dst = self._chan_off + off
@@ -218,6 +222,8 @@ class ShmChannel:
             if off >= 0:
                 dst = self._chan_off + off
                 return self._store._mv[dst:dst + nbytes]
+            if off == -3:
+                raise EOFError("channel closed")
             if not self._wait(self._lib.rt_chan_wait_writable, deadline):
                 raise TimeoutError("channel full (consumer stalled?)")
 

@@ -19,6 +19,7 @@ _LOCK = threading.Lock()
 _LIBS = {
     "shm_store": ["shm_store.cc"],
     "shm_channel": ["shm_channel.cc"],
+    "fastpath": ["fastpath.cc"],
 }
 
 

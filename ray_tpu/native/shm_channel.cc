@@ -123,9 +123,12 @@ int rt_chan_validate(void* base) {
 }
 
 // Writer side. rt_chan_reserve returns the offset (from base) of the slot
-// payload to write into, or -1 if the ring is full (backpressure).
+// payload to write into, -1 if the ring is full (backpressure), or -3 if
+// the ring is closed (either end hung up — writes must fail fast, e.g. a
+// teardown-racing rpc_chan_write against a reader that already closed).
 int64_t rt_chan_reserve(void* base) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
+  if (h->closed.load(std::memory_order_acquire)) return -3;
   uint64_t w = h->write_seq.load(std::memory_order_relaxed);
   uint64_t r = h->read_seq.load(std::memory_order_acquire);
   if (w - r >= h->nslots) return -1;  // full
@@ -174,9 +177,13 @@ int rt_chan_release(void* base) {
 void rt_chan_close(void* base) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
   h->closed.store(1, std::memory_order_release);
-  // close must reach parked readers even with no payload in flight
+  // close must reach parked readers even with no payload in flight, AND
+  // parked writers (a reader closing a full ring at teardown must fail
+  // blocked producers fast, not strand them until timeout)
   h->write_ding.fetch_add(1, std::memory_order_release);
   futex_wake_all(&h->write_ding);
+  h->read_ding.fetch_add(1, std::memory_order_release);
+  futex_wake_all(&h->read_ding);
 }
 
 // Park until the ring is (probably) readable: data available or closed.
@@ -204,6 +211,7 @@ int rt_chan_wait_readable(void* base, int64_t timeout_us) {
 int rt_chan_wait_writable(void* base, int64_t timeout_us) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
   uint32_t ding = h->read_ding.load(std::memory_order_acquire);
+  if (h->closed.load(std::memory_order_acquire)) return 0;  // fail fast
   uint64_t w = h->write_seq.load(std::memory_order_relaxed);
   if (w - h->read_seq.load(std::memory_order_acquire) < h->nslots) return 0;
   h->write_waiters.fetch_add(1, std::memory_order_acq_rel);

@@ -11,6 +11,7 @@ aliases store memory with no copies and no server round-trip.
 from __future__ import annotations
 
 import ctypes
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -91,6 +92,11 @@ class ShmObjectStore:
 
             allow_evict = GLOBAL_CONFIG.get("object_store_destructive_eviction")
         self._allow_evict = 1 if allow_evict else 0
+        # serializes close() against GC-driven release()/contains()/delete()
+        # (zero-copy pin finalizers fire on arbitrary threads at shutdown;
+        # rt_store_close munmaps + frees, so a handle snapshot alone would
+        # race a close into use-after-free)
+        self._close_lock = threading.Lock()
         if create:
             self._handle = self._lib.rt_store_create(name.encode(), size, capacity)
         else:
@@ -189,13 +195,28 @@ class ShmObjectStore:
             time.sleep(poll_s)
 
     def release(self, object_id: ObjectID) -> None:
-        self._lib.rt_object_release(self._handle, object_id.binary())
+        # Zero-copy pins (_Pin finalizers) are released by GC and routinely
+        # outlive close() at shutdown; a NULL handle into the native lib is
+        # a segfault, not an error return — and close() munmaps, so the
+        # check must hold the close lock, not just snapshot the handle.
+        with self._close_lock:
+            if not self._handle:
+                return
+            self._lib.rt_object_release(self._handle, object_id.binary())
 
     def contains(self, object_id: ObjectID) -> bool:
-        return bool(self._lib.rt_object_contains(self._handle, object_id.binary()))
+        with self._close_lock:
+            if not self._handle:
+                return False
+            return bool(
+                self._lib.rt_object_contains(self._handle, object_id.binary()))
 
     def delete(self, object_id: ObjectID) -> bool:
-        return self._lib.rt_object_delete(self._handle, object_id.binary()) == RT_OK
+        with self._close_lock:
+            if not self._handle:
+                return False
+            return self._lib.rt_object_delete(
+                self._handle, object_id.binary()) == RT_OK
 
     def put_bytes(self, object_id: ObjectID, data, metadata: int = META_NORMAL) -> None:
         """Convenience: create+copy+seal in one call."""
@@ -204,12 +225,13 @@ class ShmObjectStore:
         self.seal(object_id)
 
     def close(self) -> None:
-        if self._handle:
-            # Drop the ctypes view before unmapping.
-            self._mv.release()
-            del self._map
-            self._lib.rt_store_close(self._handle)
-            self._handle = None
+        with self._close_lock:
+            if self._handle:
+                # Drop the ctypes view before unmapping.
+                self._mv.release()
+                del self._map
+                self._lib.rt_store_close(self._handle)
+                self._handle = None
 
     def destroy(self) -> None:
         name = self.name

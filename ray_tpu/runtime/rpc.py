@@ -24,6 +24,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 import msgpack
 
 from ray_tpu._private import chaos
+from ray_tpu._private import fastpath as _fastpath
 from ray_tpu._private.errors import RpcError
 
 
@@ -51,6 +52,28 @@ async def _read_frame(reader: asyncio.StreamReader):
         raise RpcError(f"Frame too large: {length}")
     payload = await reader.readexactly(length)
     return msgpack.unpackb(payload, raw=False)
+
+
+def _drain_splitter(splitter) -> list:
+    """Pull every complete frame out of the native splitter, decoded to the
+    same (kind, req_id, method, payload) shape _read_frame yields. The C++
+    side pre-parses the header; only the payload value goes through the
+    msgpack unpacker — and the whole available chunk is handled in one
+    event-loop iteration (batched completion dispatch)."""
+    out = []
+    while True:
+        fr = splitter.next()
+        if fr is None:
+            return out
+        kind, req_id, method, payload = fr
+        if kind is None:
+            # header shape the native parser defers on: unpack whole frame
+            kind, req_id, method, decoded = msgpack.unpackb(
+                payload, raw=False)
+        else:
+            method = method.decode()
+            decoded = msgpack.unpackb(payload, raw=False)
+        out.append((kind, req_id, method, decoded))
 
 
 Handler = Callable[..., Awaitable[Any]]
@@ -115,16 +138,38 @@ class RpcServer:
         conn_id = next(self._conn_counter)
         self._conns[conn_id] = writer
         writer._rt_write_lock = asyncio.Lock()  # serialize drain() across dispatch tasks
+        splitter = _fastpath.new_splitter()
         try:
-            while True:
-                try:
-                    frame = await _read_frame(reader)
-                except (asyncio.IncompleteReadError, ConnectionError):
-                    break
-                kind, req_id, method, payload = frame
-                if kind != _REQ:
-                    continue
-                spawn(self._dispatch(conn_id, writer, req_id, method, payload))
+            if splitter is not None:
+                # native codec: one read() may carry many frames (pipelined
+                # submissions); the C++ splitter carves them all in one pass
+                while True:
+                    try:
+                        data = await reader.read(1 << 18)
+                    except (ConnectionError, OSError):
+                        break
+                    if not data:
+                        break
+                    try:
+                        splitter.feed(data)
+                        frames = _drain_splitter(splitter)
+                    except ValueError:
+                        break  # oversized frame: protocol violation
+                    for kind, req_id, method, payload in frames:
+                        if kind != _REQ:
+                            continue
+                        spawn(self._dispatch(
+                            conn_id, writer, req_id, method, payload))
+            else:
+                while True:
+                    try:
+                        frame = await _read_frame(reader)
+                    except (asyncio.IncompleteReadError, ConnectionError):
+                        break
+                    kind, req_id, method, payload = frame
+                    if kind != _REQ:
+                        continue
+                    spawn(self._dispatch(conn_id, writer, req_id, method, payload))
         finally:
             self._conns.pop(conn_id, None)
             for cb in self._on_disconnect:
@@ -218,30 +263,34 @@ class RpcClient:
         self._connected_once = True
 
     async def _recv_loop(self):
+        splitter = _fastpath.new_splitter()
         try:
-            while True:
-                frame = await _read_frame(self._reader)
-                # any inbound frame proves the peer is alive — short per-call
-                # timeouts on slow methods must not count toward a reconnect
-                # while other replies are flowing
-                self._consecutive_timeouts = 0
-                kind, req_id, method, payload = frame
-                if kind == _PUSH:
-                    cb = self._subs.get(method)
-                    if cb is not None:
-                        try:
-                            cb(payload)
-                        except Exception:
-                            logger.exception("%s: push callback for %s failed", self.name, method)
-                    continue
-                fut = self._pending.pop(req_id, None)
-                if fut is None or fut.done():
-                    continue
-                if kind == _ERR:
-                    fut.set_exception(RpcError(payload))
-                else:
-                    fut.set_result(payload)
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError) as e:
+            if splitter is not None:
+                # native codec: a burst of replies is carved and dispatched
+                # in one loop iteration — futures resolve in chunks instead
+                # of one coroutine wakeup per frame
+                while True:
+                    data = await self._reader.read(1 << 18)
+                    if not data:
+                        raise asyncio.IncompleteReadError(b"", None)
+                    splitter.feed(data)
+                    frames = _drain_splitter(splitter)
+                    if frames:
+                        # any inbound frame proves the peer is alive
+                        self._consecutive_timeouts = 0
+                    for kind, req_id, method, payload in frames:
+                        self._dispatch_frame(kind, req_id, method, payload)
+            else:
+                while True:
+                    frame = await _read_frame(self._reader)
+                    # any inbound frame proves the peer is alive — short
+                    # per-call timeouts on slow methods must not count toward
+                    # a reconnect while other replies are flowing
+                    self._consecutive_timeouts = 0
+                    kind, req_id, method, payload = frame
+                    self._dispatch_frame(kind, req_id, method, payload)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError, ValueError) as e:
             logger.debug("%s: recv loop ended: %r", self.name, e)
         finally:
             # Mark the transport dead so call() reconnects instead of writing
@@ -255,6 +304,24 @@ class RpcClient:
                         RpcConnectionLost(f"{self.name}: connection to {self.address} lost")
                     )
             self._pending.clear()
+
+    def _dispatch_frame(self, kind, req_id, method, payload):
+        if kind == _PUSH:
+            cb = self._subs.get(method)
+            if cb is not None:
+                try:
+                    cb(payload)
+                except Exception:
+                    logger.exception(
+                        "%s: push callback for %s failed", self.name, method)
+            return
+        fut = self._pending.pop(req_id, None)
+        if fut is None or fut.done():
+            return
+        if kind == _ERR:
+            fut.set_exception(RpcError(payload))
+        else:
+            fut.set_result(payload)
 
     def subscribe_channel(self, channel: str, callback: Callable[[Any], None]):
         self._subs[channel] = callback
@@ -329,6 +396,44 @@ class RpcClient:
         raise RpcError(
             f"{self.name}: call {method} to {self.address} failed after retries"
         ) from last_exc
+
+    async def call_frame(self, build, timeout: float | None = None) -> Any:
+        """Single-attempt call whose complete frame (length prefix included)
+        comes from `build(req_id)` — the handoff point for the native
+        engine's pre-assembled batch frames: one buffer, one write. No
+        transport-level retries: building consumes the batch entries, so a
+        failure surfaces to the caller, which owns re-submission (the feeder
+        requeues specs through the task-retry path)."""
+        if self._closed:
+            raise RpcError(f"{self.name}: client closed")
+        loop = asyncio.get_running_loop()
+        if self._writer is None or self._writer.is_closing():
+            async with self._lock:
+                await self._ensure_connected()
+        req_id = next(self._req_counter)
+        fut = loop.create_future()
+        self._pending[req_id] = fut
+        writer = self._writer
+        if writer is None:
+            self._pending.pop(req_id, None)
+            raise RpcConnectionLost(f"{self.name}: reconnect pending")
+        try:
+            frame = build(req_id)
+            writer.write(frame)
+            if writer.transport.get_write_buffer_size() > 256 * 1024:
+                async with self._write_lock:
+                    await writer.drain()
+        except (ConnectionError, RuntimeError, OSError) as e:
+            self._pending.pop(req_id, None)
+            raise RpcConnectionLost(f"{self.name}: send failed: {e}") from e
+        timer = None
+        if timeout is not None:
+            timer = loop.call_later(timeout, self._expire_pending, req_id)
+        try:
+            return await fut
+        finally:
+            if timer is not None:
+                timer.cancel()
 
     def _expire_pending(self, req_id: int):
         fut = self._pending.pop(req_id, None)

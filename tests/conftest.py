@@ -27,7 +27,12 @@ if not os.environ.get("RT_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        # newer jax spells the device count as a config option; older
+        # releases only honor the XLA_FLAGS form set above
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass
 
 import pytest  # noqa: E402
 

@@ -144,6 +144,9 @@ def test_cross_process_doorbell(store, tmp_path):
 
     ch = ShmChannel(store, _oid(b"xproc"), creator=True, nslots=4,
                     slot_size=1024)
+    # pre-3.12 f-strings forbid backslashes inside expressions: build the
+    # padded id outside the template
+    oid_bytes = b"xproc".ljust(24, b"\x00")
     script = tmp_path / "reader.py"
     script.write_text(f"""
 import sys, time
@@ -153,7 +156,7 @@ from ray_tpu._private.ids import ObjectID
 from ray_tpu.experimental.channel import ShmChannel
 from ray_tpu.runtime.object_store import ShmObjectStore
 store = ShmObjectStore({store.name!r})
-ch = ShmChannel(store, ObjectID({b"xproc".ljust(24, b"\0")!r}))
+ch = ShmChannel(store, ObjectID({oid_bytes!r}))
 t0 = time.perf_counter()
 data = ch.read_bytes(timeout=15)
 dt = time.perf_counter() - t0

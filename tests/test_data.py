@@ -261,7 +261,11 @@ def test_limit_pushdown_and_explain(ray_init):
 
     limited = ds.limit(3).map_batches(check_and_double)
     plan = limited.explain()
-    assert "fused" in plan and "map_batches -> map_batches" in plan, plan
+    # map_batches can change row counts, so it sits BEHIND the stream-order
+    # limit fence (ADVICE r5 #1): the parent plan carries the fused
+    # per-block cap, the fence line marks the global cut, and the op itself
+    # only ever sees rows within the budget
+    assert "fused" in plan and "limit[stream-order fence: 3 rows]" in plan, plan
     rows = limited.take_all()
     assert [r["id"] for r in rows] == [0, 1, 2]  # exactly n rows, in order
     assert all(r["twice"] == 2 * r["id"] for r in rows)
