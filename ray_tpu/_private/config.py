@@ -182,9 +182,17 @@ _flag("device_object_transport", True, "Keep jax.Arrays HBM-resident through the
 _flag("native_fastpath", True, "Use the C++ submission/completion engine (native/fastpath.cc: templated spec encoding, lock-free submission ring, batched frame build + reply splitting) on the control-plane hot path (reference: the _raylet.pyx submit_task seam). Falls back to the pure-Python path when the build fails or no compiler exists — set 0 to force the fallback.")
 _flag("fastpath_ring_slots", 65536, "Capacity of each lock-free submission ring (one ring per scheduling key); a full ring overflows gracefully onto the Python queue.")
 
+# --- retry policy (shared by RPC calls, object fetch, lease requests) ---
+_flag("retry_base_s", 0.2, "Unified retry policy: first backoff delay (reference: retryable_grpc_client backoff base).")
+_flag("retry_max_s", 5.0, "Unified retry policy: backoff cap (decorrelated jitter draws in [base, prev*3] clipped here).")
+
 # --- chaos / fault injection (day 1, per SURVEY §4) ---
+_flag("testing_chaos_seed", 0, "Seed for the per-process chaos PRNG (mixed with the process's chaos role). 0 = fresh entropy. A seeded run replays every injected delay/drop/jitter draw exactly — reproduce any chaos failure from its seed.")
 _flag("testing_event_loop_delay_us", "", "Inject delays into event-loop handlers. Format: 'method:min_us:max_us,...' ('*' matches all). Mirrors RAY_testing_asio_delay_us.")
 _flag("testing_rpc_failure", "", "Inject RPC failures. Format: 'method:max_failures:req_prob:resp_prob,...' ('*' matches all). Mirrors RAY_testing_rpc_failure.")
+_flag("testing_rpc_stall", "", "Server-side RESPONSE stalls: 'method:ms:count,...' — the handler runs, then the reply stalls ms milliseconds, count times (models a wedged-but-alive control store).")
+_flag("testing_rpc_partition", "", "One-way RPC-layer partition: 'src>dst#count,...' — a client in a process whose chaos role matches src cannot reach peers whose address matches dst; heals after count blocked sends (omit for unbounded).")
+_flag("testing_process_kill", "", "Process-kill fault: 'role:method:nth,...' — the nth dispatch of method in a process whose chaos role matches exits hard (os._exit 137).")
 
 # --- TPU ---
 _flag("tpu_chips_per_host", 0, "Override detected TPU chips per host (0 = autodetect).")

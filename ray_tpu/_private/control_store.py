@@ -617,6 +617,11 @@ class ControlStore:
         self.dead_worker_addresses.move_to_end(address)
         while len(self.dead_worker_addresses) > 65536:
             self.dead_worker_addresses.popitem(last=False)
+        # authoritative worker-failure notice (reference: the GCS
+        # WORKER_DELTA pubsub channel): owners subscribe so borrow
+        # reconciliation and recovery react to the recorded death instead
+        # of waiting out probe timeouts
+        self.pubsub.publish("workers", {"address": address, "dead": True})
         # drop the id index entries too (node-death and job-finish paths
         # bypass rpc_report_worker_death's by-id pop): the control store
         # must not grow a stale entry per worker/driver forever
@@ -749,6 +754,16 @@ class ControlStore:
     # ------------------------------------------------------------------
     # pub/sub
     # ------------------------------------------------------------------
+
+    async def rpc_chaos_set(self, conn_id: int, payload: dict) -> dict:
+        """Chaos scenario hook (testing only): apply chaos/testing config
+        flags to the control store at runtime — e.g. stall its responses
+        mid-failover (see _private.chaos)."""
+        from ray_tpu._private import chaos
+
+        GLOBAL_CONFIG.apply_system_config(payload.get("config", {}))
+        chaos.reset()
+        return {"ok": True, "role": chaos.role()}
 
     async def rpc_subscribe(self, conn_id: int, payload: dict) -> dict:
         self.pubsub.subscribe(conn_id, payload["channel"])

@@ -442,11 +442,18 @@ class ActorHandleState:
     __slots__ = ("actor_id", "seq", "address", "client", "state", "death_cause",
                  "event", "creation_keepalive", "incarnation", "ever_alive",
                  "push_queue", "pump_running", "push_next", "push_incarnation",
-                 "push_waiters", "concurrent")
+                 "push_waiters", "concurrent", "applied_version")
 
     def __init__(self, actor_id: bytes):
         self.actor_id = actor_id
         self.seq = 0
+        # last applied (num_restarts, state-rank) version: state updates
+        # arrive over BOTH pubsub and get_actor_info polls, whose replies
+        # can reorder under load — a stale RESTARTING applied after the
+        # fresh ALIVE would bump the incarnation spuriously and reset seq
+        # numbering into the executor's duplicate-reply cache (found by the
+        # chaos harness: two distinct calls returning one cached result)
+        self.applied_version: tuple = (-1, -1)
         # push coalescing: (spec, future) entries drained by one pump task
         # into push_task_batch RPCs (reference: pipelined actor PushTask)
         self.push_queue: collections.deque = collections.deque()
@@ -530,16 +537,12 @@ class CoreWorker:
         # CancelTask / actor_task_submitter queued-task cancellation)
         self._submissions: Dict[bytes, dict] = {}
         self._return_to_task: Dict[bytes, bytes] = {}
-        # lineage cache (reference: object_recovery_manager.h + task_manager
-        # lineage pinning): completed task specs whose shm-resident returns
-        # are still referenced, so a lost object can be recomputed by
-        # resubmitting its creating task. keepalive pins the arg ObjectRefs
-        # for as long as the lineage entry lives (reference pins lineage via
-        # the reference counter).
-        self._lineage: Dict[bytes, tuple] = {}  # tid -> (spec, keepalive, n_rebuilt)
-        self._lineage_returns: Dict[bytes, bytes] = {}  # return oid -> tid
-        self._lineage_live: Dict[bytes, int] = {}  # tid -> live return count
-        self._reconstructing: Dict[bytes, asyncio.Future] = {}
+        # recovery plane (reference: object_recovery_manager.h): lineage
+        # cache + per-object recovery state machine, driven by authoritative
+        # death notices from the control store (see _private.recovery)
+        from ray_tpu._private.recovery import ObjectRecoveryManager
+
+        self.recovery = ObjectRecoveryManager(self)
         # granted-but-idle worker leases by scheduling key, reused by the
         # next same-shaped task (reference: normal_task_submitter lease
         # pools). Each entry: {"idle": [lease...], "waiters": deque[Future]}.
@@ -599,9 +602,24 @@ class CoreWorker:
         await self.daemon.connect()
         self.control.subscribe_channel("actors", self._on_actor_update)
         await self.control.call("subscribe", {"channel": "actors"})
+        # authoritative failure notices (reference: GCS node/worker-failure
+        # pubsub): node deaths drive the recovery manager — lost locations
+        # are poisoned and recovery starts on the NOTICE, not on a getter
+        # tripping over a stale location; worker deaths reconcile borrows
+        # immediately instead of waiting out the reaper's probe cycle
+        self.control.subscribe_channel("nodes", self._on_node_notice)
+        await self.control.call("subscribe", {"channel": "nodes"})
+        self.control.subscribe_channel("workers", self._on_worker_notice)
+        await self.control.call("subscribe", {"channel": "workers"})
         # a restarted control store loses server-side subscription state
         self.control.on_reconnect(
             lambda: self.control.call("subscribe", {"channel": "actors"})
+        )
+        self.control.on_reconnect(
+            lambda: self.control.call("subscribe", {"channel": "nodes"})
+        )
+        self.control.on_reconnect(
+            lambda: self.control.call("subscribe", {"channel": "workers"})
         )
         # announce this process's RPC address so owners' borrow reapers can
         # distinguish authoritative death from mere unresponsiveness
@@ -620,6 +638,47 @@ class CoreWorker:
 
     async def rpc_ping(self, conn_id: int, payload: dict) -> dict:
         return {"ok": True}
+
+    async def rpc_chaos_set(self, conn_id: int, payload: dict) -> dict:
+        """Chaos scenario hook (testing only): apply chaos/testing config
+        flags to this worker/driver process at runtime."""
+        from ray_tpu._private import chaos as _chaos
+
+        GLOBAL_CONFIG.apply_system_config(payload.get("config", {}))
+        _chaos.reset()
+        return {"ok": True, "role": _chaos.role()}
+
+    def _on_node_notice(self, message: dict):
+        """Control-store "nodes" pubsub: a DEAD notice is the authoritative
+        recovery trigger — poison lost locations, kick eager recovery, and
+        drop pooled leases/clients aimed at the dead daemon."""
+        if message.get("state") != pb.NODE_DEAD:
+            return
+        node_hex = NodeID(message["node_id"]).hex()
+        daemon_addr = message.get("address", "")
+        self.recovery.on_node_death(node_hex, daemon_addr)
+        if daemon_addr:
+            # a cached lease on the dead node would push the next task (or a
+            # recovery re-execution) into a store no daemon serves
+            self._drop_pooled_leases_from(daemon_addr)
+
+    def _on_worker_notice(self, message: dict):
+        """Control-store "workers" pubsub: a recorded worker/driver death
+        reconciles its borrows NOW (the probe-based reaper loop stays as
+        the fallback for missed pushes)."""
+        if not message.get("dead"):
+            return
+        addr = message.get("address", "")
+        if not addr:
+            return
+        dropped = self.ref_counter.drop_borrower_process(addr)
+        if dropped:
+            logger.info(
+                "reaped %d borrow(s) held by dead borrower %s "
+                "(authoritative death notice)", dropped, addr)
+        dead = self._owner_clients.pop(addr, None)
+        if dead is not None:
+            spawn(dead.close())
 
     async def _register_worker_liveness(self):
         try:
@@ -935,13 +994,16 @@ class CoreWorker:
                     await asyncio.sleep(0)
                     continue
                 try:
-                    return await self._read_store_object(ref, location, deadline)
+                    self.recovery.note_fetching(oid)
+                    value = await self._read_store_object(ref, location, deadline)
+                    self.recovery.note_local(oid)
+                    return value
                 except ObjectLostError:
                     # the store node died with the object; recompute from
                     # lineage and retry with the fresh location (bounded by
                     # the caller's deadline — recovery continues regardless)
                     if not await self._bounded(
-                        self._maybe_reconstruct(oid, location.get("node_id")),
+                        self.recovery.recover(oid, location.get("node_id")),
                         deadline, ref, "reconstructing",
                     ):
                         raise
@@ -1027,6 +1089,15 @@ class CoreWorker:
             return await self._remote_read(ref, location, deadline)
         oid = ref.object_id()
         is_local = location.get("node_id") == self.node_id_hex
+        # authoritative death notice poisoned this location (see
+        # recovery.on_node_death): a still-valid LOCAL copy may exist in
+        # this node's store, but a remote pull from the dead daemon would
+        # only burn the deadline — fail over to recovery immediately
+        if location.get("dead") and not is_local and not self.store.contains(oid):
+            raise ObjectLostError(
+                ref.hex(),
+                f"store node {location.get('node_id', '')[:8]} is dead "
+                "(authoritative death record)")
         pulled = False
         # Pin-or-recover loop: between any check and the pinning get() the
         # spill loop may write the object to disk and delete it from shm, so
@@ -1499,7 +1570,7 @@ class CoreWorker:
         key = oid.binary()
         loc = self.memory_store.locations.get(key)
         self.memory_store.delete(key)
-        self._drop_lineage_for(key)
+        self.recovery.drop_lineage_for(key)
         if loc is not None:
             await self._free_store_copy(key, loc)
 
@@ -1893,8 +1964,19 @@ class CoreWorker:
             st.concurrent = True
         with self._lock:
             seq = self._next_seq(st)
+            # the task id must NOT derive from `seq`: sequence numbering
+            # restarts at 1 for every actor incarnation, so a post-restart
+            # task would reuse a pre-restart task's id — colliding in the
+            # executor's duplicate-reply cache (a new call answered with a
+            # stale cached reply) and in this owner's submission/return
+            # tables. Mint from the caller-global task counter instead;
+            # seq stays purely an ordering stamp. (Found by the chaos
+            # harness: soak scenario 4, control-store stall during
+            # failover.)
+            self._task_index += 1
+            task_index = self._task_index
         task_id = TaskID.for_actor_task(
-            self.job_id, ActorID(actor_id), self.current_task_id, seq
+            self.job_id, ActorID(actor_id), self.current_task_id, task_index
         )
         spec = TaskSpec(
             trace_ctx=_trace_inject(),
@@ -1997,9 +2079,15 @@ class CoreWorker:
         st.wake_consumers()
 
     async def _submit_with_retries(self, spec: TaskSpec, keepalive):
+        from ray_tpu._private.retry import RetryPolicy
+
         retries = spec.max_retries
         attempt = 0
         sub = None
+        backoff = RetryPolicy(
+            GLOBAL_CONFIG.get("retry_base_s"),
+            GLOBAL_CONFIG.get("retry_max_s"),
+        ).backoff()
         while True:
             sub = self._submissions.get(spec.task_id.binary())
             if sub is not None and sub["cancelled"]:
@@ -2032,7 +2120,7 @@ class CoreWorker:
                     )
                     return
                 logger.info("retrying task %s (attempt %d): %s", spec.name, attempt, e)
-                await asyncio.sleep(min(0.2 * (2 ** attempt), 5.0))
+                await backoff.sleep()
             except Exception as e:  # noqa: BLE001 — scheduling-level failure
                 self._fail_task(spec, RayTpuError(f"submit failed: {e}"))
                 return
@@ -2594,144 +2682,17 @@ class CoreWorker:
             self._record_return_entry(ret)
 
     # ------------------------------------------------------------------
-    # lineage reconstruction (reference: object_recovery_manager.h —
-    # a lost shm-resident return is recovered by resubmitting its
-    # creating task; args resolve recursively through the same path)
+    # lineage reconstruction — delegated to the recovery manager
+    # (reference: object_recovery_manager.h; see _private.recovery for the
+    # per-object state machine and the authoritative-death trigger)
     # ------------------------------------------------------------------
 
-    def _return_is_live(self, oid: bytes) -> bool:
-        """An owned return is live while anyone (local or borrower) holds it."""
-        rc = self.ref_counter
-        return (rc.local_counts.get(oid, 0) > 0
-                or rc.borrower_counts.get(oid, 0) > 0)
-
     def _record_lineage(self, spec: TaskSpec, keepalive):
-        """Cache the spec of a completed task whose returns live in a shm
-        store (location-recorded) — those die with their node. Inline
-        returns live in the owner's memory store and need no lineage.
-        Already-freed returns (refcount zero) are not re-registered — a
-        re-execution may have recreated them, but nothing can free them
-        again, so tracking them would leak the lineage entry."""
-        if spec.actor_id is not None or spec.is_streaming:
-            return  # actor state is not replayable; streams not recovered
-        if spec.max_retries <= 0:
-            # max_retries=0 is an at-most-once contract (side-effecting
-            # tasks); never silently re-run them (reference:
-            # object_recovery_manager reconstructs only retryable tasks)
-            return
-        ret_oids = [
-            oid.binary() for oid in spec.return_ids()
-            if oid.binary() in self.memory_store.locations
-            and self._return_is_live(oid.binary())
-        ]
-        if not ret_oids:
-            return
-        tid = spec.task_id.binary()
-        prior = self._lineage.get(tid)
-        self._lineage[tid] = (spec, keepalive, prior[2] if prior else 0)
-        for ob in ret_oids:
-            if self._lineage_returns.get(ob) != tid:
-                self._lineage_returns[ob] = tid
-                self._lineage_live[tid] = self._lineage_live.get(tid, 0) + 1
-        cap = GLOBAL_CONFIG.get("lineage_cache_max_tasks")
-        while len(self._lineage) > cap:
-            old_tid = next(iter(self._lineage))
-            old_spec, _, _ = self._lineage.pop(old_tid)
-            self._lineage_live.pop(old_tid, None)
-            for oid in old_spec.return_ids():
-                self._lineage_returns.pop(oid.binary(), None)
-
-    def _drop_lineage_for(self, oid: bytes):
-        tid = self._lineage_returns.pop(oid, None)
-        if tid is None:
-            return
-        live = self._lineage_live.get(tid, 1) - 1
-        if live <= 0:
-            self._lineage_live.pop(tid, None)
-            self._lineage.pop(tid, None)
-        else:
-            self._lineage_live[tid] = live
-
-    async def _maybe_reconstruct(self, oid: bytes,
-                                 failed_node: Optional[str] = None) -> bool:
-        """Owner-side: recompute a lost object by resubmitting its creating
-        task. Returns True if the object was (or already had been) recovered
-        — the caller should retry the read — False if it has no usable
-        lineage. `failed_node` is the node the caller's read failed against:
-        if the current location already points elsewhere, an earlier
-        reconstruction refreshed it and no new re-execution is needed."""
-        tid = self._lineage_returns.get(oid)
-        if tid is None:
-            return False
-        pending = self._reconstructing.get(tid)
-        if pending is not None:
-            await asyncio.shield(pending)
-            return True
-        if oid in self.memory_store.objects:
-            return True
-        cur = self.memory_store.locations.get(oid)
-        if (cur is not None and failed_node is not None
-                and cur.get("node_id") != failed_node):
-            return True  # a finished reconstruction already relocated it
-        entry = self._lineage.get(tid)
-        if entry is None:
-            return False
-        spec, keepalive, n_rebuilt = entry
-        if n_rebuilt >= GLOBAL_CONFIG.get("max_lineage_reconstructions"):
-            logger.warning(
-                "object %s lost and lineage reconstruction budget spent",
-                ObjectID(oid).hex(),
-            )
-            return False
-        self._lineage[tid] = (spec, keepalive, n_rebuilt + 1)
-        done = self.loop.create_future()
-        self._reconstructing[tid] = done
-        logger.info(
-            "reconstructing %s by resubmitting task %s (attempt %d)",
-            ObjectID(oid).hex(), spec.name or spec.function_key, n_rebuilt + 1,
-        )
-        try:
-            # never resubmit onto a cached lease from the failed node: an
-            # orphaned worker there may still accept the push and write the
-            # "recovered" object into a store no daemon serves
-            failed_loc = (cur or {}).get("daemon")
-            if failed_loc:
-                self._drop_pooled_leases_from(failed_loc)
-            # clear only locations lost with the failed node, so healthy
-            # sibling copies stay readable; waiters block on the fresh run
-            for roid in spec.return_ids():
-                rb = roid.binary()
-                loc = self.memory_store.locations.get(rb)
-                if (rb not in self.memory_store.objects and loc is not None
-                        and (failed_node is None
-                             or loc.get("node_id") == failed_node)):
-                    self.memory_store.locations.pop(rb, None)
-            # track the resubmission so ray_tpu.cancel() can reach it
-            atask = spawn(self._submit_with_retries(spec, keepalive))
-            self._track_submission(spec, atask)
-            try:
-                await atask
-            except asyncio.CancelledError:
-                if not atask.cancelled():
-                    raise  # this coroutine was cancelled, not the resubmission
-                # cancelled resubmission already resolved the returns with
-                # TaskCancelledError; the retrying reader surfaces it
-            # the re-execution recreates every return; drop fresh copies of
-            # returns nobody references anymore (they can never be freed by
-            # refcount — their count is already zero)
-            for roid in spec.return_ids():
-                rb = roid.binary()
-                if rb != oid and not self._return_is_live(rb):
-                    spawn(self.free_owned_object(roid))
-        finally:
-            self._reconstructing.pop(tid, None)
-            if not done.done():
-                done.set_result(True)
-        return True
+        self.recovery.record_lineage(spec, keepalive)
 
     async def rpc_reconstruct_object(self, conn_id: int, payload: dict) -> dict:
         """A borrower observed the object's store node die; recover it."""
-        ok = await self._maybe_reconstruct(
+        ok = await self.recovery.recover(
             payload["object_id"], payload.get("failed_node")
         )
         return {"ok": ok} if ok else {"ok": False, "error": "no lineage for object"}
@@ -2975,10 +2936,24 @@ class CoreWorker:
     # actors (reference: actor_task_submitter.h:69, gcs_actor_manager.h:94)
     # ------------------------------------------------------------------
 
+    _ACTOR_STATE_RANK = {
+        pb.ACTOR_PENDING: 0, pb.ACTOR_RESTARTING: 1,
+        pb.ACTOR_ALIVE: 2, pb.ACTOR_DEAD: 3,
+    }
+
     def _on_actor_update(self, message: dict):
         st = self._actor_states.get(message["actor_id"])
         if st is None:
             return
+        # per-restart-cycle monotonic version: PENDING(0) < RESTARTING(n) <
+        # ALIVE(n) < DEAD(n). Poll replies and pubsub pushes interleave
+        # without ordering; applying a stale one must never regress state
+        # (it would fabricate an incarnation and poison seq numbering).
+        version = (message.get("num_restarts", 0),
+                   self._ACTOR_STATE_RANK.get(message["state"], 0))
+        if version < st.applied_version:
+            return
+        st.applied_version = version
         st.state = message["state"]
         st.death_cause = message.get("death_cause", "")
         if st.state == pb.ACTOR_ALIVE:

@@ -51,6 +51,11 @@ def new_session_dir() -> str:
 
 
 def start_control_store(session_dir: str, port: int = 0) -> tuple:
+    # a fresh control store = a fresh cluster: restart the spawn-ordered
+    # daemon role labels so a scenario replayed in isolation draws the same
+    # (seed, role) chaos streams as it did inside a longer run
+    global _daemon_role_counter
+    _daemon_role_counter = 0
     ready = os.path.join(session_dir, f"cs_ready_{uuid.uuid4().hex[:6]}.json")
     log = open(os.path.join(session_dir, "logs", "control_store.log"), "ab")
     proc = subprocess.Popen(
@@ -61,10 +66,17 @@ def start_control_store(session_dir: str, port: int = 0) -> tuple:
             "--persist-dir", os.path.join(session_dir, "control_store"),
         ],
         stdout=log, stderr=subprocess.STDOUT, start_new_session=True,
+        env={**os.environ, "RT_CHAOS_ROLE": "control"},
     )
     log.close()
     info = _wait_ready(ready, proc)
     return proc, info["address"]
+
+
+# spawn-ordered chaos-role index for daemons started by THIS process: the
+# chaos PRNG seeds from (seed, role), so stable spawn-order labels make a
+# whole-cluster fault schedule replayable from one integer
+_daemon_role_counter = 0
 
 
 def start_node_daemon(
@@ -74,6 +86,8 @@ def start_node_daemon(
     labels: Optional[Dict[str, str]] = None,
     port: int = 0,
 ) -> tuple:
+    global _daemon_role_counter
+    _daemon_role_counter += 1
     ready = os.path.join(session_dir, f"nd_ready_{uuid.uuid4().hex[:6]}.json")
     log = open(
         os.path.join(session_dir, "logs", f"daemon_{uuid.uuid4().hex[:6]}.log"), "ab"
@@ -91,7 +105,8 @@ def start_node_daemon(
     if labels:
         cmd += ["--labels", json.dumps(labels)]
     proc = subprocess.Popen(
-        cmd, stdout=log, stderr=subprocess.STDOUT, start_new_session=True
+        cmd, stdout=log, stderr=subprocess.STDOUT, start_new_session=True,
+        env={**os.environ, "RT_CHAOS_ROLE": f"daemon{_daemon_role_counter}"},
     )
     log.close()
     info = _wait_ready(ready, proc)

@@ -31,6 +31,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import chaos
 from ray_tpu._private import protocol as pb
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.errors import ObjectStoreFullError
@@ -196,6 +197,10 @@ class NodeDaemon:
         # not roll the state back (reply snapshots are unordered vs pubsub)
         self._drain_sync_ts = 0.0
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        # daemon addresses declared dead by the control store: pulls from
+        # them fail fast instead of retrying into a void (authoritative
+        # death beats connect timeouts)
+        self._dead_peer_addrs: Set[str] = set()
         # in-progress remote-client puts: oid -> (writable view, last-touch
         # ts). Swept by the reap loop — a client dying mid-put must not pin
         # store capacity forever (unsealed entries are not evictable).
@@ -207,6 +212,9 @@ class NodeDaemon:
             session_dir, "spill", self.node_id.hex()[:12]
         )
         self._spill_lock: Optional[asyncio.Lock] = None
+        # spawn-ordered suffix for worker chaos roles (deterministic fault
+        # schedules — see _private.chaos)
+        self._worker_role_counter = 0
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -300,6 +308,9 @@ class NodeDaemon:
             return
         if info.state == pb.NODE_ALIVE:
             self.peer_nodes[hexid] = info
+            # an address can be reused by a re-registered node: it is no
+            # longer an authoritatively-dead pull source
+            self._dead_peer_addrs.discard(info.address)
             # seed with total resources; the next gossip beat corrects it
             self.cluster_view.setdefault(hexid, info.resources)
             self._try_schedule()
@@ -307,6 +318,15 @@ class NodeDaemon:
             self.peer_nodes.pop(hexid, None)
             self.cluster_view.pop(hexid, None)
             self._view_seq.pop(hexid, None)
+            if info.state == pb.NODE_DEAD:
+                # DEAD only — a DRAINING node still serves its objects.
+                # Retire the pooled transfer client too: a later pull aimed
+                # at the dead peer must fail fast, not burn retries through
+                # a half-open cached transport
+                self._dead_peer_addrs.add(info.address)
+                dead = self._peer_clients.pop(info.address, None)
+                if dead is not None:
+                    spawn(dead.close())
 
     # ------------------------------------------------------------------
     # peer resource-view gossip (reference: src/ray/ray_syncer/
@@ -546,7 +566,11 @@ class NodeDaemon:
             RT_SESSION_DIR=self.session_dir,
             RT_CONFIG_JSON=GLOBAL_CONFIG.serialize_overrides(),
             RT_ENV_KEY=env_key,
+            # spawn-ordered chaos role (see _private.chaos: the seeded PRNG
+            # mixes in this label, making worker fault schedules replayable)
+            RT_CHAOS_ROLE=f"{chaos.role()}.w{self._worker_role_counter}",
         )
+        self._worker_role_counter += 1
         # the framework itself must resolve from the env worker's (possibly
         # venv) interpreter regardless of cwd
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -1702,6 +1726,9 @@ class NodeDaemon:
         if oid.binary() in self.spilled:
             # pulled previously, then spilled: restore from local disk
             return {"ok": await self._restore_object(oid)}
+        if payload["from_address"] in self._dead_peer_addrs:
+            return {"ok": False,
+                    "error": "source node recorded dead by control store"}
         key = oid.binary()
         fut = self._pulls_inflight.get(key)
         if fut is None:
@@ -1786,6 +1813,44 @@ class NodeDaemon:
     async def rpc_ping(self, conn_id: int, payload) -> dict:
         """Liveness probe for worker fate-sharing watchdogs."""
         return {"ok": True}
+
+    # -- chaos scenario hooks (testing only; reference: rpc_chaos.h is
+    # env-driven — these add runtime aim-ability, since daemon/worker
+    # addresses are only known after spawn) -----------------------------
+
+    async def rpc_chaos_set(self, conn_id: int, payload: dict) -> dict:
+        """Apply chaos/testing config flags to THIS daemon process at
+        runtime (e.g. partition it from one peer address)."""
+        GLOBAL_CONFIG.apply_system_config(payload.get("config", {}))
+        chaos.reset()
+        return {"ok": True, "role": chaos.role()}
+
+    async def rpc_chaos_kill(self, conn_id: int, payload: dict) -> dict:
+        """Kill a chosen worker process (by id, or any one leased/idle
+        worker), or this daemon itself — the process-kill fault type aimed
+        at a specific live process."""
+        if payload.get("die"):
+            # reply first so the injector isn't stuck on a lost RPC; the
+            # exit runs after the response flushes
+            asyncio.get_running_loop().call_later(0.05, os._exit, 137)
+            return {"ok": True, "target": "daemon"}
+        wid = payload.get("worker_id")
+        victims = [w for w in self.workers.values() if w.state != W_DEAD
+                   and (wid is None or w.worker_id.binary() == wid)
+                   and (not payload.get("actor") or w.state == W_ACTOR)]
+        if not victims:
+            return {"ok": False, "error": "no matching live worker"}
+        victim = victims[0]
+        # simulate a CRASH, not an administrative kill: SIGKILL the process
+        # and run the same observation path the reap loop takes, so actor
+        # death / lease release / death records all fire exactly as they
+        # would for a real unexpected exit
+        try:
+            os.killpg(os.getpgid(victim.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        await self._on_worker_death(victim)
+        return {"ok": True, "target": victim.worker_id.hex()}
 
     async def rpc_drain(self, conn_id: int, payload) -> dict:
         """Graceful drain (reference: DrainRaylet node_manager.proto:510).

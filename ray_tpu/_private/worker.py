@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import atexit
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -93,6 +94,12 @@ def init(
         raise RayTpuError("ray_tpu.init() already called (pass ignore_reinit_error=True)")
     if system_config:
         GLOBAL_CONFIG.apply_system_config(system_config)
+    if "RT_CHAOS_ROLE" not in os.environ:
+        # the driver's stable chaos role (spawned processes inherit labels
+        # via RT_CHAOS_ROLE; see _private.chaos determinism contract)
+        from ray_tpu._private import chaos
+
+        chaos.set_role("driver")
 
     client_mode = address is not None and address.startswith("rt://")
     if client_mode:

@@ -409,12 +409,63 @@ class Dataset:
         # cache the materialization on THIS dataset too: repeated consumers
         # (sum then mean then std; schema after count) must not re-execute
         # the whole plan per call
+        if (self._refs is None and self._row_limit is not None
+                and self._limit_src is None and len(self._producers) > 1):
+            # limit pushdown into the PLAN, not just the surface: execute
+            # producers in stream order and stop submitting once the row
+            # budget is covered — ds.limit(10) over 1,000 blocks runs the
+            # prefix, never all 1,000 tasks (reference: the logical
+            # optimizer's limit pushdown + streaming early termination)
+            refs = self._materialize_limit_prefix(self._row_limit)
+            self._row_limit = None
+            self._refs = refs
+            return refs
         refs = self.materialize()._refs
         if self._row_limit is not None:
             refs = self._cut_refs(refs, self._row_limit)
             self._row_limit = None  # the cut is baked into the refs now
         self._refs = refs
         return refs
+
+    def _materialize_limit_prefix(self, n: int) -> List[Any]:
+        """Execute the plan over the shortest producer prefix whose rows
+        cover `n`, in submission windows: count each window's output and
+        stop before the next window once the budget is met. Blocks past the
+        boundary are never submitted."""
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.remote_function import RemoteFunction
+
+        window = max(1, DataContext.get_current().streaming_block_window)
+        cut = RemoteFunction(Dataset._truncate_block)
+        pipeline = _Pipeline(self._producers, self._stages())
+        out: List[Any] = []
+        remaining = n
+        try:
+            for start in range(0, len(self._producers), window):
+                if remaining <= 0:
+                    break
+                batch = [
+                    pipeline.submit_block(p)
+                    for p in self._producers[start:start + window]
+                ]
+                # the count barrier doubles as the pools'
+                # must-outlive-in-flight-blocks barrier per window
+                counts = self._block_row_counts(batch)
+                for ref, c in zip(batch, counts):
+                    if remaining <= 0:
+                        break  # computed past the boundary; dropped
+                    if c <= remaining:
+                        out.append(ref)
+                        remaining -= c
+                    else:
+                        out.append(cut.remote(ref, remaining))
+                        remaining = 0
+        finally:
+            # safe here: every pool-produced block resolved at its window's
+            # count barrier; the boundary cut is a plain task over an
+            # already-computed ref, so it survives pool shutdown
+            pipeline.shutdown()
+        return out
 
     def _cut_refs(self, refs: List[Any], n: int) -> List[Any]:
         """Global limit over materialized blocks: keep whole blocks up to

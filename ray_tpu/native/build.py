@@ -3,6 +3,13 @@
 The reference builds its native runtime with Bazel (reference: BUILD.bazel); here a
 minimal g++ invocation keeps the loop fast and hermetic. Artifacts land in
 ray_tpu/native/_build/ and are rebuilt only when sources change.
+
+Sanitizer mode (opt-in): env RAY_TPU_NATIVE_SANITIZE=1 compiles every library
+with ASan+UBSan (reference: the bazel asan/ubsan config the reference's CI
+runs its C++ unit tests under). Sanitized artifacts are cached under a
+distinct tag so they never mix with production builds. Loading them into a
+stock CPython requires LD_PRELOADing libasan — `sanitizer_preload()` returns
+the path; tests/test_sanitize.py drives the whole flow in a subprocess.
 """
 
 from __future__ import annotations
@@ -22,6 +29,30 @@ _LIBS = {
     "fastpath": ["fastpath.cc"],
 }
 
+_SANITIZE_FLAGS = [
+    "-fsanitize=address,undefined",
+    "-fno-sanitize-recover=all",
+    "-fno-omit-frame-pointer",
+]
+
+
+def sanitize_enabled() -> bool:
+    return os.environ.get("RAY_TPU_NATIVE_SANITIZE", "").strip() in (
+        "1", "true", "yes", "on")
+
+
+def sanitizer_preload() -> str:
+    """Path of the ASan runtime to LD_PRELOAD when loading sanitized
+    libraries into a non-instrumented python; '' when unavailable."""
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.CalledProcessError):
+        return ""
+    return out if out and os.path.sep in out and os.path.exists(out) else ""
+
 
 def lib_path(name: str) -> str:
     """Compile (if stale) and return the path of the shared library `name`."""
@@ -30,8 +61,12 @@ def lib_path(name: str) -> str:
     for s in sources:
         with open(s, "rb") as f:
             h.update(f.read())
+    sanitize = sanitize_enabled()
+    if sanitize:
+        h.update(b"sanitize:" + " ".join(_SANITIZE_FLAGS).encode())
     tag = h.hexdigest()[:16]
-    out = os.path.join(_BUILD, f"lib{name}-{tag}.so")
+    suffix = "-san" if sanitize else ""
+    out = os.path.join(_BUILD, f"lib{name}-{tag}{suffix}.so")
     if os.path.exists(out):
         return out
     with _LOCK:
@@ -41,6 +76,7 @@ def lib_path(name: str) -> str:
         tmp = out + f".tmp{os.getpid()}"
         cmd = [
             "g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
+            *(_SANITIZE_FLAGS if sanitize else []),
             "-o", tmp, *sources, "-lpthread", "-lrt",
         ]
         subprocess.run(cmd, check=True, capture_output=True, text=True)

@@ -25,7 +25,9 @@ import msgpack
 
 from ray_tpu._private import chaos
 from ray_tpu._private import fastpath as _fastpath
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.errors import RpcError
+from ray_tpu._private.retry import DeadlineExceeded, RetryPolicy
 
 
 class RpcConnectionLost(RpcError):
@@ -180,6 +182,7 @@ class RpcServer:
             writer.close()
 
     async def _dispatch(self, conn_id, writer, req_id, method, payload):
+        chaos.maybe_kill(method)  # injected process crash at a protocol point
         delay = chaos.event_loop_delay_us(method)
         if delay:
             await asyncio.sleep(delay / 1e6)
@@ -193,6 +196,10 @@ class RpcServer:
             result = await handler(conn_id, payload)
             if failure == "response":
                 return  # executed but reply dropped
+            stall = chaos.response_stall_s(method)
+            if stall:
+                # executed, reply delayed: the wedged-but-alive server mode
+                await asyncio.sleep(stall)
             resp = [_RESP, req_id, method, result]
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             if not isinstance(e, RpcError):
@@ -326,18 +333,35 @@ class RpcClient:
     def subscribe_channel(self, channel: str, callback: Callable[[Any], None]):
         self._subs[channel] = callback
 
-    async def call(self, method: str, payload: Any = None, timeout: float | None = 30.0) -> Any:
+    async def call(self, method: str, payload: Any = None,
+                   timeout: float | None = 30.0,
+                   deadline: float | None = None) -> Any:
         """Call with retry on connection failure/timeouts (idempotent methods only
         should rely on retries; mutating methods are deduplicated server-side by
-        caller-supplied idempotency keys in the payload)."""
+        caller-supplied idempotency keys in the payload).
+
+        Retries back off per the unified policy (_private.retry: capped
+        exponential + decorrelated jitter). `timeout` bounds each ATTEMPT;
+        `deadline` (a time.monotonic() stamp) bounds the WHOLE retry chain —
+        per-attempt timeouts and backoff sleeps are clipped to the remaining
+        budget, and expiry raises RpcError with DeadlineExceeded as cause."""
         if self._closed:
             raise RpcError(f"{self.name}: client closed")
+        backoff = RetryPolicy(
+            max(1e-3, self.retry_delay),
+            GLOBAL_CONFIG.get("retry_max_s"),
+        ).backoff(deadline=deadline)
         last_exc: Exception | None = None
         loop = asyncio.get_running_loop()
         for attempt in range(self.retries + 1):
             req_id = None
             timer = None
             try:
+                if chaos.partitioned(self.address):
+                    # injected one-way partition: this process cannot reach
+                    # the peer (models an unreachable network path)
+                    raise RpcConnectionLost(
+                        f"{self.name}: chaos partition to {self.address}")
                 # lock-free fast path: the connection is usually live
                 if self._writer is None or self._writer.is_closing():
                     async with self._lock:
@@ -355,9 +379,10 @@ class RpcClient:
                 if writer.transport.get_write_buffer_size() > 256 * 1024:
                     async with self._write_lock:
                         await writer.drain()
-                if timeout is not None:
+                attempt_timeout = backoff.clamp(timeout)
+                if attempt_timeout is not None:
                     timer = loop.call_later(
-                        timeout, self._expire_pending, req_id)
+                        attempt_timeout, self._expire_pending, req_id)
                 result = await fut
                 self._consecutive_timeouts = 0
                 return result
@@ -389,10 +414,26 @@ class RpcClient:
                     self._writer.close()
                     self._writer = None
                 if attempt < self.retries:
-                    await asyncio.sleep(self.retry_delay * (2**attempt))
+                    try:
+                        await backoff.sleep()
+                    except DeadlineExceeded as d:
+                        raise RpcError(
+                            f"{self.name}: call {method} to {self.address} "
+                            f"deadline exceeded after {attempt + 1} attempt(s)"
+                        ) from d
             finally:
                 if timer is not None:
                     timer.cancel()
+        # classify the terminal failure: connection-level exhaustion raises
+        # the retryable subclass so routing layers (lease spillback, owner
+        # fetch) re-route instead of burning task retries on a dead peer
+        if isinstance(last_exc, (ConnectionError, RpcConnectionLost, OSError,
+                                 asyncio.IncompleteReadError)) \
+                and not isinstance(last_exc, asyncio.TimeoutError):
+            raise RpcConnectionLost(
+                f"{self.name}: call {method} to {self.address} failed after "
+                f"retries (connection lost)"
+            ) from last_exc
         raise RpcError(
             f"{self.name}: call {method} to {self.address} failed after retries"
         ) from last_exc
@@ -406,6 +447,9 @@ class RpcClient:
         requeues specs through the task-retry path)."""
         if self._closed:
             raise RpcError(f"{self.name}: client closed")
+        if chaos.partitioned(self.address):
+            raise RpcConnectionLost(
+                f"{self.name}: chaos partition to {self.address}")
         loop = asyncio.get_running_loop()
         if self._writer is None or self._writer.is_closing():
             async with self._lock:

@@ -37,6 +37,11 @@ if not os.environ.get("RT_TEST_TPU"):
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_config():
     from ray_tpu._private import chaos

@@ -1,0 +1,282 @@
+"""Chaos soak suite: the recovery plane exercised adversarially on every
+CI run, deterministically replayable from a seed.
+
+Four scenarios x three seeds (reference: the nightly chaos suite around
+src/ray/rpc/rpc_chaos.h + python/ray/tests/test_gcs_fault_tolerance.py,
+miniaturized to run in tier-1):
+
+  1. node death mid-get           — owned object lost with its node while
+                                    concurrent getters are blocked on it
+  2. owner death with live borrow — authoritative worker-death notice
+                                    reconciles borrows (no probe timeout)
+  3. partition during reconstruction — one-way partition to the holder
+                                    node while lineage re-execution runs
+  4. control-store stall during failover — actor restart with the control
+                                    store wedged-but-alive
+
+Every scenario runs under seeded event-loop delays: the same seed replays
+the same injected schedule (chaos PRNGs are per-(seed, role)). Assertions
+are on STATE (recovery manager states, locations, borrow tables), never on
+bare sleeps.
+
+Tier-1 runs every scenario under the first seed; the remaining seeds are
+slow-marked so the default run stays inside its wall-clock budget. The
+full determinism matrix:
+
+    python -m pytest tests/test_chaos_soak.py -m '' -q     # 4 x 3 seeds
+"""
+
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import recovery
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.core_worker import get_core_worker
+from ray_tpu.cluster_utils import Cluster
+
+SEEDS = [
+    101,
+    pytest.param(202, marks=pytest.mark.slow),
+    pytest.param(303, marks=pytest.mark.slow),
+]
+
+_CHAOS = {
+    # every control-plane handler gets 0.5-8ms of injected delay — enough
+    # to shuffle orderings, small enough for tier-1 wall clock
+    "testing_event_loop_delay_us": "*:500:8000",
+    "health_check_period_s": 0.25,
+    "health_check_timeout_s": 2.0,
+    "lease_request_timeout_s": 5.0,
+    "borrow_reaper_period_s": 120.0,  # probes OFF the table: only the
+                                      # authoritative notice may reconcile
+}
+
+
+def _chaos_cluster(seed: int, head_resources=None, **extra):
+    cfg = dict(_CHAOS)
+    cfg["testing_chaos_seed"] = seed
+    cfg.update(extra)
+    GLOBAL_CONFIG.apply_system_config(cfg)
+    return Cluster(initialize_head=True,
+                   head_resources=head_resources or {"CPU": 2})
+
+
+@pytest.fixture(autouse=True)
+def _teardown():
+    yield
+    try:
+        ray_tpu.shutdown()
+    except Exception:  # noqa: BLE001 — scenario may have torn things down
+        pass
+
+
+def _holder_node(cw, ref):
+    loc = cw.memory_store.locations.get(ref.binary())
+    assert loc is not None, "expected a location-recorded (shm) object"
+    return loc["node_id"]
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_node_death_mid_get(seed):
+    """Concurrent getters blocked on an object whose node dies: all must
+    resolve through ONE coalesced recovery, and the object must relocate."""
+    cluster = _chaos_cluster(seed)
+    try:
+        nodes = [cluster.add_node(resources={"CPU": 2, "prod": 1}),
+                 cluster.add_node(resources={"CPU": 2, "prod": 1})]
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(resources={"prod": 0.5})
+        def produce(x):
+            return np.full(150_000, x, dtype=np.float64)
+
+        ref = produce.remote(3.5)
+        first = ray_tpu.get(ref, timeout=90)
+        assert first[0] == 3.5
+        del first
+        gc.collect()
+        cw = get_core_worker()
+        holder = _holder_node(cw, ref)
+        victims = [n for n in nodes if n.node_id == holder]
+        assert victims, f"object landed on head? {holder}"
+        cluster.kill_node(victims[0])
+        cw.store.delete(ref.object_id())
+
+        results, errs = [], []
+
+        def getter():
+            try:
+                results.append(ray_tpu.get(ref, timeout=90)[0])
+            except Exception as e:  # noqa: BLE001 — assert below
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=getter) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert results == [3.5] * 4
+        # state, not sleeps: the machine settled back to LOCAL and the
+        # object lives on a surviving node
+        assert cw.recovery.state_of(ref.binary()) == recovery.LOCAL
+        assert _holder_node(cw, ref) != holder
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_owner_death_with_live_borrow(seed):
+    """A borrower process dies holding a borrow: the owner's borrow table
+    reconciles on the AUTHORITATIVE death notice (workers pubsub), with the
+    probe reaper disabled — and the freed object's store copy releases."""
+    cluster = _chaos_cluster(seed, head_resources={"CPU": 2, "host": 1})
+    try:
+        cluster.add_node(resources={"CPU": 2, "borrower": 1})
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(resources={"borrower": 0.5}, max_restarts=0)
+        class Holder:
+            def __init__(self):
+                self.kept = []
+
+            def keep(self, ref_in_list):
+                # deserializing the contained ref registers the borrow
+                self.kept.append(ref_in_list[0])
+                return True
+
+        holder = Holder.remote()
+        big = ray_tpu.put(np.ones(200_000, dtype=np.float64))
+        assert ray_tpu.get(holder.keep.remote([big]), timeout=90)
+        cw = get_core_worker()
+        deadline = time.monotonic() + 30
+        while not cw.ref_counter.borrower_counts.get(big.binary()):
+            assert time.monotonic() < deadline, "borrow never registered"
+            time.sleep(0.1)
+
+        ray_tpu.kill(holder, no_restart=True)  # borrower process dies
+        # the worker-death record publishes -> _on_worker_notice drops the
+        # borrow; the 120s probe reaper cannot be the one doing it
+        deadline = time.monotonic() + 30
+        while cw.ref_counter.borrower_counts.get(big.binary()):
+            assert time.monotonic() < deadline, (
+                "borrow not reconciled by authoritative death notice")
+            time.sleep(0.1)
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_partition_during_reconstruction(seed):
+    """One-way partition head->holder-daemon DURING recovery: pulls to the
+    unreachable node fail fast (no timeout burn) and lineage re-execution
+    relocates the object to the reachable node."""
+    cluster = _chaos_cluster(seed)
+    try:
+        nodes = [cluster.add_node(resources={"CPU": 2, "prod": 1}),
+                 cluster.add_node(resources={"CPU": 2, "prod": 1})]
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(resources={"prod": 0.5})
+        def produce():
+            return np.arange(150_000, dtype=np.float64)
+
+        ref = produce.remote()
+        ray_tpu.wait([ref], timeout=90)
+        cw = get_core_worker()
+        holder = _holder_node(cw, ref)
+        victim = next(n for n in nodes if n.node_id == holder)
+
+        # partition the HEAD daemon away from the holder's daemon (one-way,
+        # at the RPC layer), then kill the holder: the recovery window runs
+        # entirely under the partition
+        cw.run_sync(cw.daemon.call("chaos_set", {"config": {
+            "testing_rpc_partition": f"*>{victim.address}",
+        }}), timeout=30)
+        cluster.kill_node(victim)
+        cw.store.delete(ref.object_id())
+
+        @ray_tpu.remote(num_cpus=1)
+        def consume(a):
+            return float(a.sum())
+
+        # downstream consumption drives recovery through arg resolution
+        total = ray_tpu.get(consume.remote(ref), timeout=90)
+        assert total == float(np.arange(150_000, dtype=np.float64).sum())
+        assert _holder_node(cw, ref) != holder
+        assert cw.recovery.state_of(ref.binary()) == recovery.LOCAL
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_control_store_stall_during_failover(seed):
+    """Actor failover while the control store is wedged-but-alive: replies
+    to actor-state lookups stall past the per-attempt timeout, bounded so
+    convergence is guaranteed. The restarted actor must serve calls and
+    hold exactly one incarnation of its state."""
+    cluster = _chaos_cluster(seed)
+    try:
+        cluster.add_node(resources={"CPU": 2, "spot": 1})
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(resources={"spot": 0.5}, max_restarts=2)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        a = Counter.remote()
+        assert ray_tpu.get(a.incr.remote(), timeout=90) == 1
+        cw = get_core_worker()
+
+        # wedge the control store: the next 6 actor-state lookups and
+        # worker registrations stall 600ms each (handlers still execute)
+        control = cw.control
+        cw.run_sync(control.call("chaos_set", {"config": {
+            "testing_rpc_stall": "get_actor_info:600:6,register_worker:600:6",
+        }}), timeout=30)
+
+        # kill the actor's worker through its daemon (scenario hook): the
+        # control store must fail the actor over to a fresh worker while
+        # its own replies stall
+        killed = False
+        for n in cluster.nodes:
+            async def _kill(addr=n.address):
+                from ray_tpu.runtime.rpc import RpcClient
+
+                c = RpcClient(addr, name="chaos-injector")
+                try:
+                    return await c.call("chaos_kill", {"actor": True},
+                                        timeout=10)
+                finally:
+                    await c.close()
+
+            reply = cw.run_sync(_kill(), timeout=30)
+            if reply.get("ok"):
+                killed = True
+                break
+        assert killed, "no actor worker could be chaos-killed"
+
+        # the actor restarts (fresh incarnation, counter resets) and serves
+        # calls; retries ride out both the failover and the stalls
+        deadline = time.monotonic() + 90
+        value = None
+        while time.monotonic() < deadline:
+            try:
+                value = ray_tpu.get(a.incr.remote(), timeout=60)
+                break
+            except ray_tpu.ActorUnavailableError:
+                time.sleep(0.5)
+        assert value == 1, f"restarted actor state wrong: {value}"
+        assert ray_tpu.get(a.incr.remote(), timeout=60) == 2
+    finally:
+        cluster.shutdown()

@@ -9,12 +9,23 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from ray_tpu._private import recovery
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.core_worker import get_core_worker
 from ray_tpu.cluster_utils import Cluster
 
 
 @pytest.fixture()
 def cluster():
+    # always exercised under seeded chaos delays: the node-death recovery
+    # path must hold under load (this test's historical flake was exactly
+    # a loaded-machine race), and a failure replays from the seed
+    GLOBAL_CONFIG.apply_system_config({
+        "testing_chaos_seed": 7,
+        "testing_event_loop_delay_us": "*:200:5000",
+        "health_check_period_s": 0.5,
+        "health_check_timeout_s": 4.0,
+    })
     c = Cluster(initialize_head=True, head_resources={"CPU": 2})
     yield c
     try:
@@ -61,8 +72,10 @@ def test_get_after_node_death_reconstructs(cluster):
 
     out = ray_tpu.get(ref, timeout=120)
     assert out[0] == 7.0 and out.shape == (200_000,)
-    # the rebuilt object must live on a surviving node
+    # the rebuilt object must live on a surviving node, and the recovery
+    # state machine must have settled — assertions on STATE, not sleeps
     assert _node_holding(ref) != holder_id
+    assert cw.recovery.state_of(ref.binary()) == recovery.LOCAL
 
 
 def test_dependent_task_after_node_death(cluster):
@@ -131,3 +144,6 @@ def test_at_most_once_task_not_reconstructed(cluster):
     cluster.kill_node(victims[0])
     with pytest.raises((ray_tpu.ObjectLostError, ray_tpu.GetTimeoutError)):
         ray_tpu.get(ref, timeout=15)
+    # no lineage (at-most-once): recovery is terminally FAILED for it
+    cw = get_core_worker()
+    assert cw.recovery.state_of(ref.binary()) == recovery.FAILED

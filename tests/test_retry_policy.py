@@ -1,0 +1,193 @@
+"""Unified retry policy: backoff sequence, jitter bounds, deadline
+propagation, and the RPC client's behavior under connection failure and
+server response stalls (the control-store-stalls-mid-failover mode).
+
+Reference: src/ray/rpc/retryable_grpc_client.h (exponential backoff with
+jitter bounded by server_unavailable_timeout).
+"""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from ray_tpu._private import chaos
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.retry import (
+    Backoff,
+    DeadlineExceeded,
+    RetryPolicy,
+    deadline_from_timeout,
+)
+from ray_tpu.runtime.rpc import RpcClient, RpcConnectionLost, RpcError, RpcServer
+
+
+def test_backoff_sequence_and_jitter_bounds():
+    policy = RetryPolicy(base_s=0.1, max_s=2.0, multiplier=3.0)
+    b = policy.backoff(rng=random.Random(7))
+    prev = policy.base_s
+    delays = []
+    for _ in range(50):
+        d = b.next_delay()
+        delays.append(d)
+        # decorrelated jitter: base <= d <= min(cap, prev * mult)
+        assert policy.base_s <= d <= policy.max_s
+        assert d <= max(policy.base_s, min(policy.max_s, prev * 3.0)) + 1e-9
+        prev = d
+    # the schedule must actually grow toward the cap (not stay at base)
+    assert max(delays) > 1.0
+    assert b.attempts == 50
+
+
+def test_backoff_deterministic_from_chaos_seed():
+    GLOBAL_CONFIG.apply_system_config({"testing_chaos_seed": 123})
+    chaos.reset()
+    chaos.set_role("driver")
+    seq1 = [RetryPolicy(0.1, 5.0).backoff().next_delay() for _ in range(6)]
+    chaos.reset()
+    chaos.set_role("driver")
+    seq2 = [RetryPolicy(0.1, 5.0).backoff().next_delay() for _ in range(6)]
+    assert seq1 == seq2
+    # a different seed draws a different schedule
+    GLOBAL_CONFIG.apply_system_config({"testing_chaos_seed": 124})
+    chaos.reset()
+    chaos.set_role("driver")
+    seq3 = [RetryPolicy(0.1, 5.0).backoff().next_delay() for _ in range(6)]
+    assert seq1 != seq3
+
+
+def test_deadline_propagation():
+    b = RetryPolicy(0.5, 5.0).backoff(
+        deadline=time.monotonic() + 0.25, rng=random.Random(3))
+    # delays are clipped to the remaining budget
+    assert b.next_delay() <= 0.25
+    # per-attempt timeouts clamp to the remaining budget too
+    assert b.clamp(30.0) <= 0.25
+    assert b.clamp(None) is not None
+    b2 = RetryPolicy(0.5, 5.0).backoff(deadline=time.monotonic() - 0.01)
+    assert b2.expired()
+    with pytest.raises(DeadlineExceeded):
+        b2.next_delay()
+    # unbounded backoff: no deadline, no clamping
+    b3 = RetryPolicy(0.5, 5.0).backoff()
+    assert b3.remaining() is None and b3.clamp(None) is None
+    assert not b3.expired()
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_rpc_client_deadline_bounds_retry_chain():
+    """A server that never answers: the call chain must stop at the
+    deadline (per-attempt timeouts + backoff sleeps clipped), not after
+    retries x timeout."""
+
+    async def scenario():
+        server = RpcServer("wedged")
+
+        async def never(conn_id, payload):
+            await asyncio.sleep(60)
+
+        server.register("hang", never)
+        addr = await server.start()
+        client = RpcClient(addr, name="t", retries=10, retry_delay=0.05)
+        t0 = time.monotonic()
+        with pytest.raises(RpcError) as ei:
+            await client.call("hang", {}, timeout=0.3,
+                              deadline=time.monotonic() + 1.0)
+        elapsed = time.monotonic() - t0
+        await client.close()
+        await server.stop()
+        return elapsed, ei.value
+
+    elapsed, exc = _run(scenario())
+    assert elapsed < 3.0, f"deadline not propagated: took {elapsed:.1f}s"
+    # the terminal error carries the deadline (or timeout) cause
+    assert isinstance(exc.__cause__, (DeadlineExceeded, asyncio.TimeoutError))
+
+
+def test_rpc_connection_failure_classified_retryable():
+    """Connection-level exhaustion must raise RpcConnectionLost (the
+    retryable subclass routing layers key off), not a bare RpcError."""
+
+    async def scenario():
+        client = RpcClient("127.0.0.1:1", name="t", retries=2, retry_delay=0.01)
+        t0 = time.monotonic()
+        with pytest.raises(RpcConnectionLost):
+            await client.call("x", {}, timeout=1.0)
+        await client.close()
+        return time.monotonic() - t0
+
+    elapsed = _run(scenario())
+    assert elapsed < 10.0
+
+
+def test_control_store_stall_mid_failover():
+    """The wedged-but-alive mode: the server EXECUTES but stalls replies
+    (chaos testing_rpc_stall). Short per-attempt timeouts + idempotent
+    retries must converge once the stall budget is spent, and the handler
+    side effects must not be double-applied by the caller (the reply of a
+    stalled attempt is simply ignored)."""
+    GLOBAL_CONFIG.apply_system_config({
+        "testing_chaos_seed": 11,
+        "testing_rpc_stall": "reg:700:2",
+    })
+    chaos.reset()
+
+    async def scenario():
+        server = RpcServer("cs-standin")
+        calls = {"n": 0}
+
+        async def reg(conn_id, payload):
+            calls["n"] += 1
+            return {"ok": True, "n": calls["n"]}
+
+        server.register("reg", reg)
+        addr = await server.start()
+        client = RpcClient(addr, name="t", retries=5, retry_delay=0.05)
+        reply = await client.call("reg", {"worker": "w1"}, timeout=0.25)
+        await client.close()
+        await server.stop()
+        return reply, calls["n"]
+
+    reply, executed = _run(scenario())
+    assert reply["ok"]
+    # first two replies stalled past the per-attempt timeout -> at least
+    # three executions before one reply landed inside the timeout
+    assert executed >= 3
+    assert any(ev[0] == "stall_s" for ev in chaos.events())
+
+
+def test_deadline_from_timeout_helper():
+    assert deadline_from_timeout(None) is None
+    d = deadline_from_timeout(5.0)
+    assert 4.0 < d - time.monotonic() <= 5.0
+
+
+def test_chaos_event_log_replays_from_seed():
+    """The decision SEQUENCE (delays, drops) is identical when replayed
+    from the same seed+role — the reproduce-any-failure contract."""
+    GLOBAL_CONFIG.apply_system_config({
+        "testing_chaos_seed": 77,
+        "testing_event_loop_delay_us": "*:100:5000",
+        "testing_rpc_failure": "m:8:0.4:0.4",
+    })
+    chaos.reset()
+    chaos.set_role("daemon1")
+    run1 = ([chaos.event_loop_delay_us("m") for _ in range(10)],
+            [chaos.rpc_failure("m") for _ in range(10)])
+    chaos.reset()
+    chaos.set_role("daemon1")
+    run2 = ([chaos.event_loop_delay_us("m") for _ in range(10)],
+            [chaos.rpc_failure("m") for _ in range(10)])
+    assert run1 == run2
+    chaos.reset()
+    chaos.set_role("daemon2")
+    run3 = [chaos.event_loop_delay_us("m") for _ in range(10)]
+    assert run3 != run1[0]

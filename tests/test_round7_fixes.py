@@ -1,0 +1,121 @@
+"""Round-7 satellite regressions: materialize-path limit pruning and
+cross-incarnation actor task-id uniqueness."""
+
+import glob
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data.dataset import Dataset
+
+
+@pytest.fixture()
+def local_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _marked_producers(n_blocks, rows_per_block, marker_dir):
+    def make(i):
+        def produce():
+            open(os.path.join(marker_dir, f"b{i}"), "w").close()
+            return {"x": np.arange(rows_per_block) + i * rows_per_block}
+        return produce
+
+    return [make(i) for i in range(n_blocks)]
+
+
+def test_limit_prunes_materialize_plan_to_prefix(local_cluster):
+    """ds.limit(k) consumed through the materialize path (_block_refs:
+    count/aggregates/split) must execute only the block prefix covering
+    the budget — not all N tasks then cut (VERDICT Weak #7)."""
+    marker_dir = tempfile.mkdtemp()
+    ds = Dataset(_marked_producers(100, 5, marker_dir))
+    assert ds.limit(12).count() == 12
+    executed = len(glob.glob(os.path.join(marker_dir, "b*")))
+    assert executed < 100, (
+        f"full plan ran ({executed} blocks) despite limit(12)")
+    # stream-order prefix semantics: first 12 rows exactly
+    marker_dir2 = tempfile.mkdtemp()
+    rows = Dataset(_marked_producers(40, 5, marker_dir2)).limit(7).take_all()
+    assert [r["x"] for r in rows] == list(range(7))
+
+
+def test_limit_prefix_edge_cases(local_cluster):
+    marker_dir = tempfile.mkdtemp()
+    # limit larger than the dataset: everything executes, all rows kept
+    ds = Dataset(_marked_producers(6, 3, marker_dir))
+    assert ds.limit(1000).count() == 18
+    # limit 0: nothing returned
+    marker_dir2 = tempfile.mkdtemp()
+    ds0 = Dataset(_marked_producers(6, 3, marker_dir2))
+    assert ds0.limit(0).count() == 0
+    # boundary block is sliced, not dropped or kept whole
+    marker_dir3 = tempfile.mkdtemp()
+    ds3 = Dataset(_marked_producers(10, 4, marker_dir3))
+    assert ds3.limit(6).count() == 6
+
+
+def test_limit_then_map_keeps_prefix_semantics(local_cluster):
+    marker_dir = tempfile.mkdtemp()
+    ds = Dataset(_marked_producers(30, 4, marker_dir))
+    out = ds.limit(5).map(lambda r: {"y": int(r["x"]) * 2}).take_all()
+    assert [r["y"] for r in out] == [0, 2, 4, 6, 8]
+    assert len(glob.glob(os.path.join(marker_dir, "b*"))) < 30
+
+
+def test_actor_task_ids_unique_across_restart(local_cluster):
+    """Regression (found by the chaos soak suite): actor sequence numbers
+    restart at 1 per incarnation, so task ids minted FROM the seq collided
+    across a restart — the executor's duplicate-reply cache then answered a
+    fresh post-restart call with a stale cached reply, and the ordering
+    window stalled. Ids now come from the caller-global task counter."""
+
+    @ray_tpu.remote(max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+    a = Counter.remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 2
+    pid0 = ray_tpu.get(a.pid.remote(), timeout=60)
+
+    # crash the actor process (not ray_tpu.kill: that marks it DEAD);
+    # the control store restarts it with a fresh worker
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+
+    async def _chaos_kill():
+        return await cw.daemon.call("chaos_kill", {"actor": True}, timeout=10)
+
+    assert cw.run_sync(_chaos_kill(), timeout=30).get("ok")
+
+    # post-restart calls mint seqs 1, 2, ... again; every reply must come
+    # from a REAL execution (strictly increasing counter), never from the
+    # pre-restart duplicate-reply cache
+    deadline = time.monotonic() + 90
+    first = None
+    while time.monotonic() < deadline:
+        try:
+            first = ray_tpu.get(a.incr.remote(), timeout=60)
+            break
+        except ray_tpu.ActorUnavailableError:
+            time.sleep(0.3)
+    assert first == 1, f"fresh incarnation must restart state: {first}"
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 2
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 3
+    assert ray_tpu.get(a.pid.remote(), timeout=60) != pid0
