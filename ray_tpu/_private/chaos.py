@@ -169,6 +169,27 @@ class _KillSpec:
         return False
 
 
+class _PreemptSpec:
+    """role:delay_ms:deadline_ms — a node daemon whose role matches gets a
+    synthetic preemption notice `delay_ms` after startup and must drain
+    within `deadline_ms` (models a GCE maintenance event / spot reclaim;
+    the delay makes the notice land mid-workload, deterministically)."""
+
+    def __init__(self, spec: str):
+        self.rules: List[list] = []
+        for entry in filter(None, (e.strip() for e in spec.split(","))):
+            role, delay_ms, deadline_ms = entry.rsplit(":", 2)
+            self.rules.append([role, float(delay_ms) / 1e3,
+                               float(deadline_ms) / 1e3])
+
+    def notice_for(self, role: str) -> Optional[Tuple[float, float]]:
+        for rule in self.rules:
+            r, delay_s, deadline_s = rule
+            if _match_role(r, role):
+                return (delay_s, deadline_s)
+        return None
+
+
 class ChaosController:
     """Per-process chaos state: seeded PRNG, parsed spec caches (keyed by
     the live config string so runtime `chaos_set` updates take effect), and
@@ -307,6 +328,22 @@ def partitioned(target: str) -> bool:
     if blocked:
         _controller._record("partition", target, "blocked")
     return blocked
+
+
+def preempt_notice() -> Optional[Tuple[float, float]]:
+    """Synthetic preemption notice for THIS process's role: returns
+    (delay_s, drain_deadline_s) when `testing_preempt_notice` aims at this
+    role, else None. The node daemon checks this once at startup and
+    schedules a self-drain — the deterministic counterpart of the GCE
+    maintenance-event watcher."""
+    spec = _controller._spec("testing_preempt_notice", _PreemptSpec)
+    if spec is None:
+        return None
+    with _controller._lock:
+        notice = spec.notice_for(_controller._role)
+    if notice:
+        _controller._record("preempt_notice", _controller._role, notice)
+    return notice
 
 
 def maybe_kill(method: str) -> None:

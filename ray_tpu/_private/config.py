@@ -185,6 +185,13 @@ _flag("fastpath_ring_slots", 65536, "Capacity of each lock-free submission ring 
 # --- retry policy (shared by RPC calls, object fetch, lease requests) ---
 _flag("retry_base_s", 0.2, "Unified retry policy: first backoff delay (reference: retryable_grpc_client backoff base).")
 _flag("retry_max_s", 5.0, "Unified retry policy: backoff cap (decorrelated jitter draws in [base, prev*3] clipped here).")
+_flag("shutdown_timeout_s", 30.0, "Total deadline on ray_tpu.shutdown(): bounds job-finish + close so a drain or control-store failover in progress cannot hang driver exit (deadline machinery from _private.retry).")
+
+# --- graceful drain & preemption (reference: DrainNode protocol, NodeDeathInfo) ---
+_flag("drain_deadline_s", 30.0, "Default drain deadline: how long a draining node lets running work finish before it replicates primaries, migrates actors, and exits with an expected-termination record.")
+_flag("drain_replicate_max_objects", 4096, "Max primary object copies a draining node proactively replicates to live peers before exiting (objects beyond the cap fall back to lineage reconstruction).")
+_flag("preemption_watcher_enabled", False, "Run the GCE maintenance-event/preemption watcher on each node daemon; a notice triggers an automatic drain with reason=preemption (reference: spot TPU-VM preemption gives 30-90s of warning).")
+_flag("preemption_poll_period_s", 1.0, "Preemption watcher metadata-server poll period.")
 
 # --- chaos / fault injection (day 1, per SURVEY §4) ---
 _flag("testing_chaos_seed", 0, "Seed for the per-process chaos PRNG (mixed with the process's chaos role). 0 = fresh entropy. A seeded run replays every injected delay/drop/jitter draw exactly — reproduce any chaos failure from its seed.")
@@ -193,6 +200,7 @@ _flag("testing_rpc_failure", "", "Inject RPC failures. Format: 'method:max_failu
 _flag("testing_rpc_stall", "", "Server-side RESPONSE stalls: 'method:ms:count,...' — the handler runs, then the reply stalls ms milliseconds, count times (models a wedged-but-alive control store).")
 _flag("testing_rpc_partition", "", "One-way RPC-layer partition: 'src>dst#count,...' — a client in a process whose chaos role matches src cannot reach peers whose address matches dst; heals after count blocked sends (omit for unbounded).")
 _flag("testing_process_kill", "", "Process-kill fault: 'role:method:nth,...' — the nth dispatch of method in a process whose chaos role matches exits hard (os._exit 137).")
+_flag("testing_preempt_notice", "", "Seeded preemption-notice fault: 'role:delay_ms:deadline_ms,...' — a node daemon whose chaos role matches receives a synthetic preemption notice delay_ms after startup and drains itself with the given deadline (models a GCE maintenance event / spot reclaim, deterministically).")
 
 # --- TPU ---
 _flag("tpu_chips_per_host", 0, "Override detected TPU chips per host (0 = autodetect).")

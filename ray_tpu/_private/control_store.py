@@ -49,15 +49,27 @@ class PubSub:
     def __init__(self, server: RpcServer):
         self._server = server
         self._subs: Dict[str, Set[int]] = {}
+        # per-channel monotonic publish sequence (gap detection): every
+        # notice is stamped with `_seq`; subscribers track the last seq they
+        # saw and a reconnect whose subscribe-reply seq doesn't match runs a
+        # full table reconcile — a death published during a control-store
+        # failover window must not be silently lost.
+        self.seq: Dict[str, int] = {}
 
     def subscribe(self, conn_id: int, channel: str) -> None:
         self._subs.setdefault(channel, set()).add(conn_id)
+
+    def channel_seq(self, channel: str) -> int:
+        return self.seq.get(channel, 0)
 
     def unsubscribe_conn(self, conn_id: int) -> None:
         for subs in self._subs.values():
             subs.discard(conn_id)
 
     def publish(self, channel: str, message: Any) -> None:
+        self.seq[channel] = self.seq.get(channel, 0) + 1
+        if isinstance(message, dict):
+            message = {**message, "_seq": self.seq[channel]}
         for conn_id in list(self._subs.get(channel, ())):
             if not self._server.push(conn_id, channel, message):
                 self._subs[channel].discard(conn_id)
@@ -66,7 +78,8 @@ class PubSub:
 class ActorRecord:
     __slots__ = (
         "spec", "state", "node_id", "worker_id", "worker_address",
-        "num_restarts", "death_cause", "name", "pending_create",
+        "num_restarts", "planned_restarts", "death_cause", "name",
+        "pending_create",
     )
 
     def __init__(self, spec: TaskSpec):
@@ -76,6 +89,11 @@ class ActorRecord:
         self.worker_id: Optional[bytes] = None
         self.worker_address: str = ""
         self.num_restarts = 0
+        # restarts caused by planned node removal (drain/preemption): they
+        # advance the incarnation like any restart (ordering semantics) but
+        # never charge the user's max_restarts budget — planned failure must
+        # be cheap (reference: NodeDeathInfo-driven restart accounting)
+        self.planned_restarts = 0
         self.death_cause = ""
         self.name = spec.name
         self.pending_create: Optional[asyncio.Task] = None
@@ -88,6 +106,7 @@ class ActorRecord:
             "worker_id": self.worker_id or b"",
             "worker_address": self.worker_address,
             "num_restarts": self.num_restarts,
+            "planned_restarts": self.planned_restarts,
             "death_cause": self.death_cause,
             "name": self.name,
             "class_key": self.spec.function_key,
@@ -118,6 +137,7 @@ class ActorRecord:
         self.worker_id = d["worker_id"] or None
         self.worker_address = d["worker_address"]
         self.num_restarts = d["num_restarts"]
+        self.planned_restarts = d.get("planned_restarts", 0)
         self.death_cause = d["death_cause"]
 
 
@@ -210,8 +230,15 @@ class ControlStore:
         # timeouts (a stalled-but-alive borrower must keep its borrows).
         self.worker_addresses: Dict[str, str] = {}  # address -> node_id hex
         self.worker_addr_by_id: Dict[bytes, str] = {}
-        self.dead_worker_addresses: "collections.OrderedDict[str, float]" = (
+        # address -> {"ts", "reason", "exit_code"}: structured death records
+        # so ObjectLostError/ActorDiedError can say WHY (preempted vs OOM vs
+        # crash vs drained) instead of a generic "worker died"
+        self.dead_worker_addresses: "collections.OrderedDict[str, dict]" = (
             collections.OrderedDict())
+        # draining-node replica reports: node_id -> {oid_hex: location dict}.
+        # Merged into the node's expected-death notice so owners fail over
+        # to the replicas with ZERO lineage reconstructions.
+        self.drained_replicas: Dict[bytes, dict] = {}
         # per-node scheduling load from heartbeats (autoscaler demand)
         self.node_load: Dict[bytes, dict] = {}
         # per-node physical stats from heartbeats (dashboard reporter)
@@ -398,32 +425,51 @@ class ControlStore:
                 if now - last > timeout:
                     await self._mark_node_dead(node_id, "health check timed out")
 
-    async def _mark_node_dead(self, node_id: bytes, reason: str):
+    async def _mark_node_dead(self, node_id: bytes, reason: str,
+                              expected: bool = False):
         info = self.nodes.get(node_id)
         if info is None or info.state == pb.NODE_DEAD:
             return
         info.state = pb.NODE_DEAD
+        # planned vs unexpected termination recorded in the node table
+        # (reference: NodeDeathInfo) — owners choose replica failover vs
+        # lineage reconstruction off this bit
+        info.death = pb.NodeDeathInfo(expected=expected, reason=reason,
+                                      ts=time.time())
         self.node_available.pop(node_id, None)
         self.node_load.pop(node_id, None)
         self.node_stats.pop(node_id, None)  # never serve a dead node's stats
         client = self._daemon_clients.pop(node_id, None)
         if client:
             await client.close()
-        logger.warning("node %s marked DEAD: %s", info.node_id.hex()[:8], reason)
+        log = logger.info if expected else logger.warning
+        log("node %s marked DEAD (%s): %s", info.node_id.hex()[:8],
+            "expected" if expected else "unexpected", reason)
         # every worker/driver process registered on the node died with it:
         # record their addresses so borrow reapers can reconcile
         node_hex = info.node_id.hex()
         for addr, nhex in list(self.worker_addresses.items()):
             if nhex == node_hex:
                 self.worker_addresses.pop(addr, None)
-                self._mark_worker_dead(addr)
-        self._event("node", "DEAD", reason, node_id=info.node_id.hex())
+                self._mark_worker_dead(addr, reason=f"node died: {reason}")
+        self._event("node", "DEAD", reason, node_id=info.node_id.hex(),
+                    expected=expected)
         self._persist("node", info.to_wire())
-        self.pubsub.publish("nodes", info.to_wire())
-        # Fail over actors that lived on the node.
+        notice = info.to_wire()
+        replicas = self.drained_replicas.get(node_id)
+        if expected and replicas:
+            # expected death with pre-replicated primaries: the notice tells
+            # owners exactly where each copy went, so readers fail over with
+            # zero reconstructions
+            notice["replicas"] = replicas
+        self.pubsub.publish("nodes", notice)
+        # Fail over actors that lived on the node. An EXPECTED death should
+        # find none (drain migrated them) — any straggler restarts without
+        # charging its max_restarts budget (planned removal must be cheap).
         for rec in list(self.actors.values()):
             if rec.node_id == node_id and rec.state in (pb.ACTOR_ALIVE, pb.ACTOR_PENDING):
-                await self._on_actor_worker_death(rec, f"node died: {reason}")
+                await self._on_actor_worker_death(
+                    rec, f"node died: {reason}", planned=expected)
         # Reschedule placement groups with bundles on the dead node: return
         # surviving bundles, reset to PENDING, and re-run placement
         # (reference: gcs_placement_group_manager.h node-death rescheduling).
@@ -568,7 +614,17 @@ class ControlStore:
         }
 
     async def rpc_get_all_nodes(self, conn_id: int, payload) -> dict:
-        return {"nodes": [n.to_wire() for n in self.nodes.values()]}
+        # expectedly-dead drained nodes carry their replica map so a gap
+        # reconcile (missed death notice during failover) still fails
+        # readers over instead of reconstructing
+        out = []
+        for nid, n in self.nodes.items():
+            wire = n.to_wire()
+            reps = self.drained_replicas.get(nid)
+            if reps and n.state == pb.NODE_DEAD and n.death and n.death.expected:
+                wire["replicas"] = reps
+            out.append(wire)
+        return {"nodes": out}
 
     async def rpc_get_node_stats(self, conn_id: int, payload) -> dict:
         """Per-node physical stats from heartbeats (reference: the reporter
@@ -578,16 +634,76 @@ class ControlStore:
         }}
 
     async def rpc_drain_node(self, conn_id: int, payload: dict) -> dict:
+        """DrainNode: planned removal with `{reason, deadline_s}` (reference:
+        node_manager.proto DrainNode + autoscaler.proto DrainNodeReason).
+        The notice goes out on the "nodes" channel; the daemon mirrors the
+        state into its lease gate and — when a deadline is present — runs
+        the full drain orchestration (finish work, replicate primaries,
+        exit expected). Actors on the node migrate immediately without
+        charging their restart budget."""
         node_id = payload["node_id"]
         info = self.nodes.get(node_id)
-        if info is None:
+        if info is None or info.state == pb.NODE_DEAD:
             return {"ok": False}
+        reason = payload.get("reason") or pb.DRAIN_REASON_MANUAL
+        deadline_s = float(payload.get("deadline_s") or 0.0)
         info.state = pb.NODE_DRAINING
-        self._event("node", "DRAINING", "drain requested",
-                    node_id=info.node_id.hex())
+        info.drain_reason = reason
+        info.drain_deadline = time.time() + deadline_s if deadline_s else 0.0
+        self._event("node", "DRAINING", f"drain requested ({reason})",
+                    node_id=info.node_id.hex(), reason=reason,
+                    deadline_s=deadline_s)
         self._persist("node", info.to_wire())
         self.pubsub.publish("nodes", info.to_wire())
+        if deadline_s:
+            # terminal drain (preemption/manual removal): migrate resident
+            # actors NOW so they restart warm elsewhere instead of crash-
+            # recovering when the node exits. Reversible idle-drains (no
+            # deadline) leave actors alone — there should be none anyway.
+            spawn(self._migrate_actors_off(node_id, reason))
         return {"ok": True}
+
+    async def _migrate_actors_off(self, node_id: bytes, reason: str):
+        """Planned actor migration off a draining node (reference: the
+        checkpoint-or-migrate half of graceful drain): each ALIVE actor is
+        killed on the draining node and recreated elsewhere as a PLANNED
+        restart — incarnation advances (ordering semantics stay crash-
+        equivalent) but max_restarts is not charged. PG-bound actors stay:
+        their bundle lives on this node until node death reschedules the
+        whole group."""
+        for rec in list(self.actors.values()):
+            if rec.node_id != node_id or rec.state != pb.ACTOR_ALIVE:
+                continue
+            if rec.spec.strategy.kind == pb.STRATEGY_PLACEMENT_GROUP:
+                continue
+            cause = f"node draining ({reason})"
+            if rec.node_id is not None and rec.worker_id:
+                try:
+                    daemon = await self._daemon(rec.node_id)
+                    await daemon.call(
+                        "kill_worker",
+                        {"worker_id": rec.worker_id, "reason": cause},
+                        timeout=5,
+                    )
+                except Exception:  # noqa: BLE001 — node may be going already
+                    pass
+            # restartable actors migrate (planned restart, budget untouched);
+            # max_restarts=0 actors die NOW with a cause naming the drain so
+            # their owner rebuilds during the warning window instead of at
+            # the node's hard death
+            await self._on_actor_worker_death(rec, cause, planned=True)
+
+    async def rpc_report_drain_replicas(self, conn_id: int, payload: dict) -> dict:
+        """A draining daemon replicated its primary copies to live peers;
+        remember where each went so the expected-death notice (and gap-
+        reconcile reads) can point owners at the replicas."""
+        node_id = payload["node_id"]
+        reps = self.drained_replicas.setdefault(node_id, {})
+        reps.update(payload.get("replicas") or {})
+        # bounded: one entry per draining node, pruned with the node record
+        while len(self.drained_replicas) > 64:
+            self.drained_replicas.pop(next(iter(self.drained_replicas)))
+        return {"ok": True, "count": len(reps)}
 
     async def rpc_undrain_node(self, conn_id: int, payload: dict) -> dict:
         """Reverse a drain that never reached termination — demand returned
@@ -597,13 +713,29 @@ class ControlStore:
         info = self.nodes.get(node_id)
         if info is None or info.state != pb.NODE_DRAINING:
             return {"ok": False}
+        if info.drain_deadline:
+            # deadline drains are TERMINAL: the daemon is already running
+            # its exit orchestration and cannot be called back — reviving
+            # the record would route fresh leases onto a node about to die
+            # and drop its replica map
+            return {"ok": False, "error": "drain is terminal (deadline set)"}
         info.state = pb.NODE_ALIVE
+        info.drain_reason = ""
+        info.drain_deadline = 0.0
+        self.drained_replicas.pop(node_id, None)
         self._persist("node", info.to_wire())
         self.pubsub.publish("nodes", info.to_wire())
         return {"ok": True}
 
     async def rpc_unregister_node(self, conn_id: int, payload: dict) -> dict:
-        await self._mark_node_dead(payload["node_id"], "unregistered")
+        """Administrative removal: an expected termination unless the
+        caller says otherwise (a drained daemon unregisters itself on exit
+        with the drain reason so the death record says WHY)."""
+        await self._mark_node_dead(
+            payload["node_id"],
+            payload.get("reason", "unregistered"),
+            expected=payload.get("expected", True),
+        )
         return {"ok": True}
 
     # ------------------------------------------------------------------
@@ -612,16 +744,23 @@ class ControlStore:
     # authoritative notices, never off ping timeouts)
     # ------------------------------------------------------------------
 
-    def _mark_worker_dead(self, address: str):
-        self.dead_worker_addresses[address] = time.time()
+    def _mark_worker_dead(self, address: str, reason: str = "",
+                          exit_code: Optional[int] = None):
+        self.dead_worker_addresses[address] = {
+            "ts": time.time(), "reason": reason, "exit_code": exit_code,
+        }
         self.dead_worker_addresses.move_to_end(address)
         while len(self.dead_worker_addresses) > 65536:
             self.dead_worker_addresses.popitem(last=False)
         # authoritative worker-failure notice (reference: the GCS
         # WORKER_DELTA pubsub channel): owners subscribe so borrow
         # reconciliation and recovery react to the recorded death instead
-        # of waiting out probe timeouts
-        self.pubsub.publish("workers", {"address": address, "dead": True})
+        # of waiting out probe timeouts. The structured {reason, exit_code}
+        # lets error messages say WHY (preempted vs OOM vs crash vs drained).
+        self.pubsub.publish("workers", {
+            "address": address, "dead": True,
+            "reason": reason, "exit_code": exit_code,
+        })
         # drop the id index entries too (node-death and job-finish paths
         # bypass rpc_report_worker_death's by-id pop): the control store
         # must not grow a stale entry per worker/driver forever
@@ -650,13 +789,26 @@ class ControlStore:
         return {"ok": True}
 
     async def rpc_report_worker_death(self, conn_id: int, payload: dict) -> dict:
-        """A node daemon observed one of its worker processes exit."""
+        """A node daemon observed one of its worker processes exit; the
+        report carries the structured cause (fate-sharing, OOM-kill, drain,
+        chaos process_kill, plain crash) and the exit code."""
         addr = payload.get("address") or self.worker_addr_by_id.pop(
             payload.get("worker_id", b""), None)
         if addr:
             self.worker_addresses.pop(addr, None)
-            self._mark_worker_dead(addr)
+            self._mark_worker_dead(addr, reason=payload.get("reason", ""),
+                                   exit_code=payload.get("exit_code"))
         return {"ok": True}
+
+    async def rpc_list_dead_workers(self, conn_id: int, payload: dict) -> dict:
+        """Recent authoritative worker-death records (gap reconcile: a
+        subscriber that missed "workers" notices during a failover window
+        replays these through its notice handler)."""
+        limit = int((payload or {}).get("limit", 1024))
+        items = list(self.dead_worker_addresses.items())[-limit:]
+        return {"workers": [
+            {"address": addr, "dead": True, **rec} for addr, rec in items
+        ]}
 
     async def rpc_check_worker_liveness(self, conn_id: int, payload: dict) -> dict:
         """Authoritative death lookup for a worker/driver RPC address:
@@ -766,8 +918,12 @@ class ControlStore:
         return {"ok": True, "role": chaos.role()}
 
     async def rpc_subscribe(self, conn_id: int, payload: dict) -> dict:
-        self.pubsub.subscribe(conn_id, payload["channel"])
-        return {"ok": True}
+        channel = payload["channel"]
+        self.pubsub.subscribe(conn_id, channel)
+        # reply carries the channel's current publish seq: a resubscribing
+        # client whose last-seen seq doesn't match knows it missed notices
+        # (or that the store restarted with fresh counters) and reconciles
+        return {"ok": True, "seq": self.pubsub.channel_seq(channel)}
 
     async def rpc_publish(self, conn_id: int, payload: dict) -> dict:
         self.pubsub.publish(payload["channel"], payload["message"])
@@ -806,7 +962,7 @@ class ControlStore:
             drv = job.get("driver_address")
             if drv:
                 self.worker_addresses.pop(drv, None)
-                self._mark_worker_dead(drv)
+                self._mark_worker_dead(drv, reason="driver exited (job finished)")
             # Kill detached-from-driver resources: actors owned by the job.
             for rec in list(self.actors.values()):
                 if (
@@ -921,7 +1077,8 @@ class ControlStore:
                         self.node_available[node_id] + rec.spec.resources
                     )
                 if (
-                    "insufficient resources" in str(reply.get("error", ""))
+                    not reply.get("permanent")
+                    and "insufficient resources" in str(reply.get("error", ""))
                     and time.monotonic() < deadline
                     and rec.state != pb.ACTOR_DEAD
                 ):
@@ -1030,12 +1187,22 @@ class ControlStore:
         await self._on_actor_worker_death(rec, payload.get("reason", "worker died"))
         return {"ok": True}
 
-    async def _on_actor_worker_death(self, rec: ActorRecord, reason: str):
+    async def _on_actor_worker_death(self, rec: ActorRecord, reason: str,
+                                     planned: bool = False):
         if rec.state == pb.ACTOR_DEAD:
             return
         max_restarts = rec.spec.max_restarts
-        if max_restarts == -1 or rec.num_restarts < max_restarts:
+        # planned removals (drain/preemption) never charge the user's
+        # restart budget: only unplanned crashes count against max_restarts.
+        # max_restarts=0 actors are non-restartable by contract — even a
+        # planned removal kills them (with a death cause saying WHY, so the
+        # owner can rebuild warm during the drain window).
+        unplanned = rec.num_restarts - rec.planned_restarts
+        if ((planned and max_restarts != 0)
+                or max_restarts == -1 or unplanned < max_restarts):
             rec.num_restarts += 1
+            if planned:
+                rec.planned_restarts += 1
             rec.state = pb.ACTOR_RESTARTING
             dead_node = rec.node_id
             rec.worker_id = None

@@ -543,6 +543,11 @@ class CoreWorker:
         from ray_tpu._private.recovery import ObjectRecoveryManager
 
         self.recovery = ObjectRecoveryManager(self)
+        # subscriber-side pubsub gap detection: channel -> last publish seq
+        # seen (every control-store notice is stamped with _seq)
+        self._channel_seq: Dict[str, Optional[int]] = {
+            "nodes": None, "workers": None,
+        }
         # granted-but-idle worker leases by scheduling key, reused by the
         # next same-shaped task (reference: normal_task_submitter lease
         # pools). Each entry: {"idle": [lease...], "waiters": deque[Future]}.
@@ -608,18 +613,18 @@ class CoreWorker:
         # tripping over a stale location; worker deaths reconcile borrows
         # immediately instead of waiting out the reaper's probe cycle
         self.control.subscribe_channel("nodes", self._on_node_notice)
-        await self.control.call("subscribe", {"channel": "nodes"})
         self.control.subscribe_channel("workers", self._on_worker_notice)
-        await self.control.call("subscribe", {"channel": "workers"})
-        # a restarted control store loses server-side subscription state
+        await self._subscribe_notices()
+        # a restarted control store loses server-side subscription state;
+        # on resubscribe the reply seq is compared against the last notice
+        # we saw — a mismatch means deaths were published while we were
+        # away (control-store failover window) and triggers a full
+        # node/worker table reconcile instead of trusting the stream
         self.control.on_reconnect(
             lambda: self.control.call("subscribe", {"channel": "actors"})
         )
         self.control.on_reconnect(
-            lambda: self.control.call("subscribe", {"channel": "nodes"})
-        )
-        self.control.on_reconnect(
-            lambda: self.control.call("subscribe", {"channel": "workers"})
+            lambda: self._subscribe_notices(resync=True)
         )
         # announce this process's RPC address so owners' borrow reapers can
         # distinguish authoritative death from mere unresponsiveness
@@ -648,15 +653,89 @@ class CoreWorker:
         _chaos.reset()
         return {"ok": True, "role": _chaos.role()}
 
+    def _note_channel_seq(self, channel: str, message: dict):
+        seq = message.get("_seq")
+        if seq is not None:
+            last = self._channel_seq.get(channel)
+            self._channel_seq[channel] = seq if last is None else max(last, seq)
+
+    async def _subscribe_notices(self, resync: bool = False):
+        """Subscribe to the node/worker death channels with gap detection:
+        the subscribe reply carries each channel's current publish seq. On
+        a reconnect whose seq doesn't match the last notice seen, a death
+        published during the outage (control-store failover window) was
+        silently lost — run a full node/worker table reconcile so borrows
+        and recovery still trigger."""
+        gap = False
+        pending: Dict[str, int] = {}
+        for channel in ("nodes", "workers"):
+            reply = await self.control.call("subscribe", {"channel": channel})
+            server_seq = reply.get("seq")
+            if server_seq is None:
+                continue
+            last = self._channel_seq.get(channel)
+            if resync and server_seq != last:
+                gap = True
+                logger.info(
+                    "%s-channel gap detected (last seen %s, server at %s)",
+                    channel, last, server_seq)
+            pending[channel] = server_seq
+        if gap and not await self._reconcile_death_records():
+            # reconcile failed (store still mid-failover): keep the OLD
+            # last-seen seqs so the next reconnect re-detects this gap —
+            # advancing them now would mark the missed window as seen
+            return
+        self._channel_seq.update(pending)
+
+    async def _reconcile_death_records(self) -> bool:
+        """Replay the authoritative node/worker death tables through the
+        same notice handlers the pubsub stream feeds (both are idempotent):
+        nothing recorded during a subscription gap stays unseen."""
+        try:
+            nodes = (await self.control.call(
+                "get_all_nodes", {})).get("nodes", [])
+            for nw in nodes:
+                self._on_node_notice(nw)
+            dead = (await self.control.call(
+                "list_dead_workers", {})).get("workers", [])
+            for rec in dead:
+                self._on_worker_notice(rec)
+            logger.info(
+                "reconciled death records after pubsub gap: %d node(s), "
+                "%d dead worker record(s)", len(nodes), len(dead))
+            return True
+        except Exception:  # noqa: BLE001 — control store mid-failover; the
+            # next reconnect retries the reconcile
+            logger.warning("death-record reconcile failed", exc_info=True)
+            return False
+
     def _on_node_notice(self, message: dict):
         """Control-store "nodes" pubsub: a DEAD notice is the authoritative
-        recovery trigger — poison lost locations, kick eager recovery, and
-        drop pooled leases/clients aimed at the dead daemon."""
-        if message.get("state") != pb.NODE_DEAD:
+        recovery trigger — poison lost locations (or fail them over to the
+        drain replicas carried on an EXPECTED death), kick eager recovery,
+        and drop pooled leases/clients aimed at the dead daemon. A DRAINING
+        notice reroutes future submissions away immediately so no task
+        retry is burned against a node that will refuse the lease."""
+        self._note_channel_seq("nodes", message)
+        state = message.get("state")
+        daemon_addr = message.get("address", "")
+        if state == pb.NODE_DRAINING:
+            if daemon_addr:
+                # cached leases on the draining node would be refused (or
+                # worse, accepted and then die at the deadline): reroute new
+                # work now, let in-flight tasks finish there
+                self._drop_pooled_leases_from(daemon_addr)
+            return
+        if state != pb.NODE_DEAD:
             return
         node_hex = NodeID(message["node_id"]).hex()
-        daemon_addr = message.get("address", "")
-        self.recovery.on_node_death(node_hex, daemon_addr)
+        death = message.get("death") or {}
+        self.recovery.on_node_death(
+            node_hex, daemon_addr,
+            reason=death.get("reason", ""),
+            expected=death.get("expected", False),
+            replicas=message.get("replicas"),
+        )
         if daemon_addr:
             # a cached lease on the dead node would push the next task (or a
             # recovery re-execution) into a store no daemon serves
@@ -666,6 +745,7 @@ class CoreWorker:
         """Control-store "workers" pubsub: a recorded worker/driver death
         reconciles its borrows NOW (the probe-based reaper loop stays as
         the fallback for missed pushes)."""
+        self._note_channel_seq("workers", message)
         if not message.get("dead"):
             return
         addr = message.get("address", "")
@@ -675,7 +755,8 @@ class CoreWorker:
         if dropped:
             logger.info(
                 "reaped %d borrow(s) held by dead borrower %s "
-                "(authoritative death notice)", dropped, addr)
+                "(authoritative death notice: %s)", dropped, addr,
+                message.get("reason") or "unspecified")
         dead = self._owner_clients.pop(addr, None)
         if dead is not None:
             spawn(dead.close())
@@ -817,12 +898,22 @@ class CoreWorker:
         if getattr(self, "_borrow_reaper_task", None) is not None:
             self._borrow_reaper_task.cancel()
         # return every cached lease so the daemons free the capacity now
-        # (snapshot: an in-flight submit can insert a pool key mid-await)
+        # (snapshot: an in-flight submit can insert a pool key mid-await).
+        # One shared deadline bounds the whole sweep: against live daemons
+        # each return is a millisecond call, and a closing worker must not
+        # burn a retry chain per lease on daemons that are already gone —
+        # they reclaim leases from the recorded worker death anyway.
+        from ray_tpu._private.retry import deadline_from_timeout
+
+        sweep_deadline = deadline_from_timeout(1.5)
         for pool in list(self._lease_pools.values()):
             for lease in list(pool["idle"]):
+                if time.monotonic() >= sweep_deadline:
+                    break
                 try:
                     await self._return_lease_quiet(
-                        lease["daemon_address"], lease["lease_id"])
+                        lease["daemon_address"], lease["lease_id"],
+                        deadline=sweep_deadline)
                 except Exception:  # noqa: BLE001
                     pass
         self._lease_pools.clear()
@@ -1094,10 +1185,11 @@ class CoreWorker:
         # this node's store, but a remote pull from the dead daemon would
         # only burn the deadline — fail over to recovery immediately
         if location.get("dead") and not is_local and not self.store.contains(oid):
+            why = location.get("death_reason") or "authoritative death record"
             raise ObjectLostError(
                 ref.hex(),
                 f"store node {location.get('node_id', '')[:8]} is dead "
-                "(authoritative death record)")
+                f"({why})")
         pulled = False
         # Pin-or-recover loop: between any check and the pinning get() the
         # spill loop may write the object to disk and delete it from shm, so
@@ -2791,6 +2883,12 @@ class CoreWorker:
                 # the lease locally even when only a remote node can host it
                 hops = 0
                 continue
+            if reply.get("infeasible_in_pg"):
+                # permanent: the request exceeds the bundle's TOTAL
+                # reservation and can never be granted — fail loudly
+                raise RayTpuError(
+                    f"task {spec.name or spec.function_key} can never be "
+                    f"placed: {reply.get('error')}")
             raise RayTpuError(f"lease request failed: {reply}")
 
     async def _lease_call_with_deadline(self, client, payload: dict) -> dict:
@@ -2840,10 +2938,12 @@ class CoreWorker:
         if reply.get("granted"):
             self.schedule(self._return_lease_quiet(daemon_address, reply["lease_id"]))
 
-    async def _return_lease_quiet(self, daemon_address: str, lease_id):
+    async def _return_lease_quiet(self, daemon_address: str, lease_id,
+                                  deadline: Optional[float] = None):
         try:
             client = await self._owner_client(daemon_address)
-            await client.call("return_lease", {"lease_id": lease_id}, timeout=5)
+            await client.call("return_lease", {"lease_id": lease_id},
+                              timeout=5, deadline=deadline)
         except Exception:  # noqa: BLE001 — daemon may be gone
             pass
 

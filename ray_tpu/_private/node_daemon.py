@@ -196,6 +196,14 @@ class NodeDaemon:
         # in-flight heartbeat reply issued BEFORE a pubsub drain update must
         # not roll the state back (reply snapshots are unordered vs pubsub)
         self._drain_sync_ts = 0.0
+        # terminal-drain orchestration (one per daemon lifetime): set when a
+        # deadline-carrying drain notice lands; run_daemon wires _exit_cb so
+        # the process exits cleanly once the drain completes
+        self._drain_task: Optional[asyncio.Task] = None
+        self._exit_cb = None
+        # subscriber-side pubsub gap detection: last publish seq seen on the
+        # "nodes" channel (control_store stamps every notice with _seq)
+        self._nodes_seq: Optional[int] = None
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
         # daemon addresses declared dead by the control store: pulls from
         # them fail fast instead of retrying into a void (authoritative
@@ -244,9 +252,9 @@ class NodeDaemon:
         # at member-change time instead of waiting for heartbeat gossip
         # (reference: GcsNodeManager node add/removed pubsub).
         self.control.subscribe_channel("nodes", self._on_node_update)
-        await self.control.call("subscribe", {"channel": "nodes"})
+        await self._subscribe_nodes()
         self.control.on_reconnect(
-            lambda: self.control.call("subscribe", {"channel": "nodes"})
+            lambda: self._subscribe_nodes(resync=True)
         )
         reg = await self.control.call("register_node", {"node": info.to_wire()})
         for nw in reg.get("nodes", []):
@@ -267,6 +275,20 @@ class NodeDaemon:
         self._oom_kills = 0
         self._tasks.append(spawn(self._memory_monitor_loop()))
         self._tasks.append(spawn(self._resource_gossip_loop()))
+        # preemption plane: a real (GCE maintenance-event metadata/SIGTERM)
+        # or synthetic (seeded chaos) preemption notice triggers a terminal
+        # drain — the 30-90s of warning spot TPU VMs give must not be
+        # thrown away (reference: autoscaler preemption handling)
+        notice = chaos.preempt_notice()
+        if notice is not None:
+            delay_s, deadline_s = notice
+            self._tasks.append(spawn(self._chaos_preempt(delay_s, deadline_s)))
+        if GLOBAL_CONFIG.get("preemption_watcher_enabled"):
+            from ray_tpu.tpu.preemption import PreemptionWatcher
+
+            self._preempt_watcher = PreemptionWatcher(
+                on_notice=self._self_drain)
+            self._tasks.append(spawn(self._preempt_watcher.run()))
         logger.info(
             "daemon %s up at %s store=%s resources=%s",
             self.node_id.hex()[:8], addr, self.store_name, self.total_resources.to_dict(),
@@ -289,22 +311,74 @@ class NodeDaemon:
         if self.cgroups is not None:
             self.cgroups.cleanup()
 
-    def _sync_drain_state(self, state: str):
+    def _sync_drain_state(self, info: NodeInfo):
         """Mirror the control store's view of this node into the local
-        lease gate (reference: DrainRaylet; undrain re-opens local grants)."""
+        lease gate (reference: DrainRaylet; undrain re-opens local grants).
+        A drain carrying a deadline is TERMINAL (preemption / planned
+        removal): beyond gating leases, it starts the full orchestration —
+        finish running work, replicate primary copies, exit with an
+        expected-termination record."""
         self._drain_sync_ts = time.monotonic()
-        draining = state == pb.NODE_DRAINING
+        draining = info.state == pb.NODE_DRAINING
+        if self._drain_task is not None and not draining:
+            # terminal drain is one-way: once the exit orchestration is in
+            # flight (e.g. a local preemption notice the store never heard
+            # about), an ALIVE snapshot must not reopen the lease gate on a
+            # node that is about to die — new tasks would be routed onto it
+            # only to be killed at the deadline
+            return
         if draining != self._draining:
             self._draining = draining
-            logger.info("node %s drain -> %s", self.node_id.hex()[:8], draining)
+            logger.info("node %s drain -> %s (%s)", self.node_id.hex()[:8],
+                        draining, info.drain_reason or "-")
             if not draining:
                 self._try_schedule()
+        if draining and info.drain_deadline and self._drain_task is None:
+            # wall-clock deadline from the control store -> local monotonic
+            deadline = time.monotonic() + max(
+                0.0, info.drain_deadline - time.time())
+            self._drain_task = spawn(
+                self._drain_and_exit(info.drain_reason, deadline))
+
+    async def _subscribe_nodes(self, resync: bool = False):
+        """Subscribe to the "nodes" channel, detecting publish gaps: the
+        subscribe reply carries the channel's current seq — a reconnect
+        whose reply seq doesn't match the last notice we saw means deaths/
+        drains were published while we were away (control-store failover
+        window), so reconcile against the full node table instead of
+        trusting the stream."""
+        reply = await self.control.call("subscribe", {"channel": "nodes"})
+        server_seq = reply.get("seq")
+        last_seen = self._nodes_seq
+        gap = (resync and server_seq is not None and server_seq != last_seen)
+        if gap:
+            logger.info("nodes-channel gap detected (last seen %s, server "
+                        "at %s); reconciling node table", last_seen, server_seq)
+            try:
+                nodes = (await self.control.call(
+                    "get_all_nodes", {})).get("nodes", [])
+            except Exception:  # noqa: BLE001 — store still mid-failover:
+                # keep the old last-seen seq so the next reconnect
+                # re-detects this gap instead of marking it seen
+                logger.warning("node-table reconcile failed", exc_info=True)
+                return
+            for nw in nodes:
+                self._on_node_update(nw)
+        if server_seq is not None:
+            # RESET the baseline to the server's seq (don't max): a store
+            # restart resets its counters, and a sticky high-water mark
+            # would re-detect a phantom gap — and re-run the full table
+            # reconcile — on every reconnect until the new counter caught up
+            self._nodes_seq = server_seq
 
     def _on_node_update(self, message: dict):
+        seq = message.get("_seq")
+        if seq is not None:
+            self._nodes_seq = max(self._nodes_seq or 0, seq)
         info = NodeInfo.from_wire(message)
         hexid = info.node_id.hex()
         if hexid == self.node_id.hex():
-            self._sync_drain_state(info.state)
+            self._sync_drain_state(info)
             return
         if info.state == pb.NODE_ALIVE:
             self.peer_nodes[hexid] = info
@@ -468,7 +542,7 @@ class NodeDaemon:
                             and beat_started > self._drain_sync_ts):
                         # stale-reply guard: a reply snapshotted before the
                         # last pubsub drain/undrain push must not revert it
-                        self._sync_drain_state(info.state)
+                        self._sync_drain_state(info)
                 self._try_schedule()
             except Exception as e:  # noqa: BLE001
                 logger.warning("heartbeat failed: %s", e)
@@ -670,14 +744,17 @@ class NodeDaemon:
         self._forget_worker(w)
         # intentional kills must reach the death records too: owners' borrow
         # reapers free this worker's borrows only on an authoritative notice
-        spawn(self._report_worker_death_quiet(w))
+        spawn(self._report_worker_death_quiet(w, reason=reason))
         logger.info("killed worker %s: %s", w.worker_id.hex()[:8], reason)
 
-    async def _report_worker_death_quiet(self, w: WorkerHandle):
+    async def _report_worker_death_quiet(self, w: WorkerHandle,
+                                         reason: str = "",
+                                         exit_code: Optional[int] = None):
         try:
             await self.control.call(
                 "report_worker_death",
-                {"worker_id": w.worker_id.binary()}, timeout=10)
+                {"worker_id": w.worker_id.binary(), "reason": reason,
+                 "exit_code": exit_code}, timeout=10)
         except Exception:  # noqa: BLE001 — control store may be restarting
             logger.debug("report_worker_death failed", exc_info=True)
 
@@ -709,25 +786,45 @@ class NodeDaemon:
         self._tpu_free_chips.extend(chips)
         self._tpu_free_chips.sort()
 
-    async def _on_worker_death(self, w: WorkerHandle):
+    async def _on_worker_death(self, w: WorkerHandle,
+                               reason: Optional[str] = None):
         prev_state = w.state
         w.state = W_DEAD
         self._forget_worker(w)
+        exit_code = w.proc.poll()
+        if exit_code is None:
+            # freshly signalled: reap briefly so the death record carries
+            # the real exit code instead of None
+            try:
+                exit_code = await asyncio.to_thread(w.proc.wait, 1.0)
+            except subprocess.TimeoutExpired:
+                pass
+        if reason is None:
+            # classify the unexpected exit so downstream errors say WHY
+            # (reference: WorkerExitType): SIGKILL with the daemon healthy is
+            # almost always the kernel OOM killer or an operator kill
+            if exit_code == -signal.SIGKILL:
+                reason = "worker killed (SIGKILL: OOM killer or external kill)"
+            elif exit_code == 137:
+                reason = "worker crashed (exit 137: killed/chaos process_kill)"
+            else:
+                reason = f"worker process exited ({exit_code})"
         logger.warning(
-            "worker %s died (state=%s, code=%s)",
-            w.worker_id.hex()[:8], prev_state, w.proc.poll(),
+            "worker %s died (state=%s, code=%s): %s",
+            w.worker_id.hex()[:8], prev_state, exit_code, reason,
         )
         if w.lease_id is not None:
             self._release_lease(w.lease_id)
         self._release_actor_resources(w)
         # authoritative death record: owners' borrow reapers free this
         # worker's borrows only once the exit is recorded here
-        await self._report_worker_death_quiet(w)
+        await self._report_worker_death_quiet(w, reason=reason,
+                                              exit_code=exit_code)
         if w.actor_id is not None:
             try:
                 await self.control.call(
                     "report_actor_death",
-                    {"actor_id": w.actor_id, "reason": f"worker process exited ({w.proc.poll()})"},
+                    {"actor_id": w.actor_id, "reason": reason},
                     timeout=10,
                 )
             except Exception:  # noqa: BLE001
@@ -1021,6 +1118,18 @@ class NodeDaemon:
         else:  # caller gave up (timeout) — reclaim
             self._release_lease(lease_id)
 
+    @staticmethod
+    def _pg_request_feasible(res: ResourceSet, pg: dict,
+                             indices: List[int]) -> bool:
+        """True when *res* fits inside the TOTAL reservation of at least
+        one candidate bundle — False means the request can NEVER be
+        granted from this group (permanent infeasibility, not a
+        currently-occupied bundle)."""
+        return any(
+            i in pg["bundles"] and res.is_subset_of(pg["bundles"][i])
+            for i in indices
+        )
+
     async def _grant_pg_lease(self, res: ResourceSet, strategy: pb.SchedulingStrategy,
                               job_id: bytes,
                               runtime_env: Optional[dict] = None) -> dict:
@@ -1042,6 +1151,13 @@ class NodeDaemon:
                 else:
                     free[i] = free[i] + res
                 return reply
+        if not self._pg_request_feasible(res, pg, indices):
+            # the request exceeds the bundle's TOTAL reservation: it can
+            # never be granted here — surface a permanent infeasibility
+            # instead of letting the caller retry forever
+            return {"infeasible_in_pg": True,
+                    "error": (f"resources {res.to_dict()} exceed the "
+                              f"placement group bundle reservation")}
         return {"error": "insufficient placement group resources", "retry": True}
 
     def _release_lease(self, lease_id: bytes):
@@ -1188,6 +1304,16 @@ class NodeDaemon:
                     got = i
                     break
             if got is None:
+                # transient (bundle currently occupied) vs PERMANENT (the
+                # request exceeds the bundle's total reservation — e.g. it
+                # asks for a resource the bundle never held): a permanent
+                # mismatch must fail the creation loudly, not retry forever
+                if not self._pg_request_feasible(
+                        spec.resources, pg, indices):
+                    return {"ok": False, "permanent": True,
+                            "error": (
+                                f"resources {spec.resources.to_dict()} exceed "
+                                f"the placement group bundle reservation")}
                 return {"ok": False,
                         "error": "insufficient resources in placement group bundle"}
             actor_pg = (pg_id, got)
@@ -1849,22 +1975,236 @@ class NodeDaemon:
             os.killpg(os.getpgid(victim.proc.pid), signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
-        await self._on_worker_death(victim)
+        await self._on_worker_death(victim,
+                                    reason="worker crashed (chaos process_kill)")
         return {"ok": True, "target": victim.worker_id.hex()}
 
     async def rpc_drain(self, conn_id: int, payload) -> dict:
-        """Graceful drain (reference: DrainRaylet node_manager.proto:510).
-        Routed through the control store so the cluster-wide record agrees —
-        a locally-set flag alone would be reverted by the next heartbeat's
-        authoritative state sync."""
+        """Graceful drain (reference: DrainRaylet node_manager.proto:510)
+        carrying `{reason, deadline_s}`. Routed through the control store so
+        the cluster-wide record agrees — a locally-set flag alone would be
+        reverted by the next heartbeat's authoritative state sync. With a
+        deadline the drain is terminal: the daemon finishes running work,
+        replicates its primary copies, and exits with an expected-
+        termination death record."""
+        payload = payload or {}
+        reason = payload.get("reason") or pb.DRAIN_REASON_MANUAL
+        deadline_s = float(payload.get("deadline_s") or 0.0)
+        return await self._self_drain(reason, deadline_s)
+
+    async def _self_drain(self, reason: str, deadline_s: float) -> dict:
         try:
             await self.control.call(
-                "drain_node", {"node_id": self.node_id.binary()}, timeout=10
+                "drain_node",
+                {"node_id": self.node_id.binary(), "reason": reason,
+                 "deadline_s": deadline_s},
+                timeout=10,
             )
         except Exception as e:  # noqa: BLE001 — partitioned from the store
-            return {"ok": False, "error": str(e)}
-        self._sync_drain_state(pb.NODE_DRAINING)
+            # a preemption notice is real whether or not the control store
+            # heard about it: gate leases and run the orchestration locally;
+            # unregister_node (retried inside) records the death when the
+            # partition heals
+            logger.warning("drain_node RPC failed (%s); draining locally", e)
+            self._draining = True
+            self._drain_sync_ts = time.monotonic()
+            if deadline_s and self._drain_task is None:
+                self._drain_task = spawn(self._drain_and_exit(
+                    reason, time.monotonic() + deadline_s))
+            # keep trying to file the drain cluster-wide: owners only
+            # reroute leases/retries away from this node once the store
+            # publishes the DRAINING notice
+            spawn(self._register_drain_late(reason, deadline_s))
+            return {"ok": True, "local_only": True}
+        info = NodeInfo.from_wire(self._node_info.to_wire())
+        info.state = pb.NODE_DRAINING
+        info.drain_reason = reason
+        info.drain_deadline = time.time() + deadline_s if deadline_s else 0.0
+        self._sync_drain_state(info)
         return {"ok": True}
+
+    async def _register_drain_late(self, reason: str, deadline_s: float):
+        """A locally-initiated drain whose drain_node RPC failed (store
+        partitioned at notice time) retries the cluster-wide registration
+        until it lands or the drain budget runs out — without it no
+        DRAINING notice ever tells owners to reroute. The retry budget is
+        independent of the drain semantics: a reversible drain
+        (deadline_s == 0) must stay reversible, so the registration
+        forwards the ORIGINAL deadline (remaining wall-clock time for a
+        terminal drain, 0.0 unchanged for a reversible one) — never the
+        retry-loop budget."""
+        drain_deadline = (
+            time.monotonic() + deadline_s if deadline_s else None)
+        retry_until = time.monotonic() + max(deadline_s, 10.0)
+        while time.monotonic() < retry_until and not self._stopped:
+            await asyncio.sleep(1.0)
+            if (drain_deadline is not None
+                    and time.monotonic() >= drain_deadline):
+                # the node is about to exit anyway; the expected-death
+                # unregister tells the cluster the story
+                return
+            try:
+                await self.control.call(
+                    "drain_node",
+                    {"node_id": self.node_id.binary(), "reason": reason,
+                     "deadline_s": (
+                         max(0.1, drain_deadline - time.monotonic())
+                         if drain_deadline is not None else 0.0)},
+                    timeout=5,
+                )
+                return
+            except Exception:  # noqa: BLE001 — still partitioned
+                continue
+
+    # ------------------------------------------------------------------
+    # terminal drain orchestration (reference: the raylet's drain handling
+    # — stop granting, let running leases finish to the deadline, hand off
+    # primary copies, then die an EXPECTED death)
+    # ------------------------------------------------------------------
+
+    async def _chaos_preempt(self, delay_s: float, deadline_s: float):
+        """Seeded `testing_preempt_notice` fault: a deterministic stand-in
+        for the GCE maintenance event — the notice lands mid-workload and
+        must produce a non-event, not a recovery storm."""
+        await asyncio.sleep(delay_s)
+        logger.warning("synthetic preemption notice (chaos): draining with "
+                       "%.1fs deadline", deadline_s)
+        await self._self_drain(pb.DRAIN_REASON_PREEMPTION, deadline_s)
+
+    async def _drain_and_exit(self, reason: str, deadline: float):
+        try:
+            # the deadline is HARD (a preempted VM is killed at it): budget
+            # the phases inside it instead of letting a long-running lease
+            # starve the replication/report handoff that makes the drain
+            # cheap. The final control calls are small — reserve a tail
+            # slice; everything clamps to the overall deadline.
+            budget = max(0.0, deadline - time.monotonic())
+            lease_deadline = time.monotonic() + budget * 0.6
+            report_deadline = min(deadline, time.monotonic() + 30.0)
+            await self._wait_for_leases(lease_deadline)
+            replicas = await self._replicate_primaries(
+                max(time.monotonic(), deadline - min(5.0, budget * 0.1)))
+            if replicas:
+                try:
+                    # deadline-retried: a control-store failover mid-drain
+                    # must not lose the replica map (owners would fall back
+                    # to reconstructing everything)
+                    await self.control.call(
+                        "report_drain_replicas",
+                        {"node_id": self.node_id.binary(),
+                         "replicas": replicas},
+                        timeout=10,
+                        deadline=max(report_deadline,
+                                     time.monotonic() + 2.0),
+                    )
+                except Exception:  # noqa: BLE001 — store blip: replicas
+                    # still exist, owners just reconstruct instead
+                    logger.warning("report_drain_replicas failed",
+                                   exc_info=True)
+            try:
+                await self.control.call(
+                    "unregister_node",
+                    {"node_id": self.node_id.binary(), "expected": True,
+                     "reason": f"drained ({reason})"},
+                    timeout=10,
+                    deadline=max(min(deadline, time.monotonic() + 30.0),
+                                 time.monotonic() + 2.0),
+                )
+            except Exception:  # noqa: BLE001 — health checker will record
+                # an (unexpected) death instead; replicas still serve
+                logger.warning("drain unregister_node failed", exc_info=True)
+            logger.info("drain complete (%s): exiting", reason)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — never die silently mid-drain
+            logger.exception("drain orchestration failed; exiting anyway")
+        finally:
+            self._stopped = True
+            if self._exit_cb is not None:
+                self._exit_cb()
+
+    async def _wait_for_leases(self, deadline: float):
+        """Let running work finish: leases stop being granted the moment the
+        drain notice lands, so the busy set only shrinks."""
+        while time.monotonic() < deadline:
+            busy = [w for w in self.workers.values() if w.state == W_LEASED]
+            if not busy and not self.leases:
+                return
+            await asyncio.sleep(0.05)
+        n = len([w for w in self.workers.values() if w.state == W_LEASED])
+        if n:
+            logger.warning(
+                "drain deadline reached with %d lease(s) still running; "
+                "their tasks will retry elsewhere", n)
+
+    async def _replicate_primaries(self, deadline: float) -> dict:
+        """Proactively copy store-resident (and spilled) objects to live
+        peers so owners fail over to the replicas with ZERO lineage
+        reconstructions (reference: the object manager's primary-copy
+        handoff on drain). Returns {oid_hex: {"node_id", "daemon"}}."""
+        peers = [
+            info for hexid, info in self.peer_nodes.items()
+            if info.state == pb.NODE_ALIVE and hexid != self.node_id.hex()
+        ]
+        if not peers or self.store is None:
+            return {}
+        cap = GLOBAL_CONFIG.get("drain_replicate_max_objects")
+        oids = [oid for oid, _sz in self.store.list_evictable(max_n=cap)]
+        seen = {o.binary() for o in oids}
+        spill_extra = [ob for ob in list(self.spilled) if ob not in seen]
+        oids.extend(ObjectID(ob) for ob in spill_extra)  # restored on fetch
+        # the evictable listing is itself capped at `cap`: count candidates
+        # from the store's total object count so objects past the listing
+        # cap are not silently missing from the dropped tally
+        total = (self.store.stats().get("num_objects", len(oids))
+                 + len(spill_extra))
+        if len(oids) > cap:
+            oids = oids[:cap]
+        dropped = total - len(oids)
+        if dropped > 0:
+            logger.warning(
+                "drain: %d object(s) beyond the replicate cap will rely on "
+                "lineage reconstruction", dropped)
+        replicas: dict = {}
+
+        async def replicate_one(i: int, oid: ObjectID):
+            peer = peers[i % len(peers)]
+            try:
+                client = self._peer_clients.get(peer.address)
+                if client is None:
+                    client = RpcClient(peer.address, name="daemon->peer")
+                    await client.connect()
+                    self._peer_clients[peer.address] = client
+                r = await client.call(
+                    "pull_object",
+                    {"object_id": oid.binary(), "from_address": self.address},
+                    timeout=max(1.0, min(30.0, deadline - time.monotonic())),
+                )
+                if r.get("ok"):
+                    replicas[oid.hex()] = {
+                        "node_id": peer.node_id.hex(),
+                        "daemon": peer.address,
+                    }
+            except Exception:  # noqa: BLE001 — this object reconstructs
+                logger.debug("drain replication of %s failed",
+                             oid.hex()[:12], exc_info=True)
+
+        batch = 16
+        for b0 in range(0, len(oids), batch):
+            if time.monotonic() >= deadline:
+                logger.warning(
+                    "drain deadline reached mid-replication: %d object(s) "
+                    "unreplicated will rely on lineage reconstruction",
+                    len(oids) - b0)
+                break
+            await asyncio.gather(*[
+                replicate_one(b0 + j, oid)
+                for j, oid in enumerate(oids[b0:b0 + batch])
+            ])
+        if replicas:
+            logger.info("drain: replicated %d/%d primary object(s) to %d "
+                        "peer(s)", len(replicas), len(oids), len(peers))
+        return replicas
 
 
 async def run_daemon(args):
@@ -1887,6 +2227,9 @@ async def run_daemon(args):
                 f,
             )
     stop = asyncio.Event()
+    # a completed terminal drain exits the daemon process cleanly (the
+    # expected-termination record is already filed with the control store)
+    daemon._exit_cb = stop.set
 
     def _term(*_):
         stop.set()

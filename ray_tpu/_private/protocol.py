@@ -313,6 +313,37 @@ NODE_ALIVE = "ALIVE"
 NODE_DEAD = "DEAD"
 NODE_DRAINING = "DRAINING"
 
+# drain reasons (reference: autoscaler.proto DrainNodeReason — the protocol
+# distinguishes WHY a node is being removed so downstream layers can react
+# appropriately: preemption gets the full deadline orchestration, an
+# autoscaler idle-drain stays reversible until termination)
+DRAIN_REASON_PREEMPTION = "preemption"
+DRAIN_REASON_AUTOSCALER = "autoscaler"
+DRAIN_REASON_MANUAL = "manual"
+
+
+@dataclass
+class NodeDeathInfo:
+    """Why a node left the cluster (reference: gcs.proto NodeDeathInfo —
+    expected termination vs unexpected failure drives whether owners run
+    replica failover or lineage reconstruction)."""
+
+    expected: bool = False
+    reason: str = ""
+    ts: float = 0.0  # unix time the death was recorded
+
+    def to_wire(self) -> dict:
+        return {"expected": self.expected, "reason": self.reason,
+                "ts": self.ts}
+
+    @classmethod
+    def from_wire(cls, w: Optional[dict]) -> Optional["NodeDeathInfo"]:
+        if not w:
+            return None
+        return cls(expected=w.get("expected", False),
+                   reason=w.get("reason", ""),
+                   ts=w.get("ts", 0.0))
+
 
 @dataclass
 class NodeInfo:
@@ -323,6 +354,11 @@ class NodeInfo:
     labels: Dict[str, str] = field(default_factory=dict)
     state: str = NODE_ALIVE
     object_transfer_address: str = ""
+    # planned-removal protocol (reference: DrainNode RPC carrying reason +
+    # deadline; NodeDeathInfo recording expected vs unexpected termination)
+    drain_reason: str = ""
+    drain_deadline: float = 0.0  # absolute unix time; 0 = no deadline
+    death: Optional[NodeDeathInfo] = None
 
     def to_wire(self) -> dict:
         return {
@@ -333,6 +369,9 @@ class NodeInfo:
             "labels": self.labels,
             "state": self.state,
             "object_transfer_address": self.object_transfer_address,
+            "drain_reason": self.drain_reason,
+            "drain_deadline": self.drain_deadline,
+            "death": self.death.to_wire() if self.death else None,
         }
 
     @classmethod
@@ -345,6 +384,9 @@ class NodeInfo:
             labels=w.get("labels") or {},
             state=w.get("state", NODE_ALIVE),
             object_transfer_address=w.get("object_transfer_address", ""),
+            drain_reason=w.get("drain_reason", ""),
+            drain_deadline=w.get("drain_deadline", 0.0),
+            death=NodeDeathInfo.from_wire(w.get("death")),
         )
 
 

@@ -68,8 +68,16 @@ class ObjectRecoveryManager:
         # explicit per-object state machine + transition waiters
         self._states: Dict[bytes, str] = {}
         self._state_waiters: Dict[bytes, list] = {}
-        # authoritative death notices seen (node id hex)
-        self.dead_nodes: set = set()
+        # authoritative death notices seen: node id hex -> death reason
+        # (surfaced in ObjectLostError so errors say WHY the copy vanished)
+        self.dead_nodes: Dict[str, str] = {}
+        # recovery-plane counters (chaos assertions key off these): a
+        # graceful drain must produce replica failovers, NOT reconstructions
+        self.stats: Dict[str, int] = {
+            "lineage_reconstructions": 0,  # creating-task re-executions run
+            "replica_failovers": 0,        # locations rewritten to replicas
+            "locations_poisoned": 0,       # locations lost with a dead node
+        }
 
     # ------------------------------------------------------------------
     # state machine
@@ -171,32 +179,60 @@ class ObjectRecoveryManager:
     # authoritative failure notices (death pubsub)
     # ------------------------------------------------------------------
 
-    def on_node_death(self, node_hex: str, daemon_address: str = "") -> None:
+    def on_node_death(self, node_hex: str, daemon_address: str = "",
+                      reason: str = "", expected: bool = False,
+                      replicas: Optional[Dict[str, dict]] = None) -> None:
         """Control-store node-death notice: poison every owned location on
         the dead node so readers fail over IMMEDIATELY (no pull timeout to
         a dead daemon), and eagerly kick recovery for lost objects that
         have lineage and blocked waiters.
+
+        An EXPECTED death (graceful drain / preemption) arrives with the
+        drained node's replica map: locations are REWRITTEN to the live
+        replica instead of poisoned, so readers fail over with zero lineage
+        reconstructions — planned node removal is a non-event, not a
+        recovery storm.
 
         This is the authoritative trigger the reference drives through the
         GCS node-failure pubsub — recovery no longer depends on a getter
         happening to trip over the stale location."""
         if node_hex in self.dead_nodes:
             return
-        self.dead_nodes.add(node_hex)
+        self.dead_nodes[node_hex] = reason
         ms = self.cw.memory_store
+        replicas = replicas or {}
         lost = []
+        failed_over = 0
         for oid, loc in list(ms.locations.items()):
             if loc.get("node_id") != node_hex or loc.get("dead"):
                 continue
             if oid in ms.objects:
                 continue  # value also cached inline — nothing lost
+            rep = replicas.get(ObjectID(oid).hex())
+            if rep and rep.get("node_id") not in self.dead_nodes:
+                # pre-replicated by the draining node: point readers at the
+                # live copy — no poison, no reconstruction
+                ms.set_location(oid, {
+                    "node_id": rep["node_id"], "daemon": rep["daemon"],
+                })
+                failed_over += 1
+                continue
             loc["dead"] = True  # poison: _read_store_object fails fast
+            if reason:
+                loc["death_reason"] = reason
             lost.append(oid)
+        self.stats["replica_failovers"] += failed_over
+        self.stats["locations_poisoned"] += len(lost)
+        if failed_over:
+            logger.info(
+                "node %s expected-death notice: %d owned location(s) failed "
+                "over to drain replicas (zero reconstructions)",
+                node_hex[:8], failed_over)
         if not lost:
             return
         logger.info(
-            "node %s death notice: %d owned object location(s) poisoned",
-            node_hex[:8], len(lost))
+            "node %s death notice%s: %d owned object location(s) poisoned",
+            node_hex[:8], " (expected)" if expected else "", len(lost))
         for oid in lost:
             if not self.has_lineage(oid):
                 continue
@@ -264,6 +300,7 @@ class ObjectRecoveryManager:
             self._set_state(oid, FAILED)
             return False
         self._lineage[tid] = (spec, keepalive, n_rebuilt + 1)
+        self.stats["lineage_reconstructions"] += 1
         done = self.cw.loop.create_future()
         self._reconstructing[tid] = done
         for roid in spec.return_ids():

@@ -58,7 +58,17 @@ class DriverContext:
 
     def stop_loop(self):
         if self.loop is not None:
-            self.loop.call_soon_threadsafe(self.loop.stop)
+            # cancel stragglers (best-effort lease returns, background
+            # fetches) before stopping: the deadline-bounded shutdown no
+            # longer idles long enough for them to finish on their own, and
+            # a stopped loop full of pending tasks spews "Task was
+            # destroyed but it is pending!" at interpreter exit
+            def _drain_and_stop():
+                for task in asyncio.all_tasks(self.loop):
+                    task.cancel()
+                self.loop.call_soon(self.loop.stop)
+
+            self.loop.call_soon_threadsafe(_drain_and_stop)
             self.loop_thread.join(timeout=5)
             self.loop = None
 
@@ -191,16 +201,32 @@ def init(
 def shutdown():
     if not _context.initialized:
         return
+    # One deadline bounds the WHOLE exit sequence (unified deadline
+    # machinery from _private.retry): a drain or control-store failover in
+    # progress must not hang driver exit — each step gets the remaining
+    # budget, clipped to its usual per-step cap.
+    from ray_tpu._private.retry import Backoff, deadline_from_timeout
+
+    budget = Backoff(deadline=deadline_from_timeout(
+        GLOBAL_CONFIG.get("shutdown_timeout_s")))
     cw = _context.core_worker
     try:
+        # finish_job is best-effort: a live store answers in milliseconds,
+        # so the tight retry-chain deadline only bites when the store is
+        # gone/wedged — an exiting driver must not burn seconds of backoff
+        # reporting to a control store that cannot hear it
         asyncio.run_coroutine_threadsafe(
-            cw.control.call("finish_job", {"job_id": cw.job_id.binary()}, timeout=5),
+            cw.control.call("finish_job", {"job_id": cw.job_id.binary()},
+                            timeout=budget.clamp(5),
+                            deadline=deadline_from_timeout(budget.clamp(1.5))),
             _context.loop,
-        ).result(10)
+        ).result(budget.clamp(10))
     except Exception:  # noqa: BLE001
         pass
     try:
-        asyncio.run_coroutine_threadsafe(cw.close(), _context.loop).result(10)
+        if not budget.expired():
+            asyncio.run_coroutine_threadsafe(
+                cw.close(), _context.loop).result(budget.clamp(10))
     except Exception:  # noqa: BLE001
         pass
     set_core_worker(None)
@@ -281,6 +307,9 @@ def nodes() -> List[dict]:
             "state": info.state,
             "resources": info.resources.to_dict(),
             "labels": info.labels,
+            "drain_reason": info.drain_reason,
+            "drain_deadline": info.drain_deadline,
+            "death": info.death.to_wire() if info.death else None,
         })
     return out
 

@@ -11,6 +11,7 @@ id and rebind through the control store on the receiving side.
 from __future__ import annotations
 
 import hashlib
+import weakref
 from typing import Any, Dict, Optional
 
 from ray_tpu._private.core_worker import get_core_worker
@@ -92,12 +93,19 @@ class ActorHandle:
         self._concurrent = concurrent
         self._owned = _owned
         if _owned:
-            get_core_worker().add_actor_handle_ref(actor_id.binary())
+            cw = get_core_worker()
+            cw.add_actor_handle_ref(actor_id.binary())
+            # Pin the session that holds the refcount: a handle GC'd late
+            # (cycle collector) after shutdown()+init() must not decrement
+            # a colliding actor id in the NEW session's core worker.
+            self._owner_cw = weakref.ref(cw)
 
     def __del__(self):
         if getattr(self, "_owned", False):
             try:
-                get_core_worker().remove_actor_handle_ref(self._actor_id.binary())
+                cw = self._owner_cw()
+                if cw is not None and cw is get_core_worker():
+                    cw.remove_actor_handle_ref(self._actor_id.binary())
             except Exception:  # noqa: BLE001 — interpreter shutdown
                 pass
 

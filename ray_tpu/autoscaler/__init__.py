@@ -382,9 +382,14 @@ class Autoscaler:
                 if nid in self._draining:
                     if n["idle"]:
                         try:
+                            # planned removal: the death record must say so
+                            # (expected termination — owners fail over, no
+                            # lineage storm)
                             cw.run_sync(cw.control.call(
                                 "unregister_node",
-                                {"node_id": bytes.fromhex(nid)}), 10)
+                                {"node_id": bytes.fromhex(nid),
+                                 "expected": True,
+                                 "reason": "autoscaler scale-in"}), 10)
                         except Exception:  # noqa: BLE001 — dead already
                             pass
                         self.provider.terminate_node(w)
@@ -399,9 +404,13 @@ class Autoscaler:
                 elif (now - since >= self.config.idle_timeout_s
                       and len(self._draining) < allowed):
                     try:
+                        # reversible idle-drain (no deadline): the daemon
+                        # gates leases but keeps running so a later poll can
+                        # undrain it if demand returns
                         cw.run_sync(cw.control.call(
                             "drain_node",
-                            {"node_id": bytes.fromhex(nid)}), 10)
+                            {"node_id": bytes.fromhex(nid),
+                             "reason": "autoscaler"}), 10)
                         self._draining[nid] = now
                         logger.info("autoscaler draining idle node %s",
                                     nid[:12])
@@ -427,11 +436,38 @@ class Autoscaler:
                 logger.exception("autoscaler reconcile failed")
             self._stop.wait(self.config.poll_period_s)
 
+    def _drain_before_terminate(self, node_ids):
+        """cluster_down path: drain every node we are about to terminate so
+        their deaths are recorded as EXPECTED (reference: the autoscaler
+        drains before it terminates — teardown must not look like a mass
+        node failure to any driver still attached)."""
+        from ray_tpu._private.core_worker import get_core_worker
+
+        try:
+            cw = get_core_worker()
+        except Exception:  # noqa: BLE001 — no driver attached; nothing to
+            return         # protect from a recovery storm
+        for nid in node_ids:
+            try:
+                cw.run_sync(cw.control.call(
+                    "drain_node",
+                    {"node_id": bytes.fromhex(nid),
+                     "reason": "autoscaler"}), 5)
+                cw.run_sync(cw.control.call(
+                    "unregister_node",
+                    {"node_id": bytes.fromhex(nid), "expected": True,
+                     "reason": "autoscaler cluster teardown"}), 5)
+            except Exception:  # noqa: BLE001 — control store may be gone
+                pass
+
     def stop(self, terminate_workers: bool = True):
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
         if terminate_workers:
+            self._drain_before_terminate(
+                [w["node_id"] for w in self.workers]
+                + [n["node_id"] for sl in self.slices for n in sl["nodes"]])
             for w in self.workers:
                 try:
                     self.provider.terminate_node(w)

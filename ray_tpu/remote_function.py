@@ -55,12 +55,19 @@ def build_strategy(opts: Dict[str, Any]) -> SchedulingStrategy:
 
 def build_resources(opts: Dict[str, Any], default_cpu: float = 1.0) -> Dict[str, float]:
     res = dict(opts.get("resources") or {})
-    if "num_cpus" in opts and opts["num_cpus"] is not None:
-        res["CPU"] = float(opts["num_cpus"])
-    elif "CPU" not in res:
-        res["CPU"] = default_cpu
     if opts.get("num_tpus"):
         res["TPU"] = float(opts["num_tpus"])
+    if "num_cpus" in opts and opts["num_cpus"] is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    elif "CPU" not in res and not (res and opts.get("placement_group")):
+        # the implicit 1-CPU scheduling default does NOT apply to a
+        # placement-group request that already names custom resources
+        # (including one expressed via num_tpus — TPU is folded in above
+        # so it counts): the PG bundle is the resource envelope, and
+        # silently adding CPU to a bundle that never reserved any makes
+        # the request permanently unplaceable (it used to retry forever,
+        # invisibly)
+        res["CPU"] = default_cpu
     return res
 
 

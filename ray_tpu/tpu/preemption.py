@@ -1,0 +1,162 @@
+"""TPU/GCE preemption watcher: turn the 30-90s of warning a spot or
+maintenance-scheduled TPU VM gets into a graceful drain.
+
+Reference surface: the GCE metadata server's maintenance-event and
+preemption endpoints (the signals python/ray/autoscaler and cloud TPU
+training loops poll) plus the ACPI SIGTERM a preempted VM receives.
+Redesign: one watcher object owned by the node daemon, speaking the
+metadata HTTP surface through a swappable `MetadataTransport` seam so the
+exact production path runs offline against `FakeMetadataTransport` — the
+same fake-transport pattern as `autoscaler/gcp.py`.
+
+On a notice the watcher invokes `on_notice(reason, deadline_s)` exactly
+once; the daemon's `_self_drain` routes it through the control store's
+DrainNode protocol (stop granting leases, finish running work, replicate
+primary copies, migrate actors, exit with an expected-termination record).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+from typing import Awaitable, Callable, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.protocol import DRAIN_REASON_PREEMPTION
+
+logger = logging.getLogger(__name__)
+
+_METADATA_BASE = ("http://metadata.google.internal/computeMetadata/v1/"
+                  "instance")
+MAINTENANCE_URL = f"{_METADATA_BASE}/maintenance-event"
+PREEMPTED_URL = f"{_METADATA_BASE}/preempted"
+
+# maintenance-event values that mean "this host is about to go away"
+_TERMINAL_EVENTS = ("TERMINATE_ON_HOST_MAINTENANCE", "MIGRATE_ON_HOST_MAINTENANCE")
+
+
+class MetadataTransport:
+    """The HTTP seam: get(url) -> response body string (or raise)."""
+
+    def get(self, url: str) -> str:
+        raise NotImplementedError
+
+
+class GceMetadataTransport(MetadataTransport):
+    """Real transport against the GCE metadata server."""
+
+    def __init__(self, timeout: float = 2.0):
+        self.timeout = timeout
+
+    def get(self, url: str) -> str:
+        import urllib.request
+
+        req = urllib.request.Request(
+            url, headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read().decode("utf-8", "replace").strip()
+
+
+class FakeMetadataTransport(MetadataTransport):
+    """Offline simulation: tests flip `maintenance_event`/`preempted` and
+    the watcher reacts exactly as it would on a real TPU VM."""
+
+    def __init__(self):
+        self.maintenance_event = "NONE"
+        self.preempted = "FALSE"
+        self.calls = 0
+
+    def schedule_maintenance(self):
+        self.maintenance_event = "TERMINATE_ON_HOST_MAINTENANCE"
+
+    def preempt(self):
+        self.preempted = "TRUE"
+
+    def get(self, url: str) -> str:
+        self.calls += 1
+        if url == MAINTENANCE_URL:
+            return self.maintenance_event
+        if url == PREEMPTED_URL:
+            return self.preempted
+        raise ValueError(f"FakeMetadataTransport: unhandled {url}")
+
+
+class PreemptionWatcher:
+    """Polls the metadata endpoints (and optionally hooks SIGTERM) and
+    fires `on_notice(reason, deadline_s)` once when the host is scheduled
+    to die. Owned by the node daemon; runs on its event loop."""
+
+    def __init__(self, on_notice: Callable[[str, float], Awaitable],
+                 transport: Optional[MetadataTransport] = None,
+                 poll_period_s: Optional[float] = None,
+                 drain_deadline_s: Optional[float] = None,
+                 hook_sigterm: bool = False):
+        self.on_notice = on_notice
+        self.transport = transport or GceMetadataTransport()
+        self.poll_period_s = (
+            poll_period_s
+            if poll_period_s is not None
+            else GLOBAL_CONFIG.get("preemption_poll_period_s"))
+        self.drain_deadline_s = (
+            drain_deadline_s
+            if drain_deadline_s is not None
+            else GLOBAL_CONFIG.get("drain_deadline_s"))
+        self.hook_sigterm = hook_sigterm
+        self.fired = False
+        self._stopped = False
+
+    def stop(self):
+        self._stopped = True
+
+    async def _fire(self, cause: str):
+        if self.fired:
+            return
+        self.fired = True
+        logger.warning("preemption notice (%s): draining node with %.1fs "
+                       "deadline", cause, self.drain_deadline_s)
+        try:
+            await self.on_notice(DRAIN_REASON_PREEMPTION,
+                                 self.drain_deadline_s)
+        except Exception:  # noqa: BLE001 — the drain path logs its own
+            logger.exception("preemption drain callback failed")
+
+    def _poll_once(self) -> Optional[str]:
+        """Returns the cause string when a terminal notice is present."""
+        try:
+            ev = self.transport.get(MAINTENANCE_URL)
+            if ev in _TERMINAL_EVENTS:
+                return f"maintenance-event {ev}"
+            pre = self.transport.get(PREEMPTED_URL)
+            if pre.upper() == "TRUE":
+                return "instance preempted"
+        except Exception:  # noqa: BLE001 — metadata server unreachable
+            # (not on GCE, or transient): nothing to act on
+            return None
+        return None
+
+    async def run(self):
+        if self.hook_sigterm:
+            # a preempted VM gets SIGTERM ~30s before hard power-off; hook
+            # it so the drain starts even if the metadata poll is slow
+            loop = asyncio.get_running_loop()
+            try:
+                loop.add_signal_handler(
+                    signal.SIGTERM,
+                    lambda: loop.create_task(self._fire("SIGTERM")))
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass  # non-main thread / unsupported platform
+        while not self._stopped and not self.fired:
+            cause = await asyncio.to_thread(self._poll_once)
+            if cause:
+                await self._fire(cause)
+                return
+            await asyncio.sleep(self.poll_period_s)
+
+
+__all__ = [
+    "FakeMetadataTransport",
+    "GceMetadataTransport",
+    "MetadataTransport",
+    "PreemptionWatcher",
+]

@@ -66,10 +66,56 @@ class TrainController:
         self.accelerator_type = accelerator_type
         self.poll_interval_s = poll_interval_s
         self.failure_count = 0
+        # planned-removal rejoins (drain/preemption): checkpoint-then-rejoin,
+        # never charged against the failure policy's budget — a preempted
+        # node is the dominant production "failure" and must be a non-event.
+        # Bounded separately so a drain loop can't retry forever.
+        self.drain_rejoins = 0
+        self.max_drain_rejoins = 16
         self._group: Optional[WorkerGroup] = None
         # checkpoint steps reported but not yet finalized (async rank shards
         # may land after the report that announced them)
         self._pending_ckpt: Dict[int, Dict[str, Any]] = {}
+
+    @staticmethod
+    def _is_planned_removal(cause: Optional[str]) -> bool:
+        """A worker lost to a graceful drain or preemption notice — the
+        structured death reasons name the drain (\"node draining
+        (preemption)\", \"drained (autoscaler)\") — is a planned rejoin,
+        not a crash."""
+        if not cause:
+            return False
+        c = cause.lower()
+        return "drain" in c or "preempt" in c
+
+    @staticmethod
+    def _drain_in_progress(node_ids=None, terminal_only=False) -> bool:
+        """Notice-driven planned-failure detection: a worker can die with a
+        generic connection error before the structured death cause
+        propagates, so also consult the node table — a DRAINING node, or a
+        fresh expected-termination record, means the loss was planned.
+        When the dead workers' nodes are known, only THOSE nodes count: an
+        unrelated idle-node drain elsewhere in the cluster must not mask a
+        genuine crash (which would silently bypass the failure budget).
+        With terminal_only, a reversible (no-deadline) drain doesn't count
+        either — only deadline-carrying drains kill workers, so callers
+        with no node scoping available (group creation) use this to keep a
+        routine idle-drain from masking a genuinely bad config."""
+        wanted = {n for n in (node_ids or []) if n}
+        try:
+            for n in ray_tpu.nodes():
+                if wanted and n.get("node_id") not in wanted:
+                    continue
+                if n.get("state") == "DRAINING" and (
+                        not terminal_only or n.get("drain_deadline")):
+                    return True
+                death = n.get("death")
+                if (death and death.get("expected")
+                        and time.time() - death.get("ts", 0.0) < 120.0):
+                    return True
+        except Exception:  # noqa: BLE001 — control store unreachable
+            return False
+        return False
 
     # -- helpers --------------------------------------------------------
 
@@ -136,6 +182,15 @@ class TrainController:
             try:
                 self._group = self._make_group()
             except Exception as e:  # noqa: BLE001 — group creation failed
+                if (self._drain_in_progress(terminal_only=True)
+                        and self.drain_rejoins < self.max_drain_rejoins):
+                    # creation raced a terminal drain (workers died on the
+                    # leaving node mid-start): retry without spending the
+                    # budget — reversible idle-drains kill nothing and must
+                    # not mask a genuinely bad config
+                    self.drain_rejoins += 1
+                    time.sleep(0.5)
+                    continue
                 self.failure_count += 1
                 if not self.failure_policy.decide(self.failure_count):
                     result.error = f"worker group creation failed: {e}"
@@ -146,6 +201,7 @@ class TrainController:
             group = self._group
             world = group.num_workers
             failed = False
+            planned = False
             try:
                 while True:
                     statuses = group.poll()
@@ -155,7 +211,20 @@ class TrainController:
                     if dead or errored:
                         failed = True
                         cause = (dead or errored)[0].error
-                        logger.warning("worker failure: %s", cause)
+                        # only a LOST worker can be drain-caused: an
+                        # application error in a live worker must charge the
+                        # failure budget even while some node is draining
+                        planned = bool(dead) and (
+                            self._is_planned_removal(cause)
+                            or self._drain_in_progress(
+                                [s.node_id for s in dead]))
+                        if planned:
+                            logger.info(
+                                "worker lost to planned node removal "
+                                "(drain/preemption); rejoining from the "
+                                "latest checkpoint: %s", cause)
+                        else:
+                            logger.warning("worker failure: %s", cause)
                         result.error = cause
                         break
                     if all(s.done for s in statuses):
@@ -178,6 +247,23 @@ class TrainController:
             # differently-sized restart would otherwise mix incarnations
             self._pending_ckpt.clear()
             self._purge_staging()
+            if planned:
+                # drain-triggered rejoin: resume from the drain-window
+                # checkpoint without spending the failure budget (bounded
+                # separately so a pathological drain loop still terminates)
+                self.drain_rejoins += 1
+                if self.drain_rejoins > self.max_drain_rejoins:
+                    result.error = (
+                        f"too many drain rejoins ({self.drain_rejoins}); "
+                        f"last cause: {result.error}")
+                    return result
+                logger.info(
+                    "rejoining worker group after planned removal "
+                    "(rejoin %d, failure budget untouched), resuming from %s",
+                    self.drain_rejoins,
+                    self.ckpt.latest.path if self.ckpt.latest else "scratch",
+                )
+                continue
             self.failure_count += 1
             if not self.failure_policy.decide(self.failure_count):
                 return result

@@ -158,6 +158,10 @@ class WorkerStatus:
     done: bool = False
     error: Optional[str] = None
     reports: List[dict] = field(default_factory=list)
+    # hex id of the node that hosted the worker, resolved for dead workers
+    # so the controller can ask "was THAT node draining?" instead of
+    # treating any drain anywhere in the cluster as the cause
+    node_id: Optional[str] = None
 
 
 class WorkerGroup:
@@ -255,15 +259,32 @@ class WorkerGroup:
     def poll(self) -> List[WorkerStatus]:
         out: List[WorkerStatus] = []
         refs = [w.poll.remote() for w in self.workers]
-        for ref in refs:
+        for i, ref in enumerate(refs):
             try:
                 r = ray_tpu.get(ref, timeout=60)
                 out.append(WorkerStatus(alive=True, done=r["done"],
                                         error=r["error"], reports=r["reports"]))
             except (ray_tpu.ActorDiedError, ray_tpu.ActorUnavailableError,
                     ray_tpu.GetTimeoutError) as e:
-                out.append(WorkerStatus(alive=False, error=str(e)))
+                out.append(WorkerStatus(alive=False, error=str(e),
+                                        node_id=self._worker_node(i)))
         return out
+
+    def _worker_node(self, idx: int) -> Optional[str]:
+        """Last node that hosted worker `idx` (the actor record keeps its
+        node_id after death)."""
+        try:
+            from ray_tpu._private.core_worker import get_core_worker
+
+            cw = get_core_worker()
+            info = cw.run_sync(cw.control.call(
+                "get_actor_info",
+                {"actor_id": self.workers[idx]._actor_id.binary()}),
+                10)["actor"]
+            nid = info.get("node_id")
+            return nid.hex() if nid else None
+        except Exception:  # noqa: BLE001 — control store unreachable
+            return None
 
     def flush_checkpoints(self):
         try:

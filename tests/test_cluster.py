@@ -132,6 +132,76 @@ def test_placement_group_strict_spread(cluster):
     remove_placement_group(pg)
 
 
+def test_pg_custom_resource_actor_places_without_implicit_cpu(cluster):
+    """An actor in a PG whose bundles reserve only a custom resource must
+    place: the implicit 1-CPU scheduling default does not apply inside a
+    placement group that names custom resources (it used to make the
+    request permanently unplaceable — and the creation retried forever,
+    silently)."""
+    cluster.add_node(resources={"CPU": 2, "spot": 2})
+    cluster.add_node(resources={"CPU": 2, "spot": 2})
+    ray_tpu.init(address=cluster.address)
+    pg = placement_group([{"spot": 1}, {"spot": 1}], strategy="SPREAD")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    class W:
+        def where(self):
+            import os
+
+            return os.environ["RT_NODE_ID"]
+
+    ws = [W.options(resources={"spot": 1}, placement_group=pg,
+                    placement_group_bundle_index=i).remote()
+          for i in range(2)]
+    nodes = ray_tpu.get([w.where.remote() for w in ws], timeout=60)
+    assert nodes[0] != nodes[1]  # one per bundle, bundles spread
+    remove_placement_group(pg)
+
+
+def test_pg_actor_exceeding_bundle_fails_loudly(cluster):
+    """A PG actor whose resources exceed the bundle's TOTAL reservation is
+    a permanent mismatch: creation must fail with a clear cause instead of
+    retrying invisibly forever."""
+    cluster.add_node(resources={"CPU": 2, "spot": 1})
+    ray_tpu.init(address=cluster.address)
+    pg = placement_group([{"spot": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    class Greedy:
+        def ping(self):
+            return 1
+
+    a = Greedy.options(resources={"spot": 5}, placement_group=pg).remote()
+    from ray_tpu._private.errors import ActorDiedError
+
+    with pytest.raises(ActorDiedError, match="exceed"):
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            ray_tpu.get(a.ping.remote(), timeout=10)
+            time.sleep(0.2)
+    remove_placement_group(pg)
+
+
+def test_pg_num_tpus_request_gets_no_implicit_cpu():
+    """A placement-group request expressed only via num_tpus is a
+    custom-resource request like any other: the implicit 1-CPU scheduling
+    default must not be added (the bundle never reserved CPU, so the
+    request would be permanently infeasible)."""
+    from ray_tpu.remote_function import build_resources
+
+    pg = object()
+    assert build_resources({"num_tpus": 4, "placement_group": pg}) == {
+        "TPU": 4.0}
+    # outside a placement group the implicit CPU default still applies
+    assert build_resources({"num_tpus": 4}) == {"TPU": 4.0, "CPU": 1.0}
+    # an explicit num_cpus always wins
+    assert build_resources(
+        {"num_tpus": 4, "num_cpus": 2, "placement_group": pg}
+    ) == {"TPU": 4.0, "CPU": 2.0}
+
+
 def test_placement_group_infeasible():
     # The timeout flag must reach the control store process, so it is applied
     # before the cluster spawns (the reference serializes _system_config to

@@ -1,0 +1,471 @@
+"""Graceful drain & preemption plane: planned node death without a
+recovery storm.
+
+Covers the drain protocol end to end (reference: DrainNode +
+NodeDeathInfo + the autoscaler's drain-before-terminate):
+
+  * control-store drain state machine: DRAINING with {reason, deadline},
+    undrain, expected vs unexpected death records;
+  * pubsub seq stamping + subscribe-reply seq (gap detection input);
+  * full drain orchestration: a drained node's primary object copies
+    replicate to live peers and readers fail over with ZERO lineage
+    reconstructions;
+  * planned actor migration that never charges max_restarts;
+  * the preemption watcher against the fake GCE metadata transport, and
+    the seeded `testing_preempt_notice` chaos fault;
+  * structured death reasons surfacing in ActorDiedError / the workers
+    channel;
+  * bounded ray_tpu.shutdown() (deadline machinery from _private.retry);
+  * subscription-gap reconcile: a death "published" while the subscriber
+    missed notices is recovered by the resync path.
+"""
+
+import asyncio
+import gc
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol as pb
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.core_worker import get_core_worker
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.protocol import NodeInfo, ResourceSet
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime.rpc import RpcClient
+
+
+@pytest.fixture(autouse=True)
+def _teardown():
+    yield
+    try:
+        ray_tpu.shutdown()
+    except Exception:  # noqa: BLE001 — scenario may have torn things down
+        pass
+
+
+# ---------------------------------------------------------------------------
+# control-store protocol units (in-process, no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def _fake_node_wire(node_id=None):
+    return NodeInfo(
+        node_id=node_id or NodeID.from_random(),
+        address="127.0.0.1:1",
+        object_store_name="none",
+        resources=ResourceSet({"CPU": 2}),
+    ).to_wire()
+
+
+def test_drain_state_machine_and_death_record():
+    """DRAINING carries {reason, deadline}; undrain clears them; an
+    expected unregister records a planned death, a health-check death an
+    unplanned one — both persist in the node table."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        wire = _fake_node_wire()
+        nid = wire["node_id"]
+        await cs.rpc_register_node(0, {"node": wire})
+        r = await cs.rpc_drain_node(0, {
+            "node_id": nid, "reason": pb.DRAIN_REASON_PREEMPTION,
+            "deadline_s": 0,  # no orchestration (no daemon behind it)
+        })
+        assert r["ok"]
+        info = cs.nodes[nid]
+        assert info.state == pb.NODE_DRAINING
+        assert info.drain_reason == pb.DRAIN_REASON_PREEMPTION
+        # reversible: undrain restores ALIVE and clears the drain fields
+        assert (await cs.rpc_undrain_node(0, {"node_id": nid}))["ok"]
+        assert info.state == pb.NODE_ALIVE
+        assert info.drain_reason == ""
+        # expected termination (the drained daemon's self-unregister)
+        await cs.rpc_unregister_node(0, {
+            "node_id": nid, "expected": True, "reason": "drained (manual)"})
+        assert info.state == pb.NODE_DEAD
+        assert info.death is not None and info.death.expected
+        assert "drained" in info.death.reason
+        # an unexpected death records expected=False
+        wire2 = _fake_node_wire()
+        await cs.rpc_register_node(0, {"node": wire2})
+        await cs._mark_node_dead(wire2["node_id"], "health check timed out")
+        assert cs.nodes[wire2["node_id"]].death.expected is False
+        # round-trips the wire (node table read by gap reconcile)
+        back = NodeInfo.from_wire(cs.nodes[nid].to_wire())
+        assert back.death is not None and back.death.expected
+
+    asyncio.run(run())
+
+
+def test_pubsub_seq_stamping_and_subscribe_reply():
+    """Every published notice carries a per-channel monotonic _seq and the
+    subscribe reply reports the channel's current seq — the two inputs gap
+    detection needs."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        seen = []
+        cs.server.push = lambda conn_id, channel, msg: (
+            seen.append((channel, msg)) or True)
+        sub = await cs.rpc_subscribe(0, {"channel": "nodes"})
+        assert sub["ok"] and sub["seq"] == 0
+        cs.pubsub.publish("nodes", {"a": 1})
+        cs.pubsub.publish("nodes", {"a": 2})
+        cs.pubsub.publish("workers", {"b": 1})
+        assert [m["_seq"] for c, m in seen if c == "nodes"] == [1, 2]
+        # per-channel counters are independent
+        sub2 = await cs.rpc_subscribe(1, {"channel": "workers"})
+        assert sub2["seq"] == 1
+        assert (await cs.rpc_subscribe(2, {"channel": "nodes"}))["seq"] == 2
+
+    asyncio.run(run())
+
+
+def test_drained_replicas_merge_into_expected_death():
+    """report_drain_replicas + expected death => the nodes-channel notice
+    (and the gap-reconcile get_all_nodes read) carry the replica map."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        seen = []
+        cs.server.push = lambda conn_id, channel, msg: (
+            seen.append((channel, msg)) or True)
+        await cs.rpc_subscribe(0, {"channel": "nodes"})
+        wire = _fake_node_wire()
+        nid = wire["node_id"]
+        await cs.rpc_register_node(0, {"node": wire})
+        await cs.rpc_drain_node(0, {"node_id": nid, "reason": "manual"})
+        reps = {"ab" * 24: {"node_id": "cd" * 16, "daemon": "127.0.0.1:2"}}
+        r = await cs.rpc_report_drain_replicas(
+            0, {"node_id": nid, "replicas": reps})
+        assert r["ok"] and r["count"] == 1
+        await cs.rpc_unregister_node(0, {
+            "node_id": nid, "expected": True, "reason": "drained (manual)"})
+        dead = [m for c, m in seen
+                if c == "nodes" and m.get("state") == pb.NODE_DEAD]
+        assert dead and dead[-1]["replicas"] == reps
+        assert dead[-1]["death"]["expected"] is True
+        # gap reconcile path: get_all_nodes carries the same replica map
+        nodes = (await cs.rpc_get_all_nodes(0, {}))["nodes"]
+        rec = next(n for n in nodes if n["node_id"] == nid)
+        assert rec["replicas"] == reps
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# preemption watcher (fake metadata transport, same seam as autoscaler/gcp)
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_watcher_fires_once_on_maintenance_event():
+    from ray_tpu.tpu.preemption import FakeMetadataTransport, PreemptionWatcher
+
+    async def run():
+        fake = FakeMetadataTransport()
+        notices = []
+
+        async def on_notice(reason, deadline_s):
+            notices.append((reason, deadline_s))
+
+        w = PreemptionWatcher(on_notice, transport=fake,
+                              poll_period_s=0.01, drain_deadline_s=7.5)
+        task = asyncio.ensure_future(w.run())
+        await asyncio.sleep(0.05)
+        assert notices == []  # quiet metadata: no notice
+        fake.schedule_maintenance()
+        await asyncio.wait_for(task, timeout=5)
+        assert notices == [(pb.DRAIN_REASON_PREEMPTION, 7.5)]
+        assert w.fired and fake.calls > 0
+
+    asyncio.run(run())
+
+
+def test_preemption_watcher_preempted_flag():
+    from ray_tpu.tpu.preemption import FakeMetadataTransport, PreemptionWatcher
+
+    async def run():
+        fake = FakeMetadataTransport()
+        fake.preempt()
+        notices = []
+
+        async def on_notice(reason, deadline_s):
+            notices.append(reason)
+
+        w = PreemptionWatcher(on_notice, transport=fake, poll_period_s=0.01)
+        await asyncio.wait_for(w.run(), timeout=5)
+        assert notices == [pb.DRAIN_REASON_PREEMPTION]
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# cluster integration: full drain orchestration
+# ---------------------------------------------------------------------------
+
+
+def _drain_via_daemon(cw, address, reason, deadline_s):
+    async def drain():
+        c = RpcClient(address, name="drain-test")
+        try:
+            return await c.call(
+                "drain", {"reason": reason, "deadline_s": deadline_s},
+                timeout=30)
+        finally:
+            await c.close()
+
+    return cw.run_sync(drain(), timeout=30)
+
+
+def _wait_node_state(cw, node_hex, state, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        reply = cw.run_sync(cw.control.call("get_all_nodes", {}), 10)
+        rec = next((n for n in reply["nodes"]
+                    if n["node_id"].hex() == node_hex), None)
+        if rec is not None and rec["state"] == state:
+            return rec
+        time.sleep(0.1)
+    raise AssertionError(f"node {node_hex[:8]} never reached {state}")
+
+
+def test_drain_replicates_primaries_zero_reconstructions():
+    """A node removed via drain_node produces an expected-termination death
+    record, its primary copies fail over to pre-made replicas, and getting
+    them afterwards runs ZERO lineage reconstructions."""
+    GLOBAL_CONFIG.apply_system_config({
+        "health_check_period_s": 0.25, "health_check_timeout_s": 3.0,
+    })
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 2})
+    try:
+        nodes = [cluster.add_node(resources={"CPU": 2, "prod": 1}),
+                 cluster.add_node(resources={"CPU": 2, "prod": 1})]
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(resources={"prod": 0.5})
+        def produce(x):
+            return np.full(120_000, x, dtype=np.float64)
+
+        refs = [produce.remote(float(i)) for i in range(4)]
+        ray_tpu.get(refs, timeout=60)
+        gc.collect()
+        cw = get_core_worker()
+        holder = cw.memory_store.locations[refs[0].binary()]["node_id"]
+        victim = next(n for n in nodes if n.node_id == holder)
+        assert _drain_via_daemon(
+            cw, victim.address, pb.DRAIN_REASON_MANUAL, 15.0)["ok"]
+
+        rec = _wait_node_state(cw, holder, pb.NODE_DEAD)
+        assert rec["death"]["expected"] is True
+        assert "drained" in rec["death"]["reason"]
+
+        vals = ray_tpu.get(refs, timeout=60)
+        for i in range(4):
+            assert vals[i][0] == float(i)
+        stats = cw.recovery.stats
+        assert stats["lineage_reconstructions"] == 0, stats
+        assert stats["replica_failovers"] >= 1, stats
+    finally:
+        cluster.shutdown()
+
+
+def test_chaos_preempt_notice_self_drains():
+    """The seeded `testing_preempt_notice` fault: the aimed daemon receives
+    a synthetic preemption notice, drains itself, and exits with an
+    expected death record carrying reason preemption."""
+    GLOBAL_CONFIG.apply_system_config({
+        "health_check_period_s": 0.25, "health_check_timeout_s": 3.0,
+        # head daemon is daemon1; the node added below is daemon2
+        "testing_preempt_notice": "daemon2:500:10000",
+    })
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 2})
+    try:
+        spot = cluster.add_node(resources={"CPU": 2, "spot": 1})
+        ray_tpu.init(address=cluster.address)
+        cw = get_core_worker()
+        rec = _wait_node_state(cw, spot.node_id, pb.NODE_DEAD)
+        assert rec["death"]["expected"] is True
+        assert "preemption" in rec["death"]["reason"]
+
+        # the cluster stays usable: the head keeps serving tasks
+        @ray_tpu.remote(num_cpus=1)
+        def f():
+            return 42
+
+        assert ray_tpu.get(f.remote(), timeout=60) == 42
+    finally:
+        cluster.shutdown()
+
+
+def test_actor_migrates_on_drain_without_burning_budget():
+    """A restartable actor on a draining node migrates (planned restart):
+    it keeps serving from another node and its max_restarts budget is
+    untouched — a later real crash still gets its restart."""
+    GLOBAL_CONFIG.apply_system_config({
+        "health_check_period_s": 0.25, "health_check_timeout_s": 3.0,
+    })
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 2})
+    try:
+        n1 = cluster.add_node(resources={"CPU": 2, "spot": 1})
+        cluster.add_node(resources={"CPU": 2, "spot": 1})
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(resources={"spot": 0.5}, max_restarts=1)
+        class Counter:
+            def incr(self):
+                return os.getpid()
+
+        a = Counter.remote()
+        pid1 = ray_tpu.get(a.incr.remote(), timeout=60)
+        cw = get_core_worker()
+        info = cw.run_sync(cw.control.call(
+            "get_actor_info", {"actor_id": a._actor_id.binary()}), 10)
+        actor_node = info["actor"]["node_id"].hex()
+        victims = [n for n in (cluster.nodes[1], cluster.nodes[2])
+                   if n.node_id == actor_node]
+        if not victims:
+            pytest.skip("actor landed on the head node")
+        assert _drain_via_daemon(
+            cw, victims[0].address, pb.DRAIN_REASON_AUTOSCALER, 15.0)["ok"]
+
+        # migrated: serves again from a fresh worker on a live node, with
+        # the planned restart NOT charged against max_restarts
+        deadline = time.monotonic() + 60
+        pid2 = None
+        while time.monotonic() < deadline:
+            try:
+                pid2 = ray_tpu.get(a.incr.remote(), timeout=30)
+                break
+            except (ray_tpu.ActorUnavailableError, ray_tpu.ActorDiedError):
+                time.sleep(0.3)
+        assert pid2 is not None and pid2 != pid1
+        info = cw.run_sync(cw.control.call(
+            "get_actor_info", {"actor_id": a._actor_id.binary()}), 10)["actor"]
+        assert info["state"] == "ALIVE"
+        assert info["planned_restarts"] == 1
+        assert info["num_restarts"] == 1
+        assert info["node_id"].hex() != actor_node
+    finally:
+        cluster.shutdown()
+
+
+def test_structured_death_reason_reaches_actor_error():
+    """A chaos process_kill produces a workers-channel record and an
+    ActorDiedError that say WHY the worker died — not a generic message."""
+    GLOBAL_CONFIG.apply_system_config({
+        "health_check_period_s": 0.25, "health_check_timeout_s": 3.0,
+    })
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote(max_restarts=0)
+    class Doomed:
+        def ping(self):
+            return "up"
+
+    a = Doomed.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "up"
+    cw = get_core_worker()
+    reply = cw.run_sync(
+        cw.daemon.call("chaos_kill", {"actor": True}, timeout=10), 30)
+    assert reply["ok"], reply
+
+    # the structured record lands in the authoritative death table
+    deadline = time.monotonic() + 30
+    rec = None
+    while time.monotonic() < deadline:
+        dead = cw.run_sync(cw.control.call(
+            "list_dead_workers", {}), 10)["workers"]
+        rec = next((w for w in dead
+                    if "process_kill" in (w.get("reason") or "")), None)
+        if rec:
+            break
+        time.sleep(0.2)
+    assert rec is not None, "structured death reason never recorded"
+    assert rec["exit_code"] == -signal.SIGKILL
+
+    # ...and surfaces in the actor error the caller sees
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(a.ping.remote(), timeout=10)
+            time.sleep(0.2)
+        except ray_tpu.ActorDiedError as e:
+            assert "process_kill" in str(e) or "crashed" in str(e), str(e)
+            break
+        except ray_tpu.ActorUnavailableError:
+            time.sleep(0.2)
+    else:
+        raise AssertionError("ActorDiedError never surfaced")
+
+
+def test_shutdown_bounded_by_deadline_with_dead_control_store():
+    """ray_tpu.shutdown() must not hang when the control store is gone
+    mid-exit (drain/failover in progress): the unified deadline bounds the
+    whole sequence."""
+    ray_tpu.init(num_cpus=2, system_config={"shutdown_timeout_s": 5.0})
+    from ray_tpu._private.worker import global_context
+
+    ctx = global_context()
+    cs_proc = ctx.owned_processes[0]  # control store spawns first
+    os.kill(cs_proc.pid, signal.SIGKILL)
+    cs_proc.wait(timeout=10)
+    t0 = time.monotonic()
+    ray_tpu.shutdown()
+    took = time.monotonic() - t0
+    assert took < 20.0, f"shutdown took {took:.1f}s despite 5s deadline"
+
+
+def test_gap_reconcile_recovers_missed_death():
+    """A node death whose pubsub notice is lost (control-store failover
+    window) is recovered by the resubscribe gap check: the reconcile
+    replays the node table through the notice handlers and recovery
+    triggers."""
+    GLOBAL_CONFIG.apply_system_config({
+        "health_check_period_s": 0.25, "health_check_timeout_s": 2.0,
+    })
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 2})
+    try:
+        nodes = [cluster.add_node(resources={"CPU": 2, "prod": 1}),
+                 cluster.add_node(resources={"CPU": 2, "prod": 1})]
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(resources={"prod": 0.5})
+        def produce():
+            return np.arange(120_000, dtype=np.float64)
+
+        ref = produce.remote()
+        ray_tpu.wait([ref], timeout=60)
+        cw = get_core_worker()
+        holder = cw.memory_store.locations[ref.binary()]["node_id"]
+        victim = next(n for n in nodes if n.node_id == holder)
+
+        # simulate the failover window: this subscriber misses every
+        # "nodes" push while the node dies an UNEXPECTED death
+        real_cb = cw.control._subs["nodes"]
+        cw.control._subs["nodes"] = lambda m: None
+        try:
+            cluster.kill_node(victim)
+            cw.store.delete(ref.object_id())
+            _wait_node_state(cw, holder, pb.NODE_DEAD)
+        finally:
+            cw.control._subs["nodes"] = real_cb
+        # the death notice is gone; without reconcile the location is a
+        # silent landmine. The resubscribe-with-gap path must find it.
+        assert holder not in cw.recovery.dead_nodes
+        cw.run_sync(cw._subscribe_notices(resync=True), 30)
+        assert holder in cw.recovery.dead_nodes
+        # and the object recovers through lineage on the next read
+        val = ray_tpu.get(ref, timeout=60)
+        assert float(val.sum()) == float(
+            np.arange(120_000, dtype=np.float64).sum())
+        assert cw.recovery.stats["lineage_reconstructions"] >= 1
+    finally:
+        cluster.shutdown()

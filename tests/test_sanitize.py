@@ -73,3 +73,26 @@ def test_native_surface_under_asan_ubsan():
                    "runtime error:"):
         assert marker not in proc.stdout and marker not in proc.stderr, (
             f"sanitizer diagnostic in output:\n{tail}")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ compiler")
+@pytest.mark.skipif(not build.sanitizer_preload(),
+                    reason="libasan runtime not installed")
+def test_drain_recovery_under_asan_ubsan():
+    """Run the drain/recovery suites with the native libs instrumented:
+    the graceful-drain path drives the shm store hard (replication pulls,
+    peer fetch_chunks into freshly created segments, deletes racing reads)
+    and must stay clean under ASan/UBSan."""
+    env = _sanitize_env()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_drain.py", "tests/test_lineage.py",
+         "-q", "-p", "no:cacheprovider", "-m", "not slow"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=1500,
+    )
+    tail = (proc.stdout + "\n" + proc.stderr)[-6000:]
+    assert proc.returncode == 0, f"sanitized drain/recovery run failed:\n{tail}"
+    for marker in ("AddressSanitizer", "UndefinedBehaviorSanitizer",
+                   "runtime error:"):
+        assert marker not in proc.stdout and marker not in proc.stderr, (
+            f"sanitizer diagnostic in output:\n{tail}")
