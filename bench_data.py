@@ -1,0 +1,196 @@
+"""Data-plane benchmarks: pipeline throughput + driver RSS, with the
+logical optimizer's fusion/pushdown A/B'd via the
+`DataContext.optimizer_enabled` escape hatch.
+
+Counterpart of the reference's data release benchmarks
+(release/nightly_tests/dataset/). Emits one JSON line per benchmark:
+{"bench": ..., "optimizer": "on"|"off", "value": ..., "unit": ...} and
+writes the collected artifact (BENCH_DATA_rNN.json) with --out.
+
+Run: python bench_data.py [--quick] [--out BENCH_DATA_r09.json]
+"""
+
+import argparse
+import json
+import resource
+import time
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def _with_optimizer(enabled: bool):
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    ctx.optimizer_enabled = enabled
+
+
+def bench_fused_pipeline(rd, n_rows: int, n_blocks: int, enabled: bool):
+    """A 4-op vectorized map_batches chain over MANY small blocks,
+    streamed end to end. Fusion (optimizer on) runs ONE task per block;
+    off runs one task per op per block (4x the dispatches + 3 extra block
+    round-trips through the store) — the A/B isolates the task-hop
+    overhead fusion removes."""
+    _with_optimizer(enabled)
+
+    def make():
+        ds = rd.range(n_rows, parallelism=n_blocks)
+        for _ in range(4):
+            ds = ds.map_batches(lambda b: {"id": b["id"] + 1})
+        return ds
+
+    def consume(ds):
+        from ray_tpu.data.block import block_num_rows
+
+        return sum(block_num_rows(b) for b in ds.iter_blocks())
+
+    consume(make())  # warmup: worker pool + imports
+    t0 = time.perf_counter()
+    rows = consume(make())
+    dt = time.perf_counter() - t0
+    return {"bench": "fused_pipeline",
+            "optimizer": "on" if enabled else "off",
+            "value": round(n_rows / dt, 1), "unit": "rows/s",
+            "rows_out": rows}
+
+
+def bench_limit_pushdown(rd, n_rows: int, n_blocks: int, k: int,
+                         enabled: bool):
+    """range.map(expensive).limit(k): the LimitPushdown rule moves the
+    per-block cap below the map, so the map touches <= k-ish rows; with
+    the optimizer off it processes every admitted block in full."""
+    import numpy as np
+
+    _with_optimizer(enabled)
+
+    def expensive(r):
+        x = float(r["id"])
+        for _ in range(50):
+            x = np.sqrt(x * x + 1.0)
+        return {"id": r["id"], "x": x}
+
+    def make():
+        return rd.range(n_rows, parallelism=n_blocks).map(expensive).limit(k)
+
+    make().take(8)  # warmup
+    t0 = time.perf_counter()
+    rows = make().take_all()
+    dt = time.perf_counter() - t0
+    assert len(rows) == k
+    return {"bench": "limit_pushdown",
+            "optimizer": "on" if enabled else "off",
+            "value": round(dt * 1e3, 1), "unit": "ms"}
+
+
+def _write_parquet_dir(n_files: int, rows: int, n_cols: int) -> str:
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = tempfile.mkdtemp(prefix="rt_bench_data_")
+    for i in range(n_files):
+        cols = {"key": np.arange(i * rows, (i + 1) * rows)}
+        for c in range(n_cols):
+            cols[f"pad{c}"] = np.random.default_rng(c).random(rows)
+        pq.write_table(pa.table(cols), f"{d}/part{i}.parquet")
+    return d
+
+
+def bench_parquet_projection(rd, path: str, total_rows: int, enabled: bool):
+    """sum("key") over a wide parquet set: projection pushdown reads ONE
+    column; off reads every pad column then drops them."""
+    _with_optimizer(enabled)
+    rd.read_parquet(path).sum("key")  # warmup (fresh dataset: no ref reuse)
+    t0 = time.perf_counter()
+    total = rd.read_parquet(path).sum("key")
+    dt = time.perf_counter() - t0
+    assert total == sum(range(total_rows))
+    return {"bench": "parquet_projection_sum",
+            "optimizer": "on" if enabled else "off",
+            "value": round(dt * 1e3, 1), "unit": "ms"}
+
+
+def bench_parquet_count_metadata(rd, path: str, total_rows: int,
+                                 enabled: bool):
+    """count() on a fresh read_parquet: on = footer arithmetic (zero data
+    blocks), off = execute every read task then count."""
+    _with_optimizer(enabled)
+    rd.read_parquet(path).count()  # warmup (fresh dataset: no ref reuse)
+    t0 = time.perf_counter()
+    n = rd.read_parquet(path).count()
+    dt = time.perf_counter() - t0
+    assert n == total_rows
+    return {"bench": "parquet_count",
+            "optimizer": "on" if enabled else "off",
+            "value": round(dt * 1e3, 1), "unit": "ms"}
+
+
+def run_suite(quick: bool = False):
+    """Assumes ray_tpu.init() already ran. Returns the result list."""
+    import ray_tpu.data as rd
+
+    if quick:
+        n_rows, n_blocks, k = 4_000, 4, 50
+        pq_files, pq_rows, pq_cols = 2, 500, 4
+    else:
+        n_rows, n_blocks, k = 2_000_000, 256, 1_000
+        pq_files, pq_rows, pq_cols = 16, 100_000, 16
+    pq_dir = _write_parquet_dir(pq_files, pq_rows, pq_cols)
+    total_pq = pq_files * pq_rows
+
+    rss0 = _rss_mb()
+    results = []
+    try:
+        for enabled in (True, False):
+            results.append(
+                bench_fused_pipeline(rd, n_rows, n_blocks, enabled))
+            results.append(
+                bench_limit_pushdown(rd, n_rows, n_blocks, k, enabled))
+            results.append(
+                bench_parquet_projection(rd, pq_dir, total_pq, enabled))
+            results.append(
+                bench_parquet_count_metadata(rd, pq_dir, total_pq, enabled))
+    finally:
+        _with_optimizer(True)
+    results.append({"bench": "driver_rss_delta", "optimizer": "n/a",
+                    "value": round(_rss_mb() - rss0, 1), "unit": "MB"})
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--out", default=None,
+                        help="write the artifact JSON here")
+    args = parser.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        results = run_suite(quick=args.quick)
+    finally:
+        ray_tpu.shutdown()
+    for r in results:
+        print(json.dumps(r))
+    if args.out:
+        import platform
+
+        artifact = {
+            "suite": "bench_data",
+            "quick": bool(args.quick),
+            "python": platform.python_version(),
+            "machine": platform.machine(),
+            "results": results,
+        }
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,16 @@
 """ray_tpu.data — distributed, block-based data pipelines feeding TPU SPMD
 training (reference surface: python/ray/data/__init__.py).
 
-Blocks are columnar dict-of-numpy; transforms fuse into one remote task per
-block; `Dataset.split()` shards blocks across train workers and
+Datasets build a lazy LOGICAL PLAN; a rule-based optimizer (operator
+fusion, limit/projection/predicate pushdown — ray_tpu/data/_logical/)
+rewrites it and the physical planner compiles it onto the streaming
+executor: one fused remote task per block. Blocks are columnar
+dict-of-numpy; `Dataset.split()` shards blocks across train workers and
 `iter_batches(device_put=True)` prefetches host→device.
 """
 
 from ray_tpu.data.block import Block
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.datasource import (
     from_items,
@@ -22,6 +26,7 @@ from ray_tpu.data.datasource import (
 
 __all__ = [
     "Block",
+    "DataContext",
     "Dataset",
     "range",
     "from_items",

@@ -91,9 +91,41 @@ class DatasetStats:
 _STATS_REGISTRY: "collections.OrderedDict[str, DatasetStats]" = (
     collections.OrderedDict())
 
+def _rt_metrics_emit(stats: DatasetStats) -> None:
+    """Thread per-execution totals onto the rt_* metrics plane (the
+    cluster-wide Prometheus surface — reference: data's StatsManager
+    pushing operator metrics through the metrics agent)."""
+    try:
+        from ray_tpu.util.metrics import get_or_create_counter
 
-def record_stats(dataset_tag: str, stats: DatasetStats) -> None:
+        get_or_create_counter(
+            "rt_data_executions_total", "Dataset plan executions").inc(1)
+        if stats.output_blocks:
+            get_or_create_counter(
+                "rt_data_output_blocks_total",
+                "Dataset output blocks").inc(stats.output_blocks)
+        if stats.output_bytes:
+            get_or_create_counter(
+                "rt_data_output_bytes_total",
+                "Dataset output bytes").inc(stats.output_bytes)
+        for op in stats.ops:
+            if op.blocks:
+                get_or_create_counter(
+                    "rt_data_op_blocks_total",
+                    "Blocks processed per logical op",
+                    tag_keys=("op",)).inc(op.blocks,
+                                          tags={"op": op.name[:60]})
+    except Exception:  # noqa: BLE001 — metrics must never fail the pipeline
+        pass
+
+
+def record_stats(dataset_tag: str, stats: DatasetStats, *,
+                 emit_metrics: bool = True) -> None:
     _STATS_REGISTRY[dataset_tag] = stats
+    if emit_metrics:
+        # metadata-shortcut queries pass False: they count under
+        # rt_data_meta_shortcuts_total, not as plan executions
+        _rt_metrics_emit(stats)
     while len(_STATS_REGISTRY) > 64:
         _STATS_REGISTRY.popitem(last=False)
     # surface through the control store so the state API can list dataset
@@ -250,14 +282,16 @@ class AutoScalingActorPool:
 # ---------------------------------------------------------------------------
 
 
+def _actor_label(cls) -> str:
+    return getattr(cls, "__name__", None) or getattr(
+        getattr(cls, "func", None), "__name__", "udf")
+
+
 def _stage_name(stage) -> str:
     if stage[0] == "tasks":
         ops = stage[1]
         return "->".join(k for k, _ in ops) if ops else "read"
-    cls = stage[1]
-    name = getattr(cls, "__name__", None) or getattr(
-        getattr(cls, "func", None), "__name__", "udf")
-    return f"actors[{name}]"
+    return f"actors[{_actor_label(stage[1])}]"
 
 
 class _StageState:

@@ -74,3 +74,53 @@ def normalize_batch(block: Block) -> Block:
     if isinstance(block, dict):
         return block
     return rows_to_block(block)
+
+
+def block_select_columns(block: Block, columns: List[str]) -> Block:
+    """Project a block to a column subset (the Project logical op's task
+    body). Missing columns raise KeyError — same surface the downstream
+    UDF would have hit."""
+    if isinstance(block, dict):
+        return {c: block[c] for c in columns}
+    return [{c: r[c] for c in columns} for r in block]
+
+
+def _column_mask(col: np.ndarray, op: str, value) -> np.ndarray:
+    if op in ("==", "="):
+        return col == value
+    if op == "!=":
+        return col != value
+    if op == "<":
+        return col < value
+    if op == "<=":
+        return col <= value
+    if op == ">":
+        return col > value
+    if op == ">=":
+        return col >= value
+    if op == "in":
+        return np.isin(col, list(value))
+    if op == "not in":
+        return ~np.isin(col, list(value))
+    raise ValueError(f"unknown predicate op {op!r}")
+
+
+def block_filter_expr(block: Block, exprs) -> Block:
+    """Apply a conjunction of (column, op, value) predicates — the same
+    tuple shape pyarrow's parquet `filters=` takes, so a predicate that
+    could not push into the reader evaluates identically here, vectorized
+    over columnar blocks."""
+    if isinstance(block, dict):
+        n = block_num_rows(block)
+        mask = np.ones(n, dtype=bool)
+        for col, op, value in exprs:
+            mask &= np.asarray(_column_mask(np.asarray(block[col]), op, value))
+        return {c: np.asarray(v)[mask] for c, v in block.items()}
+
+    def keep(row) -> bool:
+        for col, op, value in exprs:
+            if not bool(_column_mask(np.asarray([row[col]]), op, value)[0]):
+                return False
+        return True
+
+    return [r for r in block if keep(r)]

@@ -21,6 +21,11 @@ class DataContext:
     """
 
     streaming_block_window: int = 8
+    # the logical optimizer escape hatch: False compiles the plan naively
+    # (one stage per op, no pushdowns, no metadata shortcuts — limit
+    # SEMANTICS still hold, they are compilation, not optimization).
+    # bench_data.py A/Bs this flag.
+    optimizer_enabled: bool = True
     # max estimated bytes in flight per pipeline stage before admission
     # backpressure (reference: execution/resource_manager.py budgets)
     op_memory_budget_bytes: int = 128 << 20

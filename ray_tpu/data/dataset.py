@@ -1,15 +1,26 @@
-"""Dataset: lazy, distributed, block-based data pipelines.
+"""Dataset: lazy, distributed, block-based data pipelines over a logical
+query plan.
 
 Reference surface: python/ray/data/dataset.py:203 (map/map_batches/filter/
-flat_map/split/iter_batches/take/count) executed by the streaming executor
+flat_map/split/iter_batches/take/count) + the logical planning stack
+(`_internal/logical/` operators and rules, `_internal/planner/planner.py`)
+executed by the streaming executor
 (python/ray/data/_internal/execution/streaming_executor.py:106).
 
 TPU-first redesign instead of a port:
-- a Dataset is (block producers, fused op chain). Materialization submits ONE
-  task per block that applies the whole chain — operator fusion is the
-  default (the reference fuses map chains inside its executor; here the
-  chain is literally one function), and blocks execute in parallel across
-  the cluster with no central executor loop.
+- a Dataset holds a LOGICAL PLAN (ray_tpu/data/_logical/operators.py) it
+  never mutates: every transform stacks a node. Consumption optimizes the
+  plan (rules to fixpoint: operator fusion, limit pushdown, projection and
+  predicate pushdown into datasources — see _logical/rules.py), compiles
+  it to streamable segments (_logical/planner.py), and executes ONE fused
+  remote task per source block, in parallel across the cluster.
+- limit semantics come from the planner, not special cases: a per-block
+  cap fuses into the task chain, the global cut is stream-order, a
+  row-count-changing op after `limit(n)` lands behind a fence segment (it
+  never observes rows beyond the budget — ADVICE r5 #1), and a limited
+  plan executes only the covering producer prefix.
+- `count()`/`schema()`/`num_blocks()` are answered from parquet footers /
+  range arithmetic with zero data blocks read when the plan shape allows.
 - blocks are columnar dict-of-numpy (block.py), the layout `iter_batches`
   feeds straight to `jax.device_put` for host→device prefetch.
 - `split()` hands disjoint block sets to SPMD train workers (the
@@ -25,15 +36,17 @@ import numpy as np
 from ray_tpu.data.block import (
     Block,
     block_concat,
+    block_filter_expr,
     block_num_rows,
     block_rows,
+    block_select_columns,
     block_slice,
     normalize_batch,
     rows_to_block,
 )
 
-# one op: (kind, fn) where kind in {"map_batches", "map", "filter", "flat_map"}
-_Op = Tuple[str, Callable]
+# one fused op: (kind, payload) — see _logical/operators.py FusedOp
+_Op = Tuple[str, Any]
 
 
 def _apply_ops(block: Block, ops: List[_Op]) -> Block:
@@ -49,6 +62,15 @@ def _apply_ops(block: Block, ops: List[_Op]) -> Block:
             for r in block_rows(block):
                 out.extend(fn(r))
             block = rows_to_block(out)
+        elif kind == "project":
+            block = block_select_columns(block, fn)
+        elif kind == "filter_expr":
+            block = block_filter_expr(block, fn)
+        elif kind == "limit":
+            # the per-block cap limit pushdown fuses into the chain; the
+            # GLOBAL stream-order cut happens where blocks surface
+            if block_num_rows(block) > fn:
+                block = block_slice(block, 0, fn)
         else:  # pragma: no cover — plan construction guards kinds
             raise ValueError(f"unknown op {kind}")
     return block
@@ -61,80 +83,12 @@ def _run_chain(producer_or_block, ops: List[_Op]) -> Block:
     from ray_tpu._private.core_worker import ObjectRef
 
     if isinstance(block, ObjectRef):
-        # closure-captured ref (union of materialized datasets): resolve
+        # closure-captured ref (union over materialized blocks): resolve
         # in-task — only top-level args resolve automatically
         import ray_tpu
 
         block = ray_tpu.get(block, timeout=600)
     return _apply_ops(block, ops)
-
-
-# A pipeline stage: ("tasks", ops) — stateless fused segment, one task per
-# block; or ("actors", udf_factory, args, kwargs, concurrency) — stateful
-# map_batches through an actor pool (reference:
-# python/ray/data/_internal/execution/operators/actor_pool_map_operator.py:1).
-_Stage = Tuple
-
-
-def _stable_key_hash(v) -> int:
-    """Deterministic cross-process key hash for shuffles/joins. NOT hash():
-    str hashing is per-process randomized. Numeric keys canonicalize first
-    (1, 1.0, np.int64(1), True are dict-equal and must co-partition)."""
-    import hashlib as _hl
-
-    if hasattr(v, "item"):
-        v = v.item()
-    if isinstance(v, bool):
-        v = int(v)
-    if isinstance(v, float) and v.is_integer():
-        v = int(v)
-    d = _hl.blake2b(repr(v).encode(), digest_size=8).digest()
-    return int.from_bytes(d, "little")
-
-
-
-def _shuffle_partitions(refs, requested: Optional[int] = None) -> int:
-    """Partition count for shuffle-class ops (sort/shuffle/groupby/join).
-
-    Spill-aware sizing (reference: the shuffle partitioning in
-    execution/operators/hash_shuffle + resource_manager budgets): target
-    ~shuffle_target_partition_bytes per partition from SAMPLED block sizes,
-    capped at shuffle_max_partitions — without the cap, B input blocks x
-    B partitions costs B^2 return refs and B-arg merge tasks, which is what
-    falls over at hundreds of blocks, not the O(N) data movement."""
-    if requested:
-        return max(1, int(requested))
-    n = len(refs)
-    if n <= 1:
-        return max(1, n)
-    from ray_tpu.data.context import DataContext
-
-    ctx = DataContext.get_current()
-    target = ctx.shuffle_target_partition_bytes
-    cap = ctx.shuffle_max_partitions
-    from ray_tpu.data._executor import _ref_size
-
-    # strided sample: leading blocks are often unrepresentative (header /
-    # remainder blocks from readers)
-    probe = refs[::max(1, n // 8)][:8]
-    sizes = [sz for sz in (_ref_size(r) for r in probe) if sz is not None]
-    if sizes:
-        est_total = (sum(sizes) / len(sizes)) * n
-        want = -(-int(est_total) // max(1, target))
-        return max(1, min(n, cap, max(want, 1)))
-    return max(1, min(n, cap))
-
-
-def _slice_row_range(lo: int, hi: int, block_starts, *blocks) -> Block:
-    """Rows [lo, hi) of a virtual concatenation, given each block's global
-    start offset (shared by repartition and zip alignment)."""
-    parts = []
-    for s, b in zip(block_starts, blocks):
-        n = block_num_rows(b)
-        a, z = max(lo, s), min(hi, s + n)
-        if z > a:
-            parts.append(block_slice(b, a - s, z - s))
-    return block_concat(parts) if parts else rows_to_block([])
 
 
 class _CallableWrapper:
@@ -153,127 +107,86 @@ class _CallableWrapper:
         return functools.partial(_CallableWrapper, fn)
 
 
-class _Pipeline:
-    """Executable form of a Dataset plan: source producers + stage list.
-    Submits ONE chained ref pipeline per source block; actor stages route
-    through their pool.
-
-    Pools here are FIRE-AND-FORGET: materialize() submits every block
-    before any resolves and shuts the pools down right after the barrier,
-    so no task_done feedback flows and least-loaded routing degrades to
-    submission-count balancing (which is uniform). The streaming executor
-    (_executor.StreamingExecutorV2) is the path with live load feedback."""
-
-    def __init__(self, producers, stages: List[_Stage]):
-        from ray_tpu.remote_function import RemoteFunction
-
-        self.producers = producers
-        self.stages = stages
-        from ray_tpu.data._executor import AutoScalingActorPool
-
-        self._run = RemoteFunction(_run_chain)
-        self._pools: List[Optional[AutoScalingActorPool]] = []
-        for st in stages:
-            if st[0] == "actors":
-                _, cls, args, kwargs, size = st
-                if isinstance(size, tuple):  # (min, max) autoscaling spec
-                    size = size[1]
-                # fixed-size pool (materialize() has no scheduling loop to
-                # drive scaling); the streaming executor autoscales
-                self._pools.append(
-                    AutoScalingActorPool(cls, args, kwargs, size, size))
-            else:
-                self._pools.append(None)
-
-    def submit_block(self, producer):
-        """Chain the whole stage pipeline for one source block; returns the
-        final block ref. No barriers — downstream stages start as soon as
-        their input ref resolves."""
-        from ray_tpu._private.core_worker import ObjectRef
-
-        ref = producer
-        materialized = isinstance(ref, ObjectRef)
-        for st, pool in zip(self.stages, self._pools):
-            if st[0] == "tasks":
-                if st[1] or not materialized:
-                    ref = self._run.remote(ref, st[1])
-                    materialized = True
-            else:
-                if not materialized:
-                    # actor stage first: actors take BLOCKS, so a callable
-                    # source materializes through one producer task
-                    ref = self._run.remote(ref, [])
-                    materialized = True
-                ref = pool.submit(ref)
-        if not materialized:
-            ref = self._run.remote(ref, [])
-        return ref
-
-    def shutdown(self):
-        for p in self._pools:
-            if p is not None:
-                p.shutdown()
-
-
 class Dataset:
-    """A lazy distributed collection of blocks.
-
-    `_producers` are zero-arg callables (or ObjectRefs of already-computed
-    blocks) each yielding one source block; `_ops` is the pending fused
-    chain. All transforms are lazy; `materialize()`/consumption triggers one
-    remote task per block.
+    """A lazy distributed collection of blocks, described by a logical
+    plan. All transforms are lazy (they stack plan nodes); consumption
+    optimizes + compiles the plan and triggers one fused remote task per
+    block. All-to-all ops (sort/shuffle/groupby/join/zip) execute when
+    called, through the same planner node executors.
     """
 
-    def __init__(self, producers: List[Any], ops: Optional[List[_Op]] = None,
-                 *, _refs: Optional[List[Any]] = None,
-                 _pre_stages: Optional[List[_Stage]] = None):
-        self._producers = producers
-        self._ops: List[_Op] = list(ops or [])
-        # completed pipeline segments before the trailing fused chain
-        # (actor-pool stages split the chain)
-        self._pre_stages: List[_Stage] = list(_pre_stages or [])
-        self._refs = _refs  # cached materialized block refs
-        # global row cap from limit(); blocks are cut wherever they surface
-        self._row_limit: Optional[int] = None
-        # limit FENCE: when a row-count-changing op is chained after
-        # limit(), this dataset's ops apply to the PARENT's stream-order-cut
-        # output (never to rows past the global budget) instead of fusing
-        # into the per-block chain — see _chain
-        self._limit_src: Optional["Dataset"] = None
+    def __init__(self, producers: Optional[List[Any]] = None, *,
+                 _refs: Optional[List[Any]] = None,
+                 _plan=None):
+        from ray_tpu.data._logical import operators as lops
 
-    def _stages(self) -> List[_Stage]:
-        stages = list(self._pre_stages)
-        if self._ops or not stages:
-            stages.append(("tasks", self._ops))
-        return stages
+        if _plan is not None:
+            plan = _plan
+        elif _refs is not None:
+            plan = lops.InputBlocks(list(_refs))
+        else:
+            from ray_tpu.data.datasource import SimpleDatasource
+
+            plan = lops.Read(SimpleDatasource(list(producers or [])))
+        self._plan = plan
+        self._refs = list(_refs) if _refs is not None else None
+        self._last_stats = None
+        self._opt_cache = None  # (plan identity, optimized, fired)
+        self._agg_refs: Dict[str, List[Any]] = {}
+
+    # -- plan plumbing --------------------------------------------------
+
+    @classmethod
+    def _from_plan(cls, plan) -> "Dataset":
+        return cls(_plan=plan)
+
+    @classmethod
+    def _from_datasource(cls, datasource) -> "Dataset":
+        from ray_tpu.data._logical import operators as lops
+
+        return cls(_plan=lops.Read(datasource))
+
+    @classmethod
+    def _from_refs(cls, refs: List[Any]) -> "Dataset":
+        return cls(_refs=list(refs))
+
+    def _plan_for_child(self):
+        """Derived datasets build on the materialized blocks once this one
+        executed (repeat consumption of a shared prefix is free)."""
+        from ray_tpu.data._logical import operators as lops
+
+        if self._refs is not None:
+            return lops.InputBlocks(self._refs)
+        return self._plan
+
+    def _optimizer_enabled(self) -> bool:
+        from ray_tpu.data.context import DataContext
+
+        return DataContext.get_current().optimizer_enabled
+
+    def _optimized(self):
+        """(optimized plan, fired-rule log) — cached per logical plan."""
+        if not self._optimizer_enabled():
+            return self._plan, []
+        if self._opt_cache is None or self._opt_cache[0] is not self._plan:
+            from ray_tpu.data._logical.optimizer import optimize
+
+            opt, fired = optimize(self._plan)
+            self._opt_cache = (self._plan, opt, fired)
+        return self._opt_cache[1], self._opt_cache[2]
 
     # -- transforms (lazy) ---------------------------------------------
 
-    def _chain(self, kind: str, fn: Callable) -> "Dataset":
-        if self._row_limit is not None and kind in (
-                "filter", "flat_map", "map_batches"):
-            # A row-count-changing op chained after limit(): the per-block
-            # cap + surface cut would let this op see rows past the global
-            # budget (and keep post-limit rows the cut can't tell apart).
-            # Fence the plan: the parent's stream-order cut runs first, and
-            # this op applies only to the capped stream. ("map" is 1:1, so
-            # it keeps riding the fused chain + surface cut.)
-            out = Dataset([], [(kind, fn)])
-            out._limit_src = self
-            return out
-        if self._refs is not None:
-            out = Dataset(list(self._refs), [(kind, fn)])
-        else:
-            out = Dataset(list(self._producers), self._ops + [(kind, fn)],
-                          _pre_stages=self._pre_stages)
-            out._limit_src = self._limit_src
-        out._row_limit = self._row_limit
-        return out
-
-    def map_batches(self, fn: Any, *, concurrency: Optional[int] = None,
+    def map_batches(self, fn: Any, *, columns: Optional[List[str]] = None,
+                    concurrency: Optional[int] = None,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[dict] = None) -> "Dataset":
         """Apply fn to whole blocks in columnar {col: ndarray} form.
+
+        `columns=` declares the column subset the UDF needs — a Project
+        node the optimizer folds into `read_parquet(columns=)` / `read_sql`
+        column lists (projection pushdown), so dropped columns are never
+        materialized.
 
         A CLASS (or any callable with `concurrency=`) becomes a stateful
         actor-pool stage: `concurrency` actors each construct the UDF once
@@ -282,73 +195,118 @@ class Dataset:
         tokenizers). `concurrency=(min, max)` enables queue-driven actor
         AUTOSCALING in the streaming executor (reference:
         actor_pool_map_operator.py + actor_autoscaler)."""
+        from ray_tpu.data._logical import operators as lops
+
+        plan = self._plan_for_child()
+        if columns is not None:
+            plan = lops.Project(plan, list(columns))
         if concurrency is not None or isinstance(fn, type):
-            if self._refs is None and (
-                    self._limit_src is not None
-                    or self._row_limit is not None):
-                # actor stages can change row counts too: bake the
-                # stream-order cut before the pool sees any block
-                self._block_refs()
-            base = self._refs if self._refs is not None else self._producers
-            pre = [] if self._refs is not None else self._pre_stages
-            ops = [] if self._refs is not None else self._ops
             udf = fn if isinstance(fn, type) else _CallableWrapper.of(fn)
             if isinstance(concurrency, tuple):
                 conc: Any = (int(concurrency[0]), int(concurrency[1]))
             else:
                 conc = int(concurrency or 1)
-            stage = ("actors", udf, tuple(fn_constructor_args),
-                     dict(fn_constructor_kwargs or {}), conc)
-            return Dataset(
-                list(base), [],
-                _pre_stages=pre + [("tasks", ops), stage] if ops
-                else pre + [stage],
-            )
-        return self._chain("map_batches", fn)
+            return Dataset._from_plan(lops.ActorPoolMap(
+                plan, udf, tuple(fn_constructor_args),
+                dict(fn_constructor_kwargs or {}), conc))
+        return Dataset._from_plan(lops.MapBatches(plan, fn))
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
-        return self._chain("map", fn)
+        from ray_tpu.data._logical import operators as lops
 
-    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
-        return self._chain("filter", fn)
+        return Dataset._from_plan(lops.MapRows(self._plan_for_child(), fn))
+
+    def filter(self, fn: Optional[Callable[[Any], bool]] = None, *,
+               expr=None) -> "Dataset":
+        """Keep rows where fn(row) is true — or where a STRUCTURED column
+        predicate holds: `expr=("col", ">=", 5)` (or a list of such tuples,
+        AND semantics; the pyarrow `filters=` shape). Only the structured
+        form is visible to predicate pushdown: over `read_parquet` it
+        reaches the reader's `filters=` and prunes row groups at the IO
+        layer."""
+        from ray_tpu.data._logical import operators as lops
+
+        if expr is not None:
+            if fn is not None:
+                raise ValueError("filter takes fn OR expr, not both")
+            return Dataset._from_plan(lops.Filter(
+                self._plan_for_child(),
+                expr=lops.normalize_filter_expr(expr)))
+        if fn is None:
+            raise ValueError("filter needs a callable or expr=")
+        return Dataset._from_plan(lops.Filter(self._plan_for_child(), fn=fn))
 
     def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
-        return self._chain("flat_map", fn)
+        from ray_tpu.data._logical import operators as lops
+
+        return Dataset._from_plan(lops.FlatMap(self._plan_for_child(), fn))
+
+    def select_columns(self, columns: List[str]) -> "Dataset":
+        """Project to a column subset (reference: Dataset.select_columns).
+        Folds into column-capable datasources via projection pushdown."""
+        from ray_tpu.data._logical import operators as lops
+
+        return Dataset._from_plan(
+            lops.Project(self._plan_for_child(), list(columns)))
+
+    def limit(self, n: int) -> "Dataset":
+        """Truncate to the first `n` rows (reference: Dataset.limit + the
+        logical optimizer's limit pushdown). The planner compiles this to
+        (a) a per-block cap fused into the task chain, (b) a global
+        stream-order cut wherever blocks surface, and (c) covering-prefix
+        execution — `limit(k)` over B blocks submits only the producer
+        prefix whose rows cover k. A row-count-changing op chained after
+        limit() lands behind a stream-order fence, so it never observes
+        rows beyond the global budget."""
+        from ray_tpu.data._logical import operators as lops
+
+        if n < 0:
+            raise ValueError("limit must be >= 0")
+        return Dataset._from_plan(lops.Limit(self._plan_for_child(), n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets at the PLAN level: each branch's producers
+        (with their pending chains baked into closures) join one producer
+        list — no materialization, no driver row round-trip."""
+        from ray_tpu.data._logical import operators as lops
+
+        return Dataset._from_plan(lops.Union(
+            self._plan_for_child(),
+            *[ds._plan_for_child() for ds in others]))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Rebalance rows into `num_blocks` equal blocks (lazy plan node;
+        executes on consumption). Each output task receives only the input
+        blocks overlapping its row range — O(N) total movement, not
+        all-blocks-to-every-task."""
+        from ray_tpu.data._logical import operators as lops
+
+        return Dataset._from_plan(
+            lops.Repartition(self._plan_for_child(), int(num_blocks)))
 
     # -- execution ------------------------------------------------------
 
     def materialize(self) -> "Dataset":
-        """Execute the plan: one fused remote task per block (actor stages
-        route through their pools). Returns a Dataset backed by block
-        ObjectRefs (repeat consumption is free)."""
+        """Execute the plan (optimize → compile → one fused remote task
+        per block; actor stages route through their pools). Returns a
+        Dataset backed by block ObjectRefs (repeat consumption is free)."""
         if self._refs is not None:
             return self
-        if self._limit_src is not None:
-            # limit fence: bake the parent's stream-order cut into refs,
-            # then run this dataset's post-limit ops over those (≤ n rows).
-            # A limit chained AFTER the fence must propagate so its global
-            # cut bakes too (_block_refs applies it), not just the fused
-            # per-block cap.
-            base = self._limit_src._block_refs()
-            mid = Dataset(list(base), list(self._ops))
-            mid._row_limit = self._row_limit
-            refs = mid._block_refs()
-            return Dataset(refs, [], _refs=refs)
-        import ray_tpu
-        from ray_tpu._private.core_worker import ObjectRef
+        refs = self._block_refs()
+        return Dataset(_refs=refs)
 
-        stages = self._stages()
-        if len(stages) == 1 and stages[0] == ("tasks", []):
-            if all(isinstance(p, ObjectRef) for p in self._producers):
-                refs = list(self._producers)
-                return Dataset(refs, [], _refs=refs)
-        pipeline = _Pipeline(self._producers, stages)
-        refs = [pipeline.submit_block(p) for p in self._producers]
-        if any(pool is not None for pool in pipeline._pools):
-            # actor pools must outlive their in-flight blocks
-            ray_tpu.wait(refs, num_returns=len(refs), timeout=None)
-        pipeline.shutdown()
-        return Dataset(refs, [], _refs=refs)
+    def _block_refs(self) -> List[Any]:
+        # cache the materialization on THIS dataset too: repeated consumers
+        # (sum then mean then std; schema after count) must not re-execute
+        # the whole plan per call
+        if self._refs is None:
+            from ray_tpu.data._logical import planner
+
+            plan, _fired = self._optimized()
+            refs, stats = planner.execute_to_refs(planner.compile_plan(plan))
+            self._refs = refs
+            self._last_stats = stats
+        return self._refs
 
     def iter_blocks(self, *, window: Optional[int] = None) -> Iterator[Block]:
         """STREAMING consumption: pull blocks through the plan under the
@@ -360,198 +318,79 @@ class Dataset:
         re-executes the plan (and re-creates actor pools). Call
         materialize() first to pin block refs for repeated reads — the
         aggregate/sort/shuffle paths do so internally via _block_refs."""
-        budget = self._row_limit
-
-        def cut(blocks):
-            nonlocal budget
-            for block in blocks:
-                if budget is None:
-                    yield block
-                    continue
-                if budget <= 0:
-                    return  # global limit reached: stop pulling upstream
-                rows = block_num_rows(block)
-                if rows > budget:
-                    yield Dataset._truncate_block(block, budget)
-                    budget = 0
-                    return
-                budget -= rows
-                yield block
-
         import ray_tpu
 
         if self._refs is not None:
-            yield from cut(
-                ray_tpu.get(ref, timeout=600) for ref in self._refs)
-            return
-        if self._limit_src is not None:
-            # limit fence: the parent applies its own stream-order cut (and
-            # stops pulling upstream once the budget is spent); this
-            # dataset's ops only ever see rows within the global limit
-            yield from cut(
-                _apply_ops(block, self._ops)
-                for block in self._limit_src.iter_blocks(window=window))
+            for ref in self._refs:
+                yield ray_tpu.get(ref, timeout=600)
             return
         if window is None:
             from ray_tpu.data.context import DataContext
 
             window = DataContext.get_current().streaming_block_window
-        from ray_tpu.data._executor import StreamingExecutorV2
+        from ray_tpu.data._logical import planner
 
-        ex = StreamingExecutorV2(
-            self._producers, self._stages(), window=window)
+        plan, _fired = self._optimized()
+        segments = planner.compile_plan(plan)
+        holder: dict = {}
         try:
-            yield from cut(ex)
+            yield from planner.iter_plan(segments, window=window,
+                                         holder=holder)
         finally:
-            self._last_stats = getattr(ex, "last_stats", None)
-
-    def _block_refs(self) -> List[Any]:
-        # cache the materialization on THIS dataset too: repeated consumers
-        # (sum then mean then std; schema after count) must not re-execute
-        # the whole plan per call
-        if (self._refs is None and self._row_limit is not None
-                and self._limit_src is None and len(self._producers) > 1):
-            # limit pushdown into the PLAN, not just the surface: execute
-            # producers in stream order and stop submitting once the row
-            # budget is covered — ds.limit(10) over 1,000 blocks runs the
-            # prefix, never all 1,000 tasks (reference: the logical
-            # optimizer's limit pushdown + streaming early termination)
-            refs = self._materialize_limit_prefix(self._row_limit)
-            self._row_limit = None
-            self._refs = refs
-            return refs
-        refs = self.materialize()._refs
-        if self._row_limit is not None:
-            refs = self._cut_refs(refs, self._row_limit)
-            self._row_limit = None  # the cut is baked into the refs now
-        self._refs = refs
-        return refs
-
-    def _materialize_limit_prefix(self, n: int) -> List[Any]:
-        """Execute the plan over the shortest producer prefix whose rows
-        cover `n`, in submission windows: count each window's output and
-        stop before the next window once the budget is met. Blocks past the
-        boundary are never submitted."""
-        from ray_tpu.data.context import DataContext
-        from ray_tpu.remote_function import RemoteFunction
-
-        window = max(1, DataContext.get_current().streaming_block_window)
-        cut = RemoteFunction(Dataset._truncate_block)
-        pipeline = _Pipeline(self._producers, self._stages())
-        out: List[Any] = []
-        remaining = n
-        try:
-            for start in range(0, len(self._producers), window):
-                if remaining <= 0:
-                    break
-                batch = [
-                    pipeline.submit_block(p)
-                    for p in self._producers[start:start + window]
-                ]
-                # the count barrier doubles as the pools'
-                # must-outlive-in-flight-blocks barrier per window
-                counts = self._block_row_counts(batch)
-                for ref, c in zip(batch, counts):
-                    if remaining <= 0:
-                        break  # computed past the boundary; dropped
-                    if c <= remaining:
-                        out.append(ref)
-                        remaining -= c
-                    else:
-                        out.append(cut.remote(ref, remaining))
-                        remaining = 0
-        finally:
-            # safe here: every pool-produced block resolved at its window's
-            # count barrier; the boundary cut is a plain task over an
-            # already-computed ref, so it survives pool shutdown
-            pipeline.shutdown()
-        return out
-
-    def _cut_refs(self, refs: List[Any], n: int) -> List[Any]:
-        """Global limit over materialized blocks: keep whole blocks up to
-        the boundary, slice the boundary block remotely, drop the rest."""
-        from ray_tpu.remote_function import RemoteFunction
-
-        counts = self._block_row_counts(refs)
-        out: List[Any] = []
-        remaining = n
-        cut = RemoteFunction(Dataset._truncate_block)
-        for ref, c in zip(refs, counts):
-            if remaining <= 0:
-                break
-            if c <= remaining:
-                out.append(ref)
-                remaining -= c
-            else:
-                out.append(cut.remote(ref, remaining))
-                remaining = 0
-        return out
+            self._last_stats = holder.get("stats") or self._last_stats
 
     # -- consumption ----------------------------------------------------
 
     def num_blocks(self) -> int:
-        if self._limit_src is not None and self._refs is None:
-            return self._limit_src.num_blocks()
-        return len(self._producers)
+        if self._refs is not None:
+            return len(self._refs)
+        from ray_tpu.data._logical import planner
+
+        n = planner.resolve_num_blocks(self._plan)
+        if n is not None:
+            return n
+        return len(self._block_refs())
 
     def count(self) -> int:
-        import ray_tpu
+        """Row count. When the (optimized) plan supports it — parquet
+        footers, range/from_items arithmetic, row-preserving chains — the
+        answer comes from METADATA with zero data blocks read; the
+        recorded stats show no tasks ran."""
+        from ray_tpu.data._logical import planner
 
+        if self._refs is None and self._optimizer_enabled():
+            plan, _fired = self._optimized()
+            n = planner.resolve_count(plan)
+            if n is not None:
+                self._last_stats = planner.record_metadata_stats(
+                    "", "count", f"{n} rows, zero blocks read")
+                return n
         refs = self._block_refs()
-        return sum(
-            block_num_rows(b) for b in ray_tpu.get(refs, timeout=600)
-        )
-
-    def limit(self, n: int) -> "Dataset":
-        """Truncate to the first `n` rows (reference: Dataset.limit +
-        the logical optimizer's limit pushdown). Two halves: a per-block
-        cap PUSHES DOWN into the fused task chain, and the GLOBAL cut is
-        enforced in stream order wherever blocks surface — _block_refs,
-        iter_blocks, take/count — via the propagated row-limit mark.
-        Chaining a row-count-changing op (filter/flat_map/map_batches)
-        after limit() fences the plan at the limit (see _chain), so such
-        ops never observe rows beyond the global budget."""
-        if n < 0:
-            raise ValueError("limit must be >= 0")
-
-        def _truncate(block: Block) -> Block:
-            if isinstance(block, dict):
-                return {c: v[:n] for c, v in block.items()}
-            return list(block)[:n]
-
-        out = self._chain("map_batches", _truncate)
-        prev = getattr(self, "_row_limit", None)
-        out._row_limit = n if prev is None else min(prev, n)
-        return out
-
-    @staticmethod
-    def _truncate_block(block: Block, n: int) -> Block:
-        if isinstance(block, dict):
-            return {c: np.asarray(v)[:n] for c, v in block.items()}
-        return list(block)[:n]
+        return sum(planner._row_counts(refs))
 
     def explain(self) -> str:
-        """Human-readable logical plan: the fused stage chain this dataset
-        executes (reference: the logical plan the data optimizer prints).
-        One "tasks[...]" stage = ONE fused remote task per block; a
-        "limit[...]" line marks a stream-order fence (ops below it only see
-        rows within the global budget)."""
-        if self._limit_src is not None and self._refs is None:
-            lines = self._limit_src.explain().splitlines()
-            lines.append("  limit[stream-order fence: "
-                         f"{self._limit_src._row_limit} rows]")
+        """The planner's full story: the logical plan this dataset built,
+        the optimizer rules that fired (fusion, limit/projection/predicate
+        pushdown), and the compiled physical stages. One "tasks[...]" line
+        = ONE fused remote task per block; a "limit[stream-order fence: n
+        rows]" line marks a fence (ops below it only ever see rows within
+        the global budget)."""
+        from ray_tpu.data._logical import operators as lops
+        from ray_tpu.data._logical import planner
+
+        lines = ["Logical plan:"]
+        lines += ["  " + s for s in lops.render_tree(self._plan)]
+        if self._optimizer_enabled():
+            plan, fired = self._optimized()
+            lines.append("Rules fired:")
+            lines += [f"  - {f}" for f in fired] or ["  (none)"]
         else:
-            lines = [f"Dataset({len(self._producers)} blocks"
-                     f"{', materialized' if self._refs is not None else ''})"]
-        for kind, *rest in self._stages():
-            if kind == "tasks":
-                ops = rest[0]
-                names = [op for op, _fn in ops] or ["read"]
-                lines.append(f"  tasks[fused: {' -> '.join(names)}]")
-            else:
-                _cls, _args, _kwargs, conc = rest
-                lines.append(f"  actors[{_cls.__name__}, "
-                             f"concurrency={conc}]")
+            plan = self._plan
+            lines.append("Rules fired:")
+            lines.append("  (optimizer disabled)")
+        lines.append("Physical plan:")
+        lines += planner.describe_segments(
+            planner.compile_plan(plan, allow_execute=False))
         return "\n".join(lines)
 
     def take(self, limit: int = 20) -> List[Any]:
@@ -620,179 +459,34 @@ class Dataset:
         repartitions first so every shard has the same row count (±1), which
         SPMD training needs for lockstep batches."""
         if equal:
-            refs = self.repartition(n)._refs
-            return [Dataset([r], [], _refs=[r]) for r in refs]
+            refs = self.repartition(n)._block_refs()
+            return [Dataset(_refs=[r]) for r in refs]
         refs = self._block_refs()
         shards: List[List[Any]] = [[] for _ in range(n)]
         for i, ref in enumerate(refs):
             shards[i % n].append(ref)
-        return [Dataset(s, [], _refs=s) for s in shards]
-
-    def _block_row_counts(self, refs: List[Any]) -> List[int]:
-        import ray_tpu
-        from ray_tpu.remote_function import RemoteFunction
-
-        count = RemoteFunction(block_num_rows)
-        return ray_tpu.get([count.remote(r) for r in refs], timeout=600)
-
-    def repartition(self, num_blocks: int) -> "Dataset":
-        """Rebalance rows into `num_blocks` equal blocks (materializes).
-
-        Each output task receives only the input blocks overlapping its row
-        range — O(N) total movement, not all-blocks-to-every-task."""
-        import ray_tpu
-        from ray_tpu.remote_function import RemoteFunction
-
-        refs = self._block_refs()
-        counts = self._block_row_counts(refs)
-        starts = list(np.cumsum([0] + counts))  # global start offset per block
-        total = starts[-1]
-
-        run = RemoteFunction(_slice_row_range)
-        new_refs = []
-        for i in range(num_blocks):
-            lo, hi = (total * i) // num_blocks, (total * (i + 1)) // num_blocks
-            overlap = [
-                j for j in range(len(refs))
-                if starts[j] < hi and starts[j] + counts[j] > lo
-            ]
-            new_refs.append(run.remote(
-                lo, hi, [starts[j] for j in overlap], *[refs[j] for j in overlap]
-            ))
-        return Dataset(new_refs, [], _refs=new_refs)
+        return [Dataset(_refs=s) for s in shards]
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Global random shuffle (materializes). Two-stage push shuffle as in
-        the reference's shuffle ops: each input block scatters its rows into
-        k partitions (one task, k returns); each output concatenates and
-        permutes its k incoming parts — O(N) total movement."""
-        from ray_tpu.remote_function import RemoteFunction
+        """Global random shuffle (materializes). Two-stage push shuffle as
+        in the reference's shuffle ops — O(N) total movement; executed by
+        the planner's RandomShuffle node."""
+        from ray_tpu.data._logical import operators as lops
+        from ray_tpu.data._logical import planner
 
-        refs = self._block_refs()
-        k = _shuffle_partitions(refs)
-        if len(refs) <= 1:
-            return Dataset(list(refs), [], _refs=list(refs))
-
-        def _scatter(sd, j: int, k: int, block):
-            rng = np.random.default_rng(None if sd is None else sd * 1_000_003 + j)
-            n = block_num_rows(block)
-            assign = rng.integers(0, k, size=n)
-            if isinstance(block, dict):
-                return tuple(
-                    {c: v[assign == i] for c, v in block.items()} for i in range(k)
-                )
-            items = list(block)
-            return tuple(
-                [items[t] for t in np.flatnonzero(assign == i)] for i in range(k)
-            )
-
-        def _merge(sd, i: int, *parts):
-            whole = block_concat(list(parts))
-            rng = np.random.default_rng(None if sd is None else sd * 7_000_003 + i)
-            n = block_num_rows(whole)
-            perm = rng.permutation(n)
-            if isinstance(whole, dict):
-                return {c: v[perm] for c, v in whole.items()}
-            return [whole[j] for j in perm]
-
-        merge = RemoteFunction(_merge)
-        if k == 1:
-            # size-driven single partition: permute everything in one task
-            new_refs = [merge.remote(seed, 0, *refs)]
-            return Dataset(new_refs, [], _refs=new_refs)
-        scatter = RemoteFunction(_scatter).options(num_returns=k)
-        # EVERY input block scatters (k is the partition count, which may
-        # be smaller than the block count under spill-aware sizing)
-        partitions = [
-            scatter.remote(seed, j, k, refs[j]) for j in range(len(refs))
-        ]
-        new_refs = [
-            merge.remote(seed, i, *[p[i] for p in partitions])
-            for i in range(k)
-        ]
-        return Dataset(new_refs, [], _refs=new_refs)
-
-    @staticmethod
-    def _sort_single_partition(refs, key, descending) -> "Dataset":
-        """One global sort task (a per-block sort would not be a global
-        order when several blocks feed one partition)."""
-        from ray_tpu.remote_function import RemoteFunction
-
-        def _sort_all(*blocks):
-            return _sort_block(block_concat(list(blocks)), key, descending)
-
-        new_refs = [RemoteFunction(_sort_all).remote(*refs)]
-        return Dataset(new_refs, [], _refs=new_refs)
+        node = lops.RandomShuffle(lops.InputBlocks(self._block_refs()), seed)
+        return Dataset._from_refs(planner.execute_node(node))
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
-        """Distributed sort (materializes): sample key range → range-partition
-        scatter → per-partition sort (reference: data sort ops; the classic
-        TeraSort shape, O(N) movement + parallel partition sorts)."""
-        import ray_tpu
-        from ray_tpu.remote_function import RemoteFunction
+        """Distributed sort (materializes): sample key range →
+        range-partition scatter → per-partition sort (reference: data sort
+        ops; the classic TeraSort shape) — the planner's Sort node."""
+        from ray_tpu.data._logical import operators as lops
+        from ray_tpu.data._logical import planner
 
-        refs = self._block_refs()
-        k = _shuffle_partitions(refs)
-        if not refs:
-            return Dataset([], [], _refs=[])
-        if k == 1:
-            # no range bounds needed — skip the sampling round-trip
-            return self._sort_single_partition(refs, key, descending)
-
-        def _sample(block):
-            col = np.asarray(block[key]) if isinstance(block, dict) else (
-                np.asarray([r[key] for r in block_rows(block)])
-            )
-            if col.size == 0:
-                return col
-            take = min(64, col.size)
-            idx = np.random.default_rng(0).choice(col.size, take, replace=False)
-            return col[idx]
-
-        samples = np.concatenate([
-            s for s in ray_tpu.get(
-                [RemoteFunction(_sample).remote(r) for r in refs], timeout=600)
-            if s.size
-        ])
-        if samples.size == 0:
-            return self._sort_single_partition(refs, key, descending)
-        # positional quantiles, not np.quantile: sort keys may be strings
-        # (any sortable dtype) and only order matters for range bounds
-        srt = np.sort(samples)
-        bounds = srt[[
-            min(srt.size - 1, max(0, (srt.size * i) // k)) for i in range(1, k)
-        ]]
-
-        def _scatter(block, bounds):
-            col = np.asarray(block[key]) if isinstance(block, dict) else (
-                np.asarray([r[key] for r in block_rows(block)])
-            )
-            assign = np.searchsorted(bounds, col, side="right")
-            n_parts = len(bounds) + 1
-            if isinstance(block, dict):
-                return tuple(
-                    {c: np.asarray(v)[assign == i] for c, v in block.items()}
-                    for i in range(n_parts)
-                )
-            items = list(block)
-            return tuple(
-                [items[t] for t in np.flatnonzero(assign == i)]
-                for i in range(n_parts)
-            )
-
-        def _merge_sort(*parts):
-            return _sort_block(block_concat(list(parts)), key, descending)
-
-        scatter = RemoteFunction(_scatter).options(num_returns=k)
-        partitions = [scatter.remote(r, bounds) for r in refs]
-        order = range(k - 1, -1, -1) if descending else range(k)
-        # fan-in over EVERY scatter (len(refs)), not range(k): k may be
-        # size-driven < len(refs)
-        new_refs = [
-            RemoteFunction(_merge_sort).remote(*[p[i] for p in partitions])
-            for i in order
-        ]
-        return Dataset(new_refs, [], _refs=new_refs)
+        node = lops.Sort(lops.InputBlocks(self._block_refs()), key,
+                         descending)
+        return Dataset._from_refs(planner.execute_node(node))
 
     def groupby(self, key: str) -> "GroupedData":
         """Group rows by a key column (reference: Dataset.groupby +
@@ -801,142 +495,59 @@ class Dataset:
 
     # -- multi-dataset ops (reference: Dataset.union/zip/join) ----------
 
-    def union(self, *others: "Dataset") -> "Dataset":
-        """Concatenate datasets (block-wise, no materialization): each
-        source block carries its own pending chain into the combined plan."""
-        import functools
-
-        def items(ds: "Dataset") -> List[Any]:
-            if ds._refs is not None:
-                return list(ds._refs)
-            if ds._limit_src is not None or ds._row_limit is not None:
-                # limit semantics can't ride a fused closure: bake the cut
-                return list(ds._block_refs())
-            stages = ds._stages()
-            if stages == [("tasks", [])]:
-                return list(ds._producers)
-            if all(s[0] == "tasks" for s in stages):
-                ops = [op for s in stages for op in s[1]]
-                return [functools.partial(_run_chain, p, ops)
-                        for p in ds._producers]
-            # actor stages can't ride a closure: materialize that branch
-            return list(ds.materialize()._refs)
-
-        combined: List[Any] = []
-        for ds in (self, *others):
-            combined.extend(items(ds))
-        return Dataset(combined, [])
-
     def zip(self, other: "Dataset") -> "Dataset":
-        """Column-wise zip of two datasets with equal row counts (reference:
-        Dataset.zip): the other dataset is range-repartitioned to this one's
-        block boundaries, then each aligned pair merges columns in one task
-        (duplicate names get a _1 suffix)."""
-        import ray_tpu
-        from ray_tpu.remote_function import RemoteFunction
+        """Column-wise zip of two datasets with equal row counts
+        (reference: Dataset.zip): the other dataset is range-repartitioned
+        to this one's block boundaries, then each aligned pair merges
+        columns in one task (duplicate names get a _1 suffix). Validates
+        row counts up front (materializes both sides)."""
+        from ray_tpu.data._logical import operators as lops
+        from ray_tpu.data._logical import planner
 
-        left = self._block_refs()
-        counts = self._block_row_counts(left)
-        right_all = other._block_refs()
-        r_counts = other._block_row_counts(right_all)
-        if sum(counts) != sum(r_counts):
-            raise ValueError(
-                f"zip needs equal row counts: {sum(counts)} vs {sum(r_counts)}")
-        r_starts = list(np.cumsum([0] + r_counts))
-
-        def _zip_blocks(a, b):
-            if not isinstance(a, dict) or not isinstance(b, dict):
-                return [
-                    (ra, rb) for ra, rb in zip(block_rows(a), block_rows(b))
-                ]
-            out = dict(a)
-            for k, v in b.items():
-                out[k if k not in out else f"{k}_1"] = v
-            return out
-
-        slicer = RemoteFunction(_slice_row_range)
-        zipper = RemoteFunction(_zip_blocks)
-        new_refs = []
-        lo = 0
-        for ref, n in zip(left, counts):
-            hi = lo + n
-            overlap = [
-                j for j in range(len(right_all))
-                if r_starts[j] < hi and r_starts[j] + r_counts[j] > lo
-            ]
-            aligned = slicer.remote(
-                lo, hi, [r_starts[j] for j in overlap],
-                *[right_all[j] for j in overlap])
-            new_refs.append(zipper.remote(ref, aligned))
-            lo = hi
-        return Dataset(new_refs, [], _refs=new_refs)
+        node = lops.Zip(lops.InputBlocks(self._block_refs()),
+                        lops.InputBlocks(other._block_refs()))
+        return Dataset._from_refs(planner.execute_node(node))
 
     def join(self, other: "Dataset", on: str, *, how: str = "inner",
              num_partitions: Optional[int] = None) -> "Dataset":
         """Distributed hash join on column `on` (reference: the data join
-        operator / hash_shuffle): both sides scatter rows by hash(key) into
-        k partitions (one task per block, k returns), then one task per
-        partition builds a hash table from the left rows and probes with the
-        right — O(N) movement, k-way parallel joins."""
+        operator / hash_shuffle) — the planner's Join node: both sides
+        scatter rows by hash(key) into k partitions, then one task per
+        partition builds-and-probes — O(N) movement, k-way parallel."""
         if how not in ("inner", "left"):
             raise ValueError(f"unsupported join type {how!r}")
-        from ray_tpu.remote_function import RemoteFunction
+        from ray_tpu.data._logical import operators as lops
+        from ray_tpu.data._logical import planner
 
-        left = self._block_refs()
-        right = other._block_refs()
-        # size BOTH sides: a huge few-block side must not collapse the
-        # join because the other side has more (tiny) blocks
-        k = (int(num_partitions) if num_partitions
-             else max(_shuffle_partitions(left), _shuffle_partitions(right)))
-
-        def _scatter(block, k):
-            rows = list(block_rows(block))
-            parts: List[List[Any]] = [[] for _ in range(k)]
-            for r in rows:
-                parts[_stable_key_hash(r[on]) % k].append(r)
-            return tuple(rows_to_block(p) for p in parts)
-
-        def _join_partition(n_left, *parts):
-            lrows = [r for b in parts[:n_left] for r in block_rows(b)]
-            rrows = [r for b in parts[n_left:] for r in block_rows(b)]
-            table: Dict[Any, List[Any]] = {}
-            for r in rrows:
-                table.setdefault(r[on], []).append(r)
-            out = []
-            for lr in lrows:
-                matches = table.get(lr[on])
-                if matches:
-                    for rr in matches:
-                        merged = dict(lr)
-                        for ck, cv in rr.items():
-                            if ck != on:
-                                merged[ck if ck not in merged
-                                       else f"{ck}_1"] = cv
-                        out.append(merged)
-                elif how == "left":
-                    out.append(dict(lr))
-            return rows_to_block(out)
-
-        joiner = RemoteFunction(_join_partition)
-        if k == 1:
-            # num_returns=1 .remote() stores the 1-tuple whole; skip the
-            # scatter and hand the raw block refs to the join task (advisor r3)
-            new_refs = [joiner.remote(len(left), *left, *right)]
-        else:
-            scatter = RemoteFunction(_scatter).options(num_returns=k)
-            lparts = [scatter.remote(r, k) for r in left]
-            rparts = [scatter.remote(r, k) for r in right]
-            new_refs = [
-                joiner.remote(
-                    len(lparts),
-                    *[lp[i] for lp in lparts],
-                    *[rp[i] for rp in rparts],
-                )
-                for i in range(k)
-            ]
-        return Dataset(new_refs, [], _refs=new_refs)
+        node = lops.Join(lops.InputBlocks(self._block_refs()),
+                         lops.InputBlocks(other._block_refs()),
+                         on, how, num_partitions)
+        return Dataset._from_refs(planner.execute_node(node))
 
     # -- global aggregates (reference: Dataset.sum/min/max/mean/std) ----
+
+    def _agg_input_refs(self, col: Optional[str]) -> List[Any]:
+        """Block refs feeding a single-column aggregate. On an
+        unmaterialized plan over a column-capable source, a Project([col])
+        is pushed through the optimizer first — the read materializes ONLY
+        that column (projection pushdown for aggregates)."""
+        if self._refs is not None:
+            return self._refs
+        if col is not None and self._optimizer_enabled():
+            if col in self._agg_refs:
+                return self._agg_refs[col]
+            from ray_tpu.data._logical import operators as lops
+            from ray_tpu.data._logical.optimizer import optimize
+            from ray_tpu.data._logical import planner
+
+            opt, _fired = optimize(lops.Project(self._plan, [col]))
+            if planner.projection_folded(opt):
+                refs, stats = planner.execute_to_refs(
+                    planner.compile_plan(opt))
+                self._agg_refs[col] = refs
+                self._last_stats = stats
+                return refs
+        return self._block_refs()
 
     def _column_stats(self, col: str):
         import ray_tpu
@@ -964,7 +575,8 @@ class Dataset:
             return (int(v.size), total, sq, mn, mx)
 
         parts = ray_tpu.get(
-            [RemoteFunction(_stats).remote(r) for r in self._block_refs()],
+            [RemoteFunction(_stats).remote(r)
+             for r in self._agg_input_refs(col)],
             timeout=600,
         )
         n = sum(p[0] for p in parts)
@@ -999,18 +611,32 @@ class Dataset:
     # -- introspection --------------------------------------------------
 
     def stats(self) -> str:
-        """Per-op execution table of the most recent STREAMING consumption
+        """Per-op execution table of the most recent consumption
         (reference: python/ray/data/stats.py — blocks, bytes, task times,
-        peak concurrency/queue, backpressure time per operator)."""
-        st = getattr(self, "_last_stats", None)
+        peak concurrency/queue, backpressure time per operator; the
+        materialize path reports per-segment rows, metadata-answered
+        queries report a zero-task metadata row)."""
+        st = self._last_stats
         if st is None:
-            return ("(no stats yet: stats cover streaming consumption — "
-                    "iterate the dataset first)")
+            return ("(no stats yet: stats cover plan execution — "
+                    "consume the dataset first)")
         return str(st)
 
     def schema(self) -> Optional[Dict[str, str]]:
+        """{column: dtype}. Answered from datasource METADATA (parquet
+        footer schema, range arithmetic) when the plan shape allows —
+        zero data blocks read."""
         import ray_tpu
 
+        from ray_tpu.data._logical import planner
+
+        if self._refs is None and self._optimizer_enabled():
+            plan, _fired = self._optimized()
+            s = planner.resolve_schema(plan)
+            if s is not None:
+                self._last_stats = planner.record_metadata_stats(
+                    "", "schema", "zero blocks read")
+                return s
         refs = self._block_refs()
         if not refs:
             return None
@@ -1020,96 +646,35 @@ class Dataset:
         return None
 
     def __repr__(self):
-        ops = "->".join(k for k, _ in self._ops) or "source"
-        return f"Dataset(blocks={len(self._producers)}, plan={ops})"
+        from ray_tpu.data._logical import planner
 
-
-def _sort_block(block: Block, key: str, descending: bool) -> Block:
-    if isinstance(block, dict):
-        col = np.asarray(block[key])
-        order = np.argsort(col, kind="stable")
-        if descending:
-            order = order[::-1]
-        return {c: np.asarray(v)[order] for c, v in block.items()}
-    rows = sorted(block_rows(block), key=lambda r: r[key], reverse=descending)
-    return rows_to_block(rows)
+        nb = (len(self._refs) if self._refs is not None
+              else planner.resolve_num_blocks(self._plan))
+        return (f"Dataset(blocks={'?' if nb is None else nb}, "
+                f"plan={self._plan.label()})")
 
 
 class GroupedData:
     """Hash-partitioned group-by + aggregates (reference: data groupby with
-    hash_shuffle aggregate operators). Keys scatter to k partitions by hash;
-    each partition aggregates its groups independently."""
-
-    # per-group leaf computed inside one partition: hash partitioning puts
-    # ALL rows of a group in the same partition, so no cross-partition
-    # combine is needed — mean included
-    _AGGS = {
-        "count": len,
-        "sum": lambda vals: np.sum(vals).item(),
-        "min": lambda vals: np.min(vals).item(),
-        "max": lambda vals: np.max(vals).item(),
-        "mean": lambda vals: float(np.mean(vals)),
-    }
+    hash_shuffle aggregate operators), executed by the planner's GroupByAgg
+    node. Keys scatter to k partitions by hash; each partition aggregates
+    its groups independently."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
     def _aggregate(self, agg: str, col: Optional[str]) -> Dataset:
-        from ray_tpu.remote_function import RemoteFunction
+        from ray_tpu.data._logical import operators as lops
+        from ray_tpu.data._logical import planner
 
-        if agg not in self._AGGS:
+        if agg not in planner.GROUP_AGGS:
             raise ValueError(f"unknown aggregate {agg!r}")
-        key = self._key
         refs = self._ds._block_refs()
         if not refs:
-            return Dataset([], [], _refs=[])
-        k = _shuffle_partitions(refs)
-
-        def _scatter(block, k):
-            keys = (np.asarray(block[key]) if isinstance(block, dict)
-                    else np.asarray([r[key] for r in block_rows(block)]))
-            assign = np.asarray(
-                [_stable_key_hash(x) % k for x in keys.tolist()])
-            if isinstance(block, dict):
-                return tuple(
-                    {c: np.asarray(v)[assign == i] for c, v in block.items()}
-                    for i in range(k)
-                )
-            items = list(block)
-            return tuple(
-                [items[t] for t in np.flatnonzero(assign == i)]
-                for i in range(k)
-            )
-
-        def _agg_partition(agg, col, *parts):
-            whole = block_concat(list(parts))
-            groups: Dict[Any, list] = {}
-            for r in block_rows(whole):
-                groups.setdefault(r[key], []).append(
-                    r[col] if col is not None else 1
-                )
-            leaf = GroupedData._AGGS[agg]
-            out_name = f"{agg}({col})" if col else "count()"
-            return rows_to_block([
-                {key: gk, out_name: leaf(vals)} for gk, vals in groups.items()
-            ])
-
-        agg_fn = RemoteFunction(_agg_partition)
-        if k == 1:
-            # no scatter needed — but EVERY block feeds the one partition
-            # (k may be size-driven < len(refs) now)
-            new_refs = [agg_fn.remote(agg, col, *refs)]
-        else:
-            scatter = RemoteFunction(_scatter).options(num_returns=k)
-            partitions = [scatter.remote(r, k) for r in refs]
-            # fan-in over EVERY scatter (len(refs) of them), not range(k):
-            # k may be size-driven < len(refs)
-            new_refs = [
-                agg_fn.remote(agg, col, *[p[i] for p in partitions])
-                for i in range(k)
-            ]
-        return Dataset(new_refs, [], _refs=new_refs)
+            return Dataset(_refs=[])
+        node = lops.GroupByAgg(lops.InputBlocks(refs), self._key, agg, col)
+        return Dataset._from_refs(planner.execute_node(node))
 
     def count(self) -> Dataset:
         return self._aggregate("count", None)

@@ -15,7 +15,9 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _REGISTRY: Dict[str, "Metric"] = {}
-_REG_LOCK = threading.Lock()
+# reentrant: get_or_create_counter constructs (which registers) while
+# holding the lock, so lookup-or-create is one atomic step
+_REG_LOCK = threading.RLock()
 
 
 def _tags_key(tags: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -77,6 +79,25 @@ class Counter(Metric):
                  "value": v, "help": self.description}
                 for k, v in self._values.items()
             ]
+
+
+def get_or_create_counter(name: str, description: str = "",
+                          tag_keys: Optional[Sequence[str]] = None
+                          ) -> Counter:
+    """Idempotent Counter handle: the registered instance if one exists,
+    else a fresh registration — instrumentation call sites need no
+    module-global caching (and can't half-initialize a metric family).
+    Atomic under _REG_LOCK: concurrent first calls converge on ONE
+    instance, so no increments land on a discarded duplicate."""
+    with _REG_LOCK:
+        existing = _REGISTRY.get(name)
+        if existing is not None:
+            if isinstance(existing, Counter):
+                return existing
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{existing.metric_type}, not counter")
+        return Counter(name, description, tag_keys)
 
 
 class Gauge(Metric):

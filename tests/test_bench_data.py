@@ -1,0 +1,36 @@
+"""Tiny-shape smoke of bench_data.py in the tier-1 suite: every benchmark
+runs both sides of the optimizer A/B, asserts its own correctness, and
+emits well-formed records."""
+
+import sys
+
+import pytest
+
+import ray_tpu
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_bench_data_quick_suite(ray_init):
+    import bench_data
+
+    results = bench_data.run_suite(quick=True)
+    names = {(r["bench"], r["optimizer"]) for r in results}
+    for bench in ("fused_pipeline", "limit_pushdown",
+                  "parquet_projection_sum", "parquet_count"):
+        assert (bench, "on") in names and (bench, "off") in names, names
+    assert ("driver_rss_delta", "n/a") in names
+    for r in results:
+        assert isinstance(r["value"], (int, float))
+        assert r["unit"] in ("rows/s", "ms", "MB")
+    # the escape hatch was restored
+    from ray_tpu.data.context import DataContext
+
+    assert DataContext.get_current().optimizer_enabled is True

@@ -49,7 +49,7 @@ def test_larger_than_store_map_sort_streams_with_spill(small_store):
                     "v": np.full(rows, float(i))}
         return make
 
-    ds = rtd.Dataset([gen(i) for i in range(n_blocks)], [])
+    ds = rtd.Dataset([gen(i) for i in range(n_blocks)])
     ds = ds.map_batches(lambda b: {"k": b["k"], "v": b["v"] * 2.0})
     out = ds.sort("k")
     # stream the sorted result and verify global order with constant memory

@@ -1,0 +1,500 @@
+"""The data query planner (ray_tpu/data/_logical): logical plan + rules +
+physical compilation (reference: python/ray/data/_internal/logical/
+optimizers.py rules, planner/planner.py:230).
+
+Covers: operator fusion as a recorded rule, limit pushdown/fold, projection
+pushdown into read_parquet(columns=)/read_sql, predicate pushdown into
+pyarrow filters=, metadata shortcuts (count/schema/num_blocks from footers
+and range arithmetic with ZERO data blocks read), plan-level union, and the
+DataContext.optimizer_enabled escape hatch.
+"""
+
+import glob
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data._logical import operators as lops
+from ray_tpu.data._logical import planner
+from ray_tpu.data._logical.optimizer import optimize
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def optimizer_off():
+    ctx = DataContext.get_current()
+    old = ctx.optimizer_enabled
+    ctx.optimizer_enabled = False
+    yield
+    ctx.optimizer_enabled = old
+
+
+def _write_parquet(tmp_path, n_files=3, rows=10):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for i in range(n_files):
+        pq.write_table(
+            pa.table({
+                "a": list(range(i * rows, i * rows + rows)),
+                "b": [float(x) for x in range(rows)],
+                "c": [f"s{x}" for x in range(rows)],
+            }),
+            str(tmp_path / f"part{i}.parquet"),
+        )
+    return str(tmp_path)
+
+
+def _marked_producers(n_blocks, rows_per_block, marker_dir):
+    def make(i):
+        def produce():
+            open(os.path.join(marker_dir, f"b{i}"), "w").close()
+            return {"x": np.arange(rows_per_block) + i * rows_per_block}
+        return produce
+
+    return [make(i) for i in range(n_blocks)]
+
+
+# ---------------------------------------------------------------------------
+# rules (no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+def test_fusion_rule_merges_adjacent_maps():
+    ds = (rd.range(100, parallelism=4)
+          .map_batches(lambda b: b)
+          .filter(lambda r: True)
+          .map(lambda r: r))
+    opt, fired = optimize(ds._plan)
+    assert any("OperatorFusion" in f for f in fired), fired
+    fused = [n for n in lops.walk(opt) if isinstance(n, lops.FusedMap)]
+    assert len(fused) == 1
+    assert [k for k, _ in fused[0].ops] == ["map_batches", "filter", "map"]
+
+
+def test_limit_pushdown_below_row_preserving_ops():
+    ds = rd.range(100, parallelism=4).map(lambda r: r).limit(7)
+    opt, fired = optimize(ds._plan)
+    assert any("LimitPushdown" in f for f in fired), fired
+    # dataflow after rewrite: Read -> Limit -> Map (limit nearest the read)
+    node = opt
+    while not isinstance(node, lops.Limit):
+        node = node.input
+    assert isinstance(node.input, lops.Read)
+
+
+def test_limit_fold_takes_the_tighter_budget():
+    ds = rd.range(100, parallelism=4).limit(10).limit(4)
+    opt, fired = optimize(ds._plan)
+    assert any("LimitFold" in f for f in fired), fired
+    limits = [n for n in lops.walk(opt) if isinstance(n, lops.Limit)]
+    assert len(limits) == 1 and limits[0].n == 4
+
+
+def test_compile_places_fence_after_limit():
+    ds = rd.range(100, parallelism=4).limit(3).flat_map(lambda r: [r, r])
+    opt, _ = optimize(ds._plan)
+    segs = planner.compile_plan(opt, allow_execute=False)
+    assert len(segs) == 2
+    assert segs[0].limit == 3 and segs[1].limit is None
+    plan = ds.explain()
+    assert "limit[stream-order fence: 3 rows]" in plan
+
+
+def test_explain_prints_all_three_layers(ray_init):
+    ds = rd.range(100, parallelism=4).map_batches(
+        lambda b: b).filter(lambda r: True).limit(5)
+    plan = ds.explain()
+    assert "Logical plan:" in plan
+    assert "Rules fired:" in plan
+    assert "Physical plan:" in plan
+    assert "OperatorFusion" in plan
+    assert "tasks[fused:" in plan
+
+
+# ---------------------------------------------------------------------------
+# projection pushdown
+# ---------------------------------------------------------------------------
+
+
+def test_projection_pushdown_into_parquet(ray_init, tmp_path):
+    root = _write_parquet(tmp_path)
+    ds = rd.read_parquet(root).select_columns(["a"])
+    opt, fired = optimize(ds._plan)
+    assert any("ProjectionPushdown" in f for f in fired), fired
+    reads = [n for n in lops.walk(opt) if isinstance(n, lops.Read)]
+    assert reads and reads[0].datasource.columns == ["a"]
+    # no residual Project: the reader returns exactly the projection
+    assert planner.projection_folded(opt)
+    rows = ds.take_all()
+    assert all(set(r) == {"a"} for r in rows)
+    assert sorted(r["a"] for r in rows) == list(range(30))
+
+
+def test_map_batches_columns_kwarg_projects(ray_init, tmp_path):
+    root = _write_parquet(tmp_path)
+    seen = {}
+
+    def udf(b):
+        seen["cols"] = sorted(b.keys())
+        return {"a2": b["a"] * 2}
+
+    ds = rd.read_parquet(root).map_batches(udf, columns=["a"])
+    total = sum(r["a2"] for r in ds.take_all())
+    assert total == 2 * sum(range(30))
+    opt, _ = optimize(ds._plan)
+    assert planner.projection_folded(opt)
+
+
+def test_projection_pushdown_into_sql(ray_init, tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER, v REAL, s TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?, ?, ?)",
+                     [(i, i * 0.5, f"x{i}") for i in range(50)])
+    conn.commit()
+    conn.close()
+
+    import functools
+
+    ds = rd.read_sql("SELECT * FROM t", functools.partial(
+        sqlite3.connect, db)).select_columns(["id", "v"])
+    opt, fired = optimize(ds._plan)
+    assert any("ProjectionPushdown" in f for f in fired), fired
+    rows = ds.take_all()
+    assert all(set(r) == {"id", "v"} for r in rows)
+    assert sorted(r["id"] for r in rows) == list(range(50))
+
+
+def test_sql_projection_keeps_partition_column_visible(ray_init, tmp_path):
+    """Pushed-down columns may EXCLUDE partition_column: the partition
+    WHERE must bind against the inner query, not the projected wrapper
+    (regression: the projection used to wrap inside the predicate, so
+    sqlite's quoted-identifier fallback read \"id\" as a string literal
+    and one partition swallowed every row)."""
+    import functools
+    import sqlite3
+
+    db = str(tmp_path / "p.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?, ?)",
+                     [(i, f"n{i}") for i in range(10)])
+    conn.commit()
+    conn.close()
+
+    ds = rd.read_sql(
+        "SELECT * FROM t", functools.partial(sqlite3.connect, db),
+        parallelism=2, partition_column="id",
+        lower_bound=0, upper_bound=10).select_columns(["name"])
+    _opt, fired = optimize(ds._plan)
+    assert any("ProjectionPushdown" in f for f in fired), fired
+    refs = ds._block_refs()
+    assert len(refs) == 2
+    sizes = [len(ray_tpu.get(r, timeout=60)["name"]) for r in refs]
+    assert sizes == [5, 5], sizes  # both partitions populated, no skew
+    assert sorted(r["name"] for r in ds.take_all()) == \
+        sorted(f"n{i}" for i in range(10))
+
+
+def test_project_over_project_not_collapsed_past_dropped_column(ray_init):
+    """select_columns(['a']).select_columns(['b']) must ERROR like the
+    unoptimized plan, not resurrect the dropped column b (regression: the
+    project∘project fold skipped the subset check)."""
+    ds = rd.from_items([{"a": i, "b": i * 2} for i in range(8)])
+    good = ds.select_columns(["a", "b"]).select_columns(["b"])
+    _opt, fired = optimize(good._plan)
+    assert any("project∘project" in f for f in fired), fired
+    assert [r["b"] for r in good.take_all()] == [i * 2 for i in range(8)]
+
+    bad = ds.select_columns(["a"]).select_columns(["b"])
+    _opt, fired = optimize(bad._plan)
+    assert not any("project∘project" in f for f in fired), fired
+    with pytest.raises(Exception):
+        bad.take_all()
+
+
+def test_predicate_not_pushed_past_dropped_column(ray_init, tmp_path):
+    """filter(expr=) on a column an earlier select_columns dropped must
+    ERROR like the unoptimized chain — not reach pyarrow filters= (which
+    sees the full file schema and would silently succeed)."""
+    root = _write_parquet(tmp_path)
+    bad = rd.read_parquet(root).select_columns(["a"]).filter(
+        expr=("b", "==", 1.0))
+    _opt, fired = optimize(bad._plan)
+    assert not any("PredicatePushdown" in f for f in fired), fired
+    with pytest.raises(Exception):
+        bad.take_all()
+
+    # same shape on a surviving column still pushes down fine
+    good = rd.read_parquet(root).select_columns(["a"]).filter(
+        expr=("a", ">=", 25))
+    _opt, fired = optimize(good._plan)
+    assert any("PredicatePushdown" in f for f in fired), fired
+    assert sorted(r["a"] for r in good.take_all()) == list(range(25, 30))
+
+
+def test_deep_transform_chain_no_recursion_error(ray_init):
+    """Plans grow one node per transform call; a programmatically built
+    pipeline deeper than the Python recursion limit must still optimize,
+    resolve metadata, render, and execute (regression: every plan walk
+    used to be recursive)."""
+    ds = rd.range(10, parallelism=2)
+    for _ in range(1500):
+        ds = ds.map(lambda r: {"id": r["id"] + 1})
+    assert ds.count() == 10  # metadata path: optimize + resolve_count
+    assert "OperatorFusion" in ds.explain()  # render + compile
+    assert sorted(r["id"] for r in ds.take_all()) == \
+        [i + 1500 for i in range(10)]
+
+
+def test_sql_projection_declines_unquotable_columns(ray_init, tmp_path):
+    """A pushed column list the SQL datasource can't express as plain
+    identifiers must leave Project as a block op, not fail the plan."""
+    import functools
+    import sqlite3
+
+    db = str(tmp_path / "q.db")
+    conn = sqlite3.connect(db)
+    conn.execute('CREATE TABLE t (id INTEGER, "my-col" TEXT)')
+    conn.executemany("INSERT INTO t VALUES (?, ?)",
+                     [(i, f"v{i}") for i in range(6)])
+    conn.commit()
+    conn.close()
+
+    ds = rd.read_sql("SELECT * FROM t", functools.partial(
+        sqlite3.connect, db)).select_columns(["my-col"])
+    _opt, fired = optimize(ds._plan)
+    assert not any("ProjectionPushdown" in f for f in fired), fired
+    rows = ds.take_all()
+    assert sorted(r["my-col"] for r in rows) == [f"v{i}" for i in range(6)]
+
+
+def test_metadata_stats_get_distinct_tags(ray_init):
+    """Two metadata-answered count()s must not clobber one shared ''
+    stats entry."""
+    from ray_tpu.data._executor import _STATS_REGISTRY
+
+    before = set(_STATS_REGISTRY)
+    assert rd.range(100).count() == 100
+    assert rd.range(200).count() == 200
+    new = set(_STATS_REGISTRY) - before
+    assert "" not in new
+    meta_tags = [t for t in new if "metadata[count" in
+                 " ".join(o.name for o in _STATS_REGISTRY[t].ops)]
+    assert len(meta_tags) == 2, new
+
+
+def test_aggregate_reads_only_its_column(ray_init, tmp_path):
+    root = _write_parquet(tmp_path)
+    ds = rd.read_parquet(root)
+    assert ds.sum("a") == sum(range(30))
+    # the aggregate went through the projected path: its input blocks came
+    # from a column-pushed read, cached per column
+    assert "a" in ds._agg_refs
+    block = ray_tpu.get(ds._agg_refs["a"][0], timeout=60)
+    assert set(block.keys()) == {"a"}
+    assert ds.mean("b") == pytest.approx(np.mean([float(x) % 10 for x in range(10)]))
+
+
+# ---------------------------------------------------------------------------
+# predicate pushdown
+# ---------------------------------------------------------------------------
+
+
+def test_predicate_pushdown_into_parquet_filters(ray_init, tmp_path):
+    root = _write_parquet(tmp_path)
+    ds = rd.read_parquet(root).filter(expr=("a", ">=", 25))
+    opt, fired = optimize(ds._plan)
+    assert any("PredicatePushdown" in f for f in fired), fired
+    reads = [n for n in lops.walk(opt) if isinstance(n, lops.Read)]
+    assert reads[0].datasource.filters == [("a", ">=", 25)]
+    # the Filter node is gone: pyarrow applies the predicate in the reader
+    assert not any(isinstance(n, lops.Filter) for n in lops.walk(opt))
+    rows = ds.take_all()
+    assert sorted(r["a"] for r in rows) == list(range(25, 30))
+
+
+def test_filter_expr_without_pushdown_still_filters(ray_init):
+    ds = rd.range(40, parallelism=4).filter(
+        expr=[("id", ">=", 10), ("id", "<", 20)])
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(10, 20))
+    # range has no predicate pushdown: the expr evaluates in the fused
+    # chain, vectorized
+    opt, fired = optimize(ds._plan)
+    assert not any("PredicatePushdown" in f for f in fired)
+
+
+def test_filter_expr_validation():
+    ds = rd.range(10)
+    with pytest.raises(ValueError, match="op"):
+        ds.filter(expr=("id", "~", 3))
+    with pytest.raises(ValueError, match="fn OR expr"):
+        ds.filter(lambda r: True, expr=("id", "==", 1))
+    with pytest.raises(ValueError, match="callable or expr"):
+        ds.filter()
+
+
+# ---------------------------------------------------------------------------
+# metadata shortcuts: zero data blocks read
+# ---------------------------------------------------------------------------
+
+
+def test_parquet_count_and_schema_from_footers(ray_init, tmp_path):
+    root = _write_parquet(tmp_path)
+    ds = rd.read_parquet(root)
+    assert ds.count() == 30
+    # the stats surface proves ZERO map tasks ran: the recorded execution
+    # is a metadata row with no blocks
+    st = ds._last_stats
+    assert st is not None and st.output_blocks == 0
+    assert st.ops and st.ops[0].name.startswith("metadata[count")
+    assert all(op.blocks == 0 for op in st.ops)
+    assert ds._refs is None, "count() materialized despite footer metadata"
+
+    assert ds.schema() == {"a": "int64", "b": "float64", "c": "object"}
+    assert ds._last_stats.ops[0].name.startswith("metadata[schema")
+    assert ds._refs is None
+    assert ds.num_blocks() == 3
+
+
+def test_range_metadata_arithmetic(ray_init):
+    ds = rd.range(12_345, parallelism=13)
+    assert ds.count() == 12_345
+    assert ds._refs is None
+    assert ds.schema() == {"id": "int64"}
+    assert ds._refs is None
+    # limit caps the arithmetic count; row-preserving maps keep it
+    assert ds.map(lambda r: r).limit(77).count() == 77
+    assert ds.limit(99_999).count() == 12_345
+    # repartition: num_blocks is pure arithmetic too
+    assert ds.repartition(5).num_blocks() == 5
+    assert ds.repartition(5).count() == 12_345
+
+
+def test_count_falls_back_when_metadata_unavailable(ray_init):
+    marker_dir = tempfile.mkdtemp()
+    ds = Dataset(_marked_producers(6, 4, marker_dir))
+    # filter destroys count metadata -> must execute
+    assert ds.filter(lambda r: r["x"] % 2 == 0).count() == 12
+    assert len(glob.glob(os.path.join(marker_dir, "b*"))) == 6
+
+
+def test_parquet_filters_disable_footer_count(ray_init, tmp_path):
+    root = _write_parquet(tmp_path)
+    ds = rd.read_parquet(root).filter(expr=("a", "<", 7))
+    # footer row counts pre-date row filtering: this must execute
+    assert ds.count() == 7
+
+
+# ---------------------------------------------------------------------------
+# union: plan-level concatenation (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_union_is_plan_level_no_materialization(ray_init):
+    dir_a, dir_b = tempfile.mkdtemp(), tempfile.mkdtemp()
+    a = Dataset(_marked_producers(30, 5, dir_a)).map(
+        lambda r: {"x": int(r["x"])})
+    b = Dataset(_marked_producers(30, 5, dir_b)).map(
+        lambda r: {"x": int(r["x"]) + 1000})
+    u = a.union(b)
+    # building the union executed NOTHING (the old path materialized)
+    assert glob.glob(os.path.join(dir_a, "b*")) == []
+    assert glob.glob(os.path.join(dir_b, "b*")) == []
+    assert u._refs is None
+    assert u.num_blocks() == 60
+
+    # streaming take(3) pulls a short prefix of a's producers; b (second
+    # in stream order, 30 blocks away) is never touched — rows flow
+    # producer-task -> store -> consumer, no driver round-trip of the rest
+    rows = u.take(3)
+    assert [r["x"] for r in rows] == [0, 1, 2]
+    ran_a = len(glob.glob(os.path.join(dir_a, "b*")))
+    ran_b = len(glob.glob(os.path.join(dir_b, "b*")))
+    assert ran_a < 30, f"union.take(3) executed all of branch a ({ran_a})"
+    assert ran_b == 0, f"union.take(3) touched branch b ({ran_b} blocks)"
+
+
+def test_union_count_and_rows(ray_init):
+    a = rd.range(10, parallelism=2).map(lambda r: {"id": r["id"]})
+    b = rd.range(5, parallelism=1).map(lambda r: {"id": r["id"] + 100})
+    u = a.union(b)
+    # both branches are row-preserving over range: count is arithmetic
+    assert u.count() == 15
+    assert u._refs is None
+    got = sorted(r["id"] for r in u.iter_rows())
+    assert got == sorted(list(range(10)) + [i + 100 for i in range(5)])
+
+
+def test_union_with_limited_branch(ray_init):
+    a = rd.range(20, parallelism=4).limit(3)
+    b = rd.range(4, parallelism=1).map(lambda r: {"id": r["id"] + 50})
+    u = a.union(b)
+    ids = [r["id"] for r in u.take_all()]
+    assert ids == [0, 1, 2, 50, 51, 52, 53]
+    assert u.count() == 7
+
+
+# ---------------------------------------------------------------------------
+# optimizer escape hatch
+# ---------------------------------------------------------------------------
+
+
+def test_optimizer_disabled_still_correct(ray_init, optimizer_off, tmp_path):
+    root = _write_parquet(tmp_path)
+    ds = rd.read_parquet(root).select_columns(["a"]).filter(
+        expr=("a", ">=", 25))
+    rows = ds.take_all()
+    assert sorted(r["a"] for r in rows) == list(range(25, 30))
+    # no rules, no metadata shortcut: count executes and still agrees
+    ds2 = rd.read_parquet(root)
+    assert ds2.count() == 30
+    assert ds2._refs is not None, "optimizer off: count must execute"
+    plan = ds.explain()
+    assert "(optimizer disabled)" in plan
+    # limit SEMANTICS are compilation, not optimization: the fence holds
+    ds3 = rd.range(20, parallelism=2)
+    assert ds3.limit(5).filter(lambda r: r["id"] % 2 == 0).take_all() == [
+        {"id": 0}, {"id": 2}, {"id": 4}]
+
+
+def test_limit_covering_prefix_still_pruned(ray_init):
+    """Acceptance: limit(k) over B blocks executes only the covering
+    prefix through the PLANNER (the old _materialize_limit_prefix special
+    case is gone)."""
+    marker_dir = tempfile.mkdtemp()
+    ds = Dataset(_marked_producers(100, 5, marker_dir))
+    assert ds.limit(12).count() == 12
+    executed = len(glob.glob(os.path.join(marker_dir, "b*")))
+    assert executed < 100, (
+        f"full plan ran ({executed} blocks) despite limit(12)")
+
+
+def test_fence_and_prefix_through_actor_stage(ray_init):
+    """An actor-pool stage chained after limit() must also only see rows
+    within the budget (compiled as a post-fence segment)."""
+
+    class Echo:
+        def __call__(self, batch):
+            assert len(batch["id"]) <= 4
+            return batch
+
+    ds = rd.range(100, parallelism=10).limit(4).map_batches(
+        Echo, concurrency=1)
+    rows = ds.take_all()
+    assert [r["id"] for r in rows] == [0, 1, 2, 3]
