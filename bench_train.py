@@ -1,0 +1,275 @@
+"""Train-plane A/B bench: elastic live resize vs checkpoint-restore under a
+seeded chaos preemption fault.
+
+One scenario, two recovery strategies. A 4-worker gang trains on two spot
+nodes; the seeded `testing_preempt_notice` fault preempts one node
+mid-run (drain with a deadline), and a "replacement" node arrives a fixed
+provisioning latency after the preempted node dies — the same capacity
+timeline the autoscaler would produce.
+
+  --elastic on   : ElasticScalingPolicy + ElasticClient.sync in the train
+                   fn — planned removal live-SHRINKS the gang (no
+                   teardown), the replacement triggers a live REGROW.
+  --elastic off  : FixedScalingPolicy — the PR-3 checkpoint-then-rejoin
+                   path: workers die at the drain deadline, the group
+                   re-creates once capacity returns, training resumes
+                   from the last finalized checkpoint (re-doing the steps
+                   since it).
+
+Metrics per mode:
+  steps_per_s            — unique epoch progress / wall clock (re-done
+                           post-restore steps do not count as progress)
+  downtime_per_preempt_s — largest gap in the merged report-timestamp
+                           series (the window nobody trained)
+  wasted_steps           — reports that re-did already-covered work
+
+Run: python bench_train.py --elastic both --out BENCH_TRAIN_r11.json
+"""
+
+import argparse
+import json
+import os
+import platform
+import threading
+import time
+
+
+def _elastic_fn_factory():
+    def train_fn(config):
+        import time as _t
+
+        import numpy as np
+
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        elastic = ctx.elastic
+        model, shards, it = elastic.init_or_join(
+            init_model=lambda: {"w": np.full(1024, 10.0)},
+            init_shards=lambda keys: {
+                k: np.full(config["shard_elems"], float(k)) for k in keys},
+            shard_keys=list(range(config["num_shards"])),
+            iterator=dict(num_samples=config["num_samples"],
+                          batch_size=config["batch_size"], seed=11),
+        )
+        while True:
+            batch = it.next_batch()
+            if batch is None:
+                break
+            model["w"] = model["w"] - 0.2 * (model["w"] - 1.0)
+            # global_batch is monotone across resizes (per-rank `batches`
+            # restarts at a re-plan) — checkpoint step ids must not repeat
+            rep = {"t": _t.time(), "step": it.global_batch,
+                   "world": ctx.get_world_size(), "samples": list(batch)}
+            if it.batches % config["ckpt_every"] == 0:
+                train.report(rep, checkpoint_state={"model": model,
+                                                    "step": it.global_batch})
+            else:
+                train.report(rep)
+            _t.sleep(config["step_s"])
+            out = elastic.sync(model=model, shards=shards, iterator=it)
+            if out.retired:
+                return
+            if out.resized:
+                model, shards, it = out.model, out.shards, out.iterator
+
+    return train_fn
+
+
+def _restore_fn_factory():
+    def train_fn(config):
+        import time as _t
+
+        import numpy as np
+
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        model = {"w": np.full(1024, 10.0)}
+        start = 0
+        ckpt = ctx.get_checkpoint()
+        if ckpt is not None:
+            state = ckpt.load_state({"model": model, "step": 0},
+                                    rank=ctx.get_world_rank())
+            model, start = state["model"], int(state["step"]) + 1
+        shards = {k: np.full(config["shard_elems"], float(k))
+                  for k in range(config["num_shards"])
+                  if k % ctx.get_world_size() == ctx.get_world_rank()}
+        del shards  # parity with the elastic fn's per-rank state footprint
+        for step in range(start, config["steps_per_rank"]):
+            model["w"] = model["w"] - 0.2 * (model["w"] - 1.0)
+            rep = {"t": _t.time(), "step": step,
+                   "world": ctx.get_world_size(), "rank": ctx.get_world_rank()}
+            if step % config["ckpt_every"] == 0:
+                train.report(rep, checkpoint_state={"model": model,
+                                                    "step": step})
+            else:
+                train.report(rep)
+            _t.sleep(config["step_s"])
+
+    return train_fn
+
+
+def run_mode(elastic: bool, tmp: str) -> dict:
+    import ray_tpu
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import (DataParallelTrainer, FailureConfig, RunConfig,
+                               ScalingConfig)
+
+    # seeded preemption fault: the SECOND spot daemon (role daemon3: head
+    # is daemon1) gets a synthetic notice 6s after it starts and drains
+    # with an 8s deadline — landing mid-training, deterministically
+    GLOBAL_CONFIG.apply_system_config({
+        "testing_chaos_seed": 11,
+        "testing_preempt_notice": "daemon3:6000:8000",
+        "train_node_watch_period_s": 0.25,
+        "train_regrow_cooldown_s": 0.5,
+        "train_resize_park_timeout_s": 30.0,
+        "health_check_period_s": 0.25,
+        "health_check_timeout_s": 2.0,
+    })
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 4})
+    world, steps_per_rank, batch = 4, 150, 2
+    try:
+        cluster.add_node(resources={"CPU": 4, "spot": 2})
+        victim = cluster.add_node(resources={"CPU": 4, "spot": 2})
+        ray_tpu.init(address=cluster.address)
+
+        config = {
+            "num_shards": 8, "shard_elems": 64 * 1024, "step_s": 0.1,
+            "ckpt_every": 5, "steps_per_rank": steps_per_rank,
+            "num_samples": world * steps_per_rank * batch,
+            "batch_size": batch,
+        }
+        scaling = (ScalingConfig(num_workers=world, elastic_min_workers=2,
+                                 resources_per_worker={"spot": 1})
+                   if elastic else
+                   ScalingConfig(num_workers=world,
+                                 resources_per_worker={"spot": 1}))
+        trainer = DataParallelTrainer(
+            _elastic_fn_factory() if elastic else _restore_fn_factory(),
+            train_loop_config=config,
+            scaling_config=scaling,
+            run_config=RunConfig(
+                name=f"bench-{'elastic' if elastic else 'restore'}",
+                storage_path=tmp,
+                failure_config=FailureConfig(max_failures=2)),
+        )
+        controller = trainer._controller()
+
+        # "autoscaler": replace the preempted node 2s after it dies — the
+        # same capacity timeline for both modes
+        events = {}
+        stop = threading.Event()
+
+        def autoscale():
+            while not stop.is_set():
+                try:
+                    nodes = ray_tpu.nodes()
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.2)
+                    continue
+                rec = next((n for n in nodes
+                            if n["node_id"] == victim.node_id), None)
+                if rec is not None:
+                    if rec["state"] == "DRAINING" and "drain_t" not in events:
+                        events["drain_t"] = time.time()
+                    if rec["state"] == "DEAD":
+                        events.setdefault("death_t", time.time())
+                        break
+                time.sleep(0.1)
+            if stop.is_set() or "death_t" not in events:
+                return
+            time.sleep(2.0)  # provisioning latency
+            if not stop.is_set():
+                try:
+                    cluster.add_node(resources={"CPU": 4, "spot": 2})
+                    events["replacement_t"] = time.time()
+                except Exception:  # noqa: BLE001 — run ended; cluster gone
+                    pass
+
+        mon = threading.Thread(target=autoscale)
+        mon.start()
+        t0 = time.time()
+        result = controller.run()
+        wall = time.time() - t0
+        stop.set()
+        mon.join(timeout=30)
+
+        reports = [m for m in result.metrics_history if "t" in m]
+        times = sorted(m["t"] for m in reports)
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        downtime = max(gaps) if gaps else 0.0
+        if elastic:
+            unique = len({s for m in reports for s in m.get("samples", [])})
+        else:
+            # progress = the furthest step each rank reached; re-done
+            # steps after a restore are not progress
+            per_rank = {}
+            for m in reports:
+                key = m.get("rank", 0)
+                per_rank[key] = max(per_rank.get(key, -1), m["step"])
+            unique = sum(v + 1 for v in per_rank.values()) * batch
+        total_reports = len(reports)
+        return {
+            "mode": "live_resize" if elastic else "checkpoint_restore",
+            "error": result.error,
+            "wall_s": round(wall, 2),
+            "steps_per_s": round((unique / batch) / wall, 2),
+            "unique_samples": unique,
+            "total_reports": total_reports,
+            "wasted_steps": max(0, total_reports - unique // batch),
+            "downtime_per_preempt_s": round(downtime, 2),
+            "notice_to_death_s": round(
+                events.get("death_t", 0) - events.get("drain_t", 0), 2)
+            if "drain_t" in events and "death_t" in events else None,
+            "resizes": getattr(controller, "resizes", 0),
+            "shrinks": getattr(controller, "shrinks", 0),
+            "regrows": getattr(controller, "regrows", 0),
+            "drain_rejoins": controller.drain_rejoins,
+            "failure_count": controller.failure_count,
+        }
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+        GLOBAL_CONFIG.reset()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--elastic", choices=["on", "off", "both"], default="both")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    import tempfile
+
+    results = []
+    modes = {"on": [True], "off": [False], "both": [True, False]}[args.elastic]
+    for elastic in modes:
+        with tempfile.TemporaryDirectory() as tmp:
+            r = run_mode(elastic, tmp)
+        print(json.dumps(r))
+        results.append(r)
+
+    doc = {
+        "suite": "bench_train",
+        "scenario": ("4-worker spot gang, seeded preemption (daemon3 at "
+                     "+6s, 8s drain deadline), replacement node 2s after "
+                     "death"),
+        "python": platform.python_version(),
+        "machine": platform.machine(),
+        "results": results,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

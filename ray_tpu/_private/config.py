@@ -193,6 +193,14 @@ _flag("drain_replicate_max_objects", 4096, "Max primary object copies a draining
 _flag("preemption_watcher_enabled", False, "Run the GCE maintenance-event/preemption watcher on each node daemon; a notice triggers an automatic drain with reason=preemption (reference: spot TPU-VM preemption gives 30-90s of warning).")
 _flag("preemption_poll_period_s", 1.0, "Preemption watcher metadata-server poll period.")
 
+# --- elastic training (train/_controller.py, train/_elastic.py) ---
+_flag("train_max_drain_rejoins", 16, "Bound on planned-removal rejoins/resizes per training run: drain-triggered recoveries never charge the failure budget, so a pathological drain loop is bounded separately by this.")
+_flag("train_expected_death_fresh_s", 120.0, "How long an expected-death node record counts as 'fresh': within this window a worker loss on that node is classified as planned (checkpoint-then-rejoin / live shrink, budget untouched) and the node's resources are excluded from elastic sizing. Shared by the controller's planned-failure detection and the regrow trigger's usable-capacity read.")
+_flag("train_live_resize", True, "Elastic runs resize the live gang on planned node removal/return instead of teardown+checkpoint-restore: survivors pause at a step barrier, lost shards re-shard over the object plane, ranks renumber under a new generation. Requires the train fn to drive ElasticClient.sync(); falls back to checkpoint-restore when workers never park.")
+_flag("train_resize_park_timeout_s", 20.0, "How long a live resize waits for every worker to park at its step boundary (and for joiners/survivors to absorb their payload) before aborting back to the checkpoint-restore path. Keep under the drain deadline: the doomed ranks must publish and be released before their node exits.")
+_flag("train_node_watch_period_s", 0.5, "Train controller node-table poll period for resize triggers (drain notices -> shrink, returned capacity -> regrow). The 'nodes' pubsub listener short-circuits the wait; this is the floor under notice loss.")
+_flag("train_regrow_cooldown_s", 2.0, "Minimum spacing between regrow attempts so a flapping node can't thrash the gang through resize churn.")
+
 # --- chaos / fault injection (day 1, per SURVEY §4) ---
 _flag("testing_chaos_seed", 0, "Seed for the per-process chaos PRNG (mixed with the process's chaos role). 0 = fresh entropy. A seeded run replays every injected delay/drop/jitter draw exactly — reproduce any chaos failure from its seed.")
 _flag("testing_event_loop_delay_us", "", "Inject delays into event-loop handlers. Format: 'method:min_us:max_us,...' ('*' matches all). Mirrors RAY_testing_asio_delay_us.")

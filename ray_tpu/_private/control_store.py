@@ -676,6 +676,13 @@ class ControlStore:
                 continue
             if rec.spec.strategy.kind == pb.STRATEGY_PLACEMENT_GROUP:
                 continue
+            if rec.spec.drain_cooperative:
+                # the owner coordinates this actor's planned removal (the
+                # elastic train controller live-shrinks its gang inside
+                # the drain window and releases the doomed ranks itself);
+                # killing it here would destroy the state the owner is
+                # about to move
+                continue
             cause = f"node draining ({reason})"
             if rec.node_id is not None and rec.worker_id:
                 try:

@@ -543,6 +543,11 @@ class CoreWorker:
         from ray_tpu._private.recovery import ObjectRecoveryManager
 
         self.recovery = ObjectRecoveryManager(self)
+        # external "nodes"-channel listeners (e.g. the elastic train
+        # controller's resize triggers): called with every node notice
+        # AFTER the worker's own handling; exceptions are swallowed so a
+        # listener can never wedge recovery
+        self._node_listeners: list = []
         # subscriber-side pubsub gap detection: channel -> last publish seq
         # seen (every control-store notice is stamped with _seq)
         self._channel_seq: Dict[str, Optional[int]] = {
@@ -717,6 +722,7 @@ class CoreWorker:
         notice reroutes future submissions away immediately so no task
         retry is burned against a node that will refuse the lease."""
         self._note_channel_seq("nodes", message)
+        self._fan_out_node_notice(message)
         state = message.get("state")
         daemon_addr = message.get("address", "")
         if state == pb.NODE_DRAINING:
@@ -740,6 +746,26 @@ class CoreWorker:
             # a cached lease on the dead node would push the next task (or a
             # recovery re-execution) into a store no daemon serves
             self._drop_pooled_leases_from(daemon_addr)
+
+    def add_node_listener(self, cb) -> None:
+        """Register a callback for every "nodes" pubsub notice (dict wire
+        form). Used by the elastic train controller: a DRAINING notice is
+        its shrink trigger, a registered-ALIVE notice its regrow trigger —
+        event-driven instead of burning a node-table poll per tick."""
+        self._node_listeners.append(cb)
+
+    def remove_node_listener(self, cb) -> None:
+        try:
+            self._node_listeners.remove(cb)
+        except ValueError:
+            pass
+
+    def _fan_out_node_notice(self, message: dict):
+        for cb in list(self._node_listeners):
+            try:
+                cb(message)
+            except Exception:  # noqa: BLE001 — listeners must never wedge
+                logger.warning("node-notice listener failed", exc_info=True)
 
     def _on_worker_notice(self, message: dict):
         """Control-store "workers" pubsub: a recorded worker/driver death
@@ -3105,6 +3131,7 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
         concurrency_groups: Optional[Dict[str, int]] = None,
         method_meta: Optional[Dict[str, dict]] = None,
+        drain_cooperative: bool = False,
     ) -> ActorID:
         with self._lock:
             self._actor_index += 1
@@ -3116,6 +3143,7 @@ class CoreWorker:
             is_async=is_async, strategy=strategy, name=name,
             namespace=namespace, detached=detached, runtime_env=runtime_env,
             concurrency_groups=concurrency_groups, method_meta=method_meta,
+            drain_cooperative=drain_cooperative,
         )
         return actor_id
 
@@ -3162,6 +3190,7 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
         concurrency_groups: Optional[Dict[str, int]] = None,
         method_meta: Optional[Dict[str, dict]] = None,
+        drain_cooperative: bool = False,
     ) -> None:
         from ray_tpu._private.runtime_env_mgr import prepare_runtime_env
 
@@ -3189,6 +3218,7 @@ class CoreWorker:
             runtime_env={**(runtime_env or {}), "namespace": namespace,
                          "detached": detached},
             name=name,
+            drain_cooperative=drain_cooperative,
         )
         self._actor_state(actor_id.binary()).creation_keepalive = pyrefs
         await self.control.call("register_actor", {"spec": spec.to_wire()})

@@ -54,7 +54,7 @@ class WorkerHandle:
         "worker_id", "proc", "state", "address", "pid", "job_id",
         "client", "lease_id", "actor_id", "ready_event", "idle_since",
         "actor_resources", "actor_pg", "tpu_chips", "reserved", "env_key",
-        "spawn_ts",
+        "spawn_ts", "drain_coop",
     )
 
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen, job_id: bytes):
@@ -76,6 +76,9 @@ class WorkerHandle:
         self.actor_resources: Optional[ResourceSet] = None
         # (pg_id, bundle_index) when the actor consumes a PG bundle
         self.actor_pg: Optional[Tuple[bytes, int]] = None
+        # actor whose owner coordinates planned removal (elastic gangs):
+        # a terminal drain holds the node open while it lives
+        self.drain_coop = False
         # chip ids this worker's TPU_VISIBLE_CHIPS was baked with at spawn
         # (visibility is per-process: it cannot change after libtpu init)
         self.tpu_chips: Optional[Tuple[int, ...]] = None
@@ -1351,6 +1354,7 @@ class NodeDaemon:
             idle.remove(w.worker_id.binary())
         w.state = W_ACTOR
         w.actor_id = spec.actor_id.binary()
+        w.drain_coop = bool(spec.drain_cooperative)
         # Mark PG membership BEFORE the init push: a concurrent
         # rpc_return_bundles must see (and kill) this in-flight actor, or the
         # bundle's resources get credited back while the actor keeps running.
@@ -2125,17 +2129,34 @@ class NodeDaemon:
 
     async def _wait_for_leases(self, deadline: float):
         """Let running work finish: leases stop being granted the moment the
-        drain notice lands, so the busy set only shrinks."""
+        drain notice lands, so the busy set only shrinks.
+
+        ACTOR workers hold the node open too — but only those some
+        protocol will actually remove: the control store migrates non-PG
+        actors immediately, and a `drain_cooperative` actor's owner runs
+        its own removal (the elastic train controller live-shrinks its
+        gang and releases the doomed ranks, killing their workers).
+        Exiting the moment no TASK lease runs would strand those
+        protocols with a dead node mid-handoff; a node hosting only
+        actors would get no warning at all. PG-pinned non-cooperative
+        actors are NOT waited for — nothing removes them before node
+        death, and idling on them would eat the replication window that
+        keeps the drain zero-reconstruction."""
         while time.monotonic() < deadline:
-            busy = [w for w in self.workers.values() if w.state == W_LEASED]
+            busy = [w for w in self.workers.values()
+                    if w.state == W_LEASED
+                    or (w.state == W_ACTOR
+                        and (w.actor_pg is None or w.drain_coop))]
             if not busy and not self.leases:
                 return
             await asyncio.sleep(0.05)
-        n = len([w for w in self.workers.values() if w.state == W_LEASED])
-        if n:
+        leased = [w for w in self.workers.values() if w.state == W_LEASED]
+        actors = [w for w in self.workers.values() if w.state == W_ACTOR]
+        if leased or actors:
             logger.warning(
-                "drain deadline reached with %d lease(s) still running; "
-                "their tasks will retry elsewhere", n)
+                "drain deadline reached with %d lease(s) and %d actor "
+                "worker(s) still running; tasks retry elsewhere, actors "
+                "die with the node", len(leased), len(actors))
 
     async def _replicate_primaries(self, deadline: float) -> dict:
         """Proactively copy store-resident (and spilled) objects to live

@@ -218,6 +218,12 @@ class TaskSpec:
     # processes (reference: util/tracing/tracing_helper.py:181
     # _DictPropagator.inject into TaskSpec)
     trace_ctx: Optional[dict] = None
+    # actors only: the OWNER coordinates this actor's planned-removal
+    # handling (e.g. the elastic train controller live-resizing its gang
+    # inside the drain window) — the control store's drain migration must
+    # neither kill nor migrate it; it rides the node to the deadline
+    # unless its owner releases it first
+    drain_cooperative: bool = False
 
     @property
     def is_streaming(self) -> bool:
@@ -269,6 +275,7 @@ class TaskSpec:
             "stream_backpressure": self.stream_backpressure,
             "cancelled": self.cancelled,
             "trace_ctx": self.trace_ctx,
+            "drain_cooperative": self.drain_cooperative,
         }
 
     @classmethod
@@ -302,6 +309,7 @@ class TaskSpec:
             stream_backpressure=w.get("stream_backpressure", -1),
             cancelled=w.get("cancelled", False),
             trace_ctx=w.get("trace_ctx"),
+            drain_cooperative=w.get("drain_cooperative", False),
         )
 
 

@@ -22,7 +22,7 @@ _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
     "max_concurrency", "name", "namespace", "lifetime", "scheduling_strategy",
     "label_selector", "placement_group", "placement_group_bundle_index",
-    "runtime_env", "concurrency_groups",
+    "runtime_env", "concurrency_groups", "drain_cooperative",
 }
 
 _VALID_METHOD_OPTIONS = {"num_returns", "concurrency_group"}
@@ -232,6 +232,7 @@ class ActorClass:
                 runtime_env=opts.get("runtime_env"),
                 concurrency_groups=groups,
                 method_meta=method_meta,
+                drain_cooperative=opts.get("drain_cooperative", False),
             )
 
         if cw._loop_running_here():
@@ -250,6 +251,7 @@ class ActorClass:
                 runtime_env=opts.get("runtime_env"),
                 concurrency_groups=groups,
                 method_meta=method_meta,
+                drain_cooperative=opts.get("drain_cooperative", False),
             )
         else:
             actor_id = cw.run_sync(create())

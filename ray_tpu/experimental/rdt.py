@@ -36,9 +36,18 @@ class DeviceObjectManager:
         self._weak: Dict[bytes, weakref.ref] = {}
         self._strong: "OrderedDict[bytes, Any]" = OrderedDict()
         self._strong_cap = strong_cap
+        # transport outcome counters: `device_hits` = same-process consumer
+        # got the original HBM-resident array back untouched; `host_rebuilds`
+        # = the consumer re-uploaded from the host staging bytes (cross-
+        # process, or the producer donated/dropped the buffer). The elastic
+        # train resize asserts on these: a shard that keeps its holder must
+        # be a device hit, never an upload.
+        self.stats: Dict[str, int] = {"registered": 0, "device_hits": 0,
+                                      "host_rebuilds": 0}
 
     def register(self, arr: Any) -> bytes:
         tid = os.urandom(16)
+        self.stats["registered"] += 1
         try:
             self._weak[tid] = weakref.ref(
                 arr, lambda _r, t=tid: self._weak.pop(t, None)
@@ -75,7 +84,8 @@ def device_object_manager() -> DeviceObjectManager:
 def _rebuild_device_array(tid: bytes, host: Any) -> Any:
     """Unpickle hook: same-process → the original HBM-resident array;
     elsewhere → upload the host staging copy."""
-    arr = device_object_manager().lookup(tid)
+    mgr = device_object_manager()
+    arr = mgr.lookup(tid)
     if arr is not None:
         # A producer that donated its array to a jitted step after put()
         # (donate_argnums — the standard training loop) leaves a deleted
@@ -83,9 +93,11 @@ def _rebuild_device_array(tid: bytes, host: Any) -> Any:
         # host staging bytes can serve (advisor r2).
         deleted = getattr(arr, "is_deleted", None)
         if deleted is None or not deleted():
+            mgr.stats["device_hits"] += 1
             return arr
     import jax
 
+    mgr.stats["host_rebuilds"] += 1
     return jax.device_put(host)
 
 

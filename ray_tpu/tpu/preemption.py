@@ -73,6 +73,13 @@ class FakeMetadataTransport(MetadataTransport):
     def preempt(self):
         self.preempted = "TRUE"
 
+    def clear(self):
+        """The scheduled event passed / the reclaim was cancelled (spot
+        capacity returned). Pairs with PreemptionWatcher.rearm() in elastic
+        soak tests that preempt the same simulated host repeatedly."""
+        self.maintenance_event = "NONE"
+        self.preempted = "FALSE"
+
     def get(self, url: str) -> str:
         self.calls += 1
         if url == MAINTENANCE_URL:
@@ -108,6 +115,19 @@ class PreemptionWatcher:
 
     def stop(self):
         self._stopped = True
+
+    def rearm(self):
+        """Reset the one-shot latch so a NEW `run()` can fire again.
+
+        A GCE maintenance event can be cancelled (or a drain undrained by
+        the autoscaler when capacity demand returns) — a watcher that
+        stays latched after a survived notice would sleep through the
+        NEXT reclaim of the same host. `run()` returns once it fires, so
+        the owner must re-arm AND schedule `run()` again (spawn a fresh
+        task); rearm alone does not resurrect the finished poll loop. The
+        elastic train plane preempts the same node repeatedly across
+        shrink/regrow cycles; re-arm after the drain resolves."""
+        self.fired = False
 
     async def _fire(self, cause: str):
         if self.fired:

@@ -12,10 +12,16 @@ from ray_tpu.train._checkpoint import (
 )
 from ray_tpu.train._context import TrainContext, get_context, report
 from ray_tpu.train._controller import TrainController, TrainResult
+from ray_tpu.train._elastic import (
+    ElasticClient,
+    ElasticDataIterator,
+    ResizeOutcome,
+)
 from ray_tpu.train._policies import (
     ElasticScalingPolicy,
     FailurePolicy,
     FixedScalingPolicy,
+    usable_cluster_resources,
 )
 from ray_tpu.train._worker_group import SyncActor, TrainWorker, WorkerGroup
 from ray_tpu.train.trainer import (
@@ -35,7 +41,10 @@ __all__ = [
     "CheckpointConfig",
     "CheckpointManager",
     "DataParallelTrainer",
+    "ElasticClient",
+    "ElasticDataIterator",
     "ElasticScalingPolicy",
+    "ResizeOutcome",
     "FailureConfig",
     "FailurePolicy",
     "FixedScalingPolicy",
@@ -51,6 +60,7 @@ __all__ = [
     "WorkerGroup",
     "get_context",
     "report",
+    "usable_cluster_resources",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -339,6 +339,14 @@ class AsyncCheckpointWriter:
             fut.result()
 
 
+def staging_dir_name(step: int, generation: int = 0) -> str:
+    """THE staging-dir name format — writers (TrainWorker's staging_fn)
+    and the finalizer/purger (CheckpointManager) must agree on it, or
+    shards land in dirs finalize() never looks at and checkpoints silently
+    stop finalizing."""
+    return f".staging_checkpoint_g{generation:04d}_{step:09d}"
+
+
 class CheckpointManager:
     """Tracks finalized checkpoints; retains latest + top-K by metric.
 
@@ -361,8 +369,13 @@ class CheckpointManager:
 
     # -- paths ----------------------------------------------------------
 
-    def staging_dir(self, step: int) -> str:
-        return self.storage.join(self.run_dir, f".staging_checkpoint_{step:09d}")
+    def staging_dir(self, step: int, generation: int = 0) -> str:
+        """Staging dirs are scoped by gang GENERATION: a live resize
+        purges only generations older than the committed one, so a
+        joiner/survivor checkpoint write in flight at the commit can
+        never race the purge of the previous layout's partial shards."""
+        return self.storage.join(self.run_dir,
+                                 staging_dir_name(step, generation))
 
     def final_dir(self, step: int) -> str:
         return self.storage.join(self.run_dir, f"checkpoint_{step:09d}")
@@ -395,16 +408,33 @@ class CheckpointManager:
     # -- lifecycle ------------------------------------------------------
 
     def finalize(self, step: int, metrics: Dict[str, Any],
-                 expected_ranks: int) -> Optional[Checkpoint]:
-        """Promote a staging dir once all ranks have written their shard."""
-        staging = self.staging_dir(step)
+                 expected_ranks: int,
+                 generation: int = 0) -> Optional[Checkpoint]:
+        """Promote a staging dir once all ranks have written their shard.
+
+        Idempotent per step: a step id can be REPORTED twice (a rank's
+        local counter repeating across an elastic resize, or a restarted
+        incarnation re-reporting its resume step) — the first promotion
+        wins and the duplicate staging dir is dropped instead of crashing
+        the controller on a rename-over-existing-dir."""
+        staging = self.staging_dir(step, generation)
+        final = self.final_dir(step)
+        existing = next((c for c in self.checkpoints if c.path == final),
+                        None)
         if not self.storage.isdir(staging):
+            if self.storage.isdir(final):
+                return existing or Checkpoint(final, dict(metrics))
             return None
         present = [f for f in self.storage.listdir(staging)
                    if f.startswith("rank_")]
         if len(present) < expected_ranks:
             return None
-        final = self.final_dir(step)
+        if self.storage.isdir(final):
+            # duplicate step: first promotion wins. Leave the staging dir
+            # in place — ranks checkpoint with skew, and deleting it here
+            # would race a slower rank's in-flight shard write (the purge
+            # paths reap it once no writer can still target it).
+            return existing or Checkpoint(final, dict(metrics))
         metrics = dict(metrics)
         metrics.setdefault("step", step)
         self.storage.rename(staging, final)
@@ -433,6 +463,40 @@ class CheckpointManager:
             if c.path not in keep:
                 self.checkpoints.remove(c)
                 self.storage.delete(c.path)
+
+    def step_orphaned(self, step: int, generation: int = 0) -> bool:
+        """Neither a staging dir nor a final dir exists for the step.
+        Rank shard writes complete BEFORE the announcing report is queued
+        (report() blocks on the writer future), so an orphaned step can
+        only mean its staging dir was purged (resize commit / restart) —
+        the pending entry will never finalize and should be dropped."""
+        return (not self.storage.isdir(self.staging_dir(step, generation))
+                and not self.storage.isdir(self.final_dir(step)))
+
+    def purge_staging(self, below_generation: Optional[int] = None):
+        """Drop partial staging dirs whose rank layout can no longer
+        complete. With `below_generation`, only generations OLDER than it
+        are purged — a live resize commit must never delete a dir the
+        renumbered gang's writers are actively filling. Without it (a
+        worker-group restart, where every writer is already dead), all
+        staging dirs drop."""
+        try:
+            for name in self.storage.listdir(self.run_dir):
+                if not name.startswith(".staging_checkpoint_"):
+                    continue
+                if below_generation is not None:
+                    gen = 0
+                    tail = name[len(".staging_checkpoint_"):]
+                    if tail.startswith("g"):
+                        try:
+                            gen = int(tail[1:].split("_", 1)[0])
+                        except ValueError:
+                            pass
+                    if gen >= below_generation:
+                        continue
+                self.storage.delete(self.storage.join(self.run_dir, name))
+        except OSError:
+            pass
 
     @property
     def latest(self) -> Optional[Checkpoint]:

@@ -33,6 +33,11 @@ class TrainContext:
     stop_event: threading.Event = field(default_factory=threading.Event)
     _writer: AsyncCheckpointWriter = field(default_factory=AsyncCheckpointWriter)
     _sync_client: Any = None  # SyncActor handle, set by the worker
+    # live-resize state: the gang generation this worker currently belongs
+    # to (rank/world_size above are REWRITTEN by a committed resize) and
+    # the worker-side protocol client (None for non-elastic runs)
+    generation: int = 0
+    elastic: Any = None
 
     # -- public API (mirrors ray.train.*) -------------------------------
 
@@ -41,6 +46,10 @@ class TrainContext:
 
     def get_world_rank(self) -> int:
         return self.rank
+
+    def get_generation(self) -> int:
+        """Gang generation: bumped by every committed live resize."""
+        return self.generation
 
     def get_local_rank(self) -> int:
         return self.local_rank
@@ -64,40 +73,52 @@ class TrainContext:
         directory for the reported step. The controller finalizes the
         checkpoint once every rank's shard has landed.
         """
-        entry: Dict[str, Any] = {"metrics": dict(metrics), "rank": self.rank}
+        entry: Dict[str, Any] = {"metrics": dict(metrics), "rank": self.rank,
+                                 "generation": self.generation}
         if checkpoint_state is not None:
             step = int(metrics.get("step", 0))
             staging = self.staging_dir_fn(step)
             fut = self._writer.save(
                 checkpoint_state, staging, rank=self.rank,
                 manifest={"metrics": dict(metrics), "rank": self.rank,
-                          "world_size": self.world_size},
+                          "world_size": self.world_size,
+                          "generation": self.generation},
             )
             fut.result()  # surface write errors at the report site
             entry["checkpoint_step"] = step
         self.report_queue.put(entry)
 
     def barrier(self, name: str = "default", timeout: float = 300.0) -> None:
-        """Block until every worker in the group reaches this barrier."""
+        """Block until every worker in the group reaches this barrier.
+
+        Barriers are scoped by the gang generation: a straggler from
+        generation N can never satisfy (or poison) generation N+1's
+        barriers — its call fails fast with a stale-generation error."""
         if self._sync_client is None:
             return
         import ray_tpu
 
         ray_tpu.get(
-            self._sync_client.barrier.remote(name, self.world_size),
+            self._sync_client.barrier.remote(
+                name, self.world_size, self.generation),
             timeout=timeout,
         )
 
     def broadcast_from_rank_zero(self, name: str, value: Any = None,
                                  timeout: float = 300.0) -> Any:
-        """Rank 0 contributes `value`; every rank returns it."""
+        """Rank 0 contributes `value`; every rank returns it. Rendezvous
+        keys are generation-scoped like barriers."""
         if self._sync_client is None:
             return value
         import ray_tpu
 
         if self.rank == 0:
-            ray_tpu.get(self._sync_client.put.remote(name, value), timeout=timeout)
-        return ray_tpu.get(self._sync_client.wait_for.remote(name), timeout=timeout)
+            ray_tpu.get(
+                self._sync_client.put.remote(name, value, self.generation),
+                timeout=timeout)
+        return ray_tpu.get(
+            self._sync_client.wait_for.remote(name, 0.01, self.generation),
+            timeout=timeout)
 
 
 def get_context() -> TrainContext:

@@ -10,19 +10,36 @@ Redesigned driver-side (a plain object run by Trainer.fit) rather than as a
 detached actor: the TPU framework's north-star path is a single driver owning
 a slice gang, and driver-failure isolation can be layered on by running fit()
 itself inside an actor.
+
+Elastic extension: for ElasticScalingPolicy runs the controller adds a
+RESIZING state between RUNNING and the teardown path. A planned removal
+(drain/preemption notice, observed on the node table and the "nodes"
+pubsub) with enough survivors triggers a LIVE SHRINK — the gang pauses at
+a step boundary, the doomed ranks' state shards re-shard across survivors
+through the object plane, ranks renumber under a new generation, and
+training resumes without ever tearing down. When the autoscaler restores
+capacity, the symmetric REGROW spawns joiners that absorb shed shards.
+Teardown + checkpoint-restore remains the fallback for everything
+unplanned (and for train fns that never reach an elastic sync point).
 """
 
 from __future__ import annotations
 
 import logging
-import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu.train._checkpoint import Checkpoint, CheckpointManager
-from ray_tpu.train._policies import FailurePolicy, ScalingPolicy
+from ray_tpu.train._policies import (
+    ElasticScalingPolicy,
+    FailurePolicy,
+    ScalingPolicy,
+    usable_cluster_resources,
+)
 from ray_tpu.train._worker_group import WorkerGroup, WorkerStatus
 
 logger = logging.getLogger(__name__)
@@ -71,11 +88,26 @@ class TrainController:
         # node is the dominant production "failure" and must be a non-event.
         # Bounded separately so a drain loop can't retry forever.
         self.drain_rejoins = 0
-        self.max_drain_rejoins = 16
+        self.max_drain_rejoins = int(
+            GLOBAL_CONFIG.get("train_max_drain_rejoins"))
+        # live resizes (elastic): shrink/regrow without teardown; bounded by
+        # the same knob as drain rejoins (both are planned-removal budget)
+        self.resizes = 0
+        self.shrinks = 0
+        self.regrows = 0
+        self.state = "CREATED"
         self._group: Optional[WorkerGroup] = None
-        # checkpoint steps reported but not yet finalized (async rank shards
-        # may land after the report that announced them)
-        self._pending_ckpt: Dict[int, Dict[str, Any]] = {}
+        # checkpoint steps reported but not yet finalized, keyed by
+        # (gang generation, step) — staging dirs are generation-scoped so
+        # a resize can purge the old layout without racing live writers
+        self._pending_ckpt: Dict[tuple, Dict[str, Any]] = {}
+        # resize trigger plumbing: the "nodes" pubsub listener flips the
+        # dirty flag so a drain notice is acted on within one poll tick;
+        # the periodic node-table read is the floor under notice loss
+        self._nodes_dirty = threading.Event()
+        self._next_node_check = 0.0
+        self._no_resize_until = 0.0
+        self._next_regrow = 0.0
 
     @staticmethod
     def _is_planned_removal(cause: Optional[str]) -> bool:
@@ -102,6 +134,7 @@ class TrainController:
         with no node scoping available (group creation) use this to keep a
         routine idle-drain from masking a genuinely bad config."""
         wanted = {n for n in (node_ids or []) if n}
+        fresh_s = float(GLOBAL_CONFIG.get("train_expected_death_fresh_s"))
         try:
             for n in ray_tpu.nodes():
                 if wanted and n.get("node_id") not in wanted:
@@ -111,7 +144,7 @@ class TrainController:
                     return True
                 death = n.get("death")
                 if (death and death.get("expected")
-                        and time.time() - death.get("ts", 0.0) < 120.0):
+                        and time.time() - death.get("ts", 0.0) < fresh_s):
                     return True
         except Exception:  # noqa: BLE001 — control store unreachable
             return False
@@ -119,15 +152,36 @@ class TrainController:
 
     # -- helpers --------------------------------------------------------
 
-    def _cluster_cpus(self) -> float:
+    def _elastic_live(self) -> bool:
+        # slice gangs are excluded: a TPU slice placement group fate-shares
+        # every bundle with every bundle's host, so an in-place resize
+        # would be undone the moment the drained host's bundle releases
+        # (and a joiner would land off-slice with no MEGASCALE peering) —
+        # slice-topology reshape goes through checkpoint-restore until
+        # jax.distributed re-init is wired (ROADMAP item 4 follow-up)
+        return (isinstance(self.scaling_policy, ElasticScalingPolicy)
+                and bool(GLOBAL_CONFIG.get("train_live_resize"))
+                and not self.use_tpu_slices)
+
+    def _nodes(self) -> List[dict]:
         try:
-            return float(ray_tpu.cluster_resources().get("CPU", 1.0))
-        except Exception:  # noqa: BLE001
-            return 1.0
+            return ray_tpu.nodes()
+        except Exception:  # noqa: BLE001 — control store unreachable
+            return []
+
+    def _usable_resources(self) -> Dict[str, float]:
+        """Capacity the group can actually target: DRAINING nodes and
+        fresh expected-death records are excluded, so a post-drain
+        (re)create never sizes for a width the shrunken cluster can't
+        hold (and immediately resizes again)."""
+        res = usable_cluster_resources(
+            self._nodes(),
+            float(GLOBAL_CONFIG.get("train_expected_death_fresh_s")))
+        return res or {"CPU": 1.0}
 
     def _make_group(self) -> WorkerGroup:
         decision = self.scaling_policy.target_size(
-            self._cluster_cpus(), self.resources_per_worker
+            self._usable_resources(), self.resources_per_worker
         )
         logger.info("worker group size %d (%s)", decision.num_workers,
                     decision.reason)
@@ -140,6 +194,7 @@ class TrainController:
             use_tpu_slices=self.use_tpu_slices,
             topology=self.topology,
             accelerator_type=self.accelerator_type,
+            elastic=self._elastic_live(),
         )
         try:
             group.create(latest_checkpoint=self.ckpt.latest)
@@ -164,21 +219,155 @@ class TrainController:
                 if rep["metrics"]:
                     result.metrics = rep["metrics"]
                 if "checkpoint_step" in rep:
-                    self._pending_ckpt[rep["checkpoint_step"]] = rep["metrics"]
-        for step in sorted(self._pending_ckpt):
+                    key = (int(rep.get("generation", 0)),
+                           rep["checkpoint_step"])
+                    self._pending_ckpt[key] = rep["metrics"]
+        for gen, step in sorted(self._pending_ckpt):
             ckpt = self.ckpt.finalize(
-                step, self._pending_ckpt[step], expected_ranks=world_size
+                step, self._pending_ckpt[(gen, step)],
+                expected_ranks=world_size, generation=gen,
             )
             if ckpt is not None:
-                del self._pending_ckpt[step]
+                del self._pending_ckpt[(gen, step)]
                 result.checkpoint = ckpt
                 logger.info("checkpoint finalized: %s", ckpt.path)
+            elif self.ckpt.step_orphaned(step, gen):
+                # reports queued before a resize commit can land AFTER the
+                # purge (stashed doomed-rank reports, survivors' buffered
+                # polls) and resurrect a step whose staging dir is gone —
+                # shard writes complete before the report is queued, so
+                # "neither staging nor final exists" can only mean purged
+                del self._pending_ckpt[(gen, step)]
+
+    # -- live resize triggers -------------------------------------------
+
+    def _resize_trigger(self, group: WorkerGroup):
+        """Decide whether the gang should resize NOW. Returns
+        ("shrink", keep_indices, 0), ("grow", keep_indices, add) or None.
+
+        Shrink: a worker sits on a node that is DRAINING with a deadline
+        (preemption/autoscaler/manual removal) and enough workers survive
+        to stay >= min_workers. The check runs BEFORE any worker dies —
+        the whole point is to use the drain window to move shards while
+        their holders are still alive.
+
+        Grow: usable capacity (DRAINING and freshly-dead-expected nodes
+        excluded) fits more workers than the gang currently has, bounded
+        by the policy and rate-limited by the regrow cooldown."""
+        if not self._elastic_live() or not group.elastic:
+            return None
+        now = time.monotonic()
+        if now < self._no_resize_until:
+            return None
+        if not self._nodes_dirty.is_set() and now < self._next_node_check:
+            return None
+        self._nodes_dirty.clear()
+        self._next_node_check = now + float(
+            GLOBAL_CONFIG.get("train_node_watch_period_s"))
+        nodes = self._nodes()
+        if not nodes:
+            return None
+        by_id = {n["node_id"]: n for n in nodes}
+        doomed = []
+        for i, nid in enumerate(group.worker_nodes):
+            rec = by_id.get(nid) if nid else None
+            if (rec is not None and rec.get("state") == "DRAINING"
+                    and rec.get("drain_deadline")):
+                doomed.append(i)
+        if doomed:
+            keep = [i for i in range(len(group.workers)) if i not in doomed]
+            if keep and len(keep) >= self.scaling_policy.min_workers:
+                return ("shrink", keep, 0)
+            return None  # below the floor: teardown path will handle it
+        if now < self._next_regrow:
+            return None
+        fresh_s = float(GLOBAL_CONFIG.get("train_expected_death_fresh_s"))
+        decision = self.scaling_policy.target_size(
+            usable_cluster_resources(nodes, fresh_s),
+            self.resources_per_worker)
+        add = decision.num_workers - group.num_workers
+        if add > 0:
+            self._next_regrow = now + float(
+                GLOBAL_CONFIG.get("train_regrow_cooldown_s"))
+            return ("grow", list(range(len(group.workers))), add)
+        return None
+
+    def _try_live_resize(self, group: WorkerGroup, trigger) -> str:
+        kind, keep, add = trigger
+        if self.drain_rejoins + self.resizes >= self.max_drain_rejoins:
+            logger.warning(
+                "live %s skipped: planned-removal budget exhausted "
+                "(%d rejoins + %d resizes)", kind, self.drain_rejoins,
+                self.resizes)
+            # same cooldown as a failed attempt: a still-DRAINING node
+            # would otherwise re-trigger (and re-log) this every watch tick
+            self._no_resize_until = time.monotonic() + float(
+                GLOBAL_CONFIG.get("train_resize_park_timeout_s"))
+            return "aborted"
+        self.state = "RESIZING"
+        try:
+            verdict = group.live_resize(
+                keep, add,
+                park_timeout_s=float(
+                    GLOBAL_CONFIG.get("train_resize_park_timeout_s")))
+        finally:
+            self.state = "RUNNING"
+        if verdict == "ok":
+            self.resizes += 1
+            if kind == "shrink":
+                self.shrinks += 1
+            else:
+                self.regrows += 1
+            # in-flight staging shards were written under the OLD rank
+            # layout; the resized gang re-checkpoints from its live state.
+            # Generation-targeted: writers of the committed generation may
+            # already be filling THEIR staging dirs (joiners train during
+            # the survivor-commit window) and must not be raced.
+            self._pending_ckpt.clear()
+            self.ckpt.purge_staging(below_generation=group.generation)
+            logger.info(
+                "live %s committed: world=%d generation=%d", kind,
+                group.num_workers, group.generation)
+        else:
+            # don't hammer prepare/park against a gang that can't resize
+            # (non-elastic train fn, plan infeasible): one attempt per
+            # park window
+            self._no_resize_until = time.monotonic() + float(
+                GLOBAL_CONFIG.get("train_resize_park_timeout_s"))
+        return verdict
 
     # -- run loop -------------------------------------------------------
 
     def run(self) -> TrainResult:
         result = TrainResult()
+        listener = None
+        cw = None
+        if self._elastic_live():
+            try:
+                from ray_tpu._private.core_worker import get_core_worker
+
+                cw = get_core_worker()
+
+                def _notice(message, flag=self._nodes_dirty):
+                    flag.set()
+
+                cw.add_node_listener(_notice)
+                listener = _notice
+            except Exception:  # noqa: BLE001 — polling floor still works
+                cw = None
+        try:
+            return self._run(result)
+        finally:
+            self.state = "DONE"
+            if cw is not None and listener is not None:
+                try:
+                    cw.remove_node_listener(listener)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _run(self, result: TrainResult) -> TrainResult:
         while True:
+            self.state = "SCHEDULING"
             try:
                 self._group = self._make_group()
             except Exception as e:  # noqa: BLE001 — group creation failed
@@ -199,13 +388,13 @@ class TrainController:
                 continue
 
             group = self._group
-            world = group.num_workers
             failed = False
             planned = False
+            self.state = "RUNNING"
             try:
                 while True:
                     statuses = group.poll()
-                    self._ingest_reports(statuses, result, world)
+                    self._ingest_reports(statuses, result, group.num_workers)
                     dead = [s for s in statuses if not s.alive]
                     errored = [s for s in statuses if s.error and s.alive]
                     if dead or errored:
@@ -230,8 +419,25 @@ class TrainController:
                     if all(s.done for s in statuses):
                         # final drain: async checkpoint writes + last reports
                         group.flush_checkpoints()
-                        self._ingest_reports(group.poll(), result, world)
+                        self._ingest_reports(group.poll(), result,
+                                             group.num_workers)
                         break
+                    trigger = self._resize_trigger(group)
+                    if trigger is not None:
+                        verdict = self._try_live_resize(group, trigger)
+                        if verdict == "ok":
+                            continue  # resized in place; keep polling
+                        if verdict == "failed":
+                            # post-commit loss: the gang shape is undefined
+                            # — planned teardown, resume from checkpoint
+                            failed = True
+                            planned = True
+                            result.error = (
+                                "live resize failed after commit; "
+                                "falling back to checkpoint-restore")
+                            break
+                        # aborted: continue at the old width; if the drain
+                        # kills workers anyway the normal path handles it
                     time.sleep(self.poll_interval_s)
             finally:
                 group.shutdown()
@@ -246,7 +452,7 @@ class TrainController:
             # drop partial staging shards from the failed incarnation: a
             # differently-sized restart would otherwise mix incarnations
             self._pending_ckpt.clear()
-            self._purge_staging()
+            self.ckpt.purge_staging()
             if planned:
                 # drain-triggered rejoin: resume from the drain-window
                 # checkpoint without spending the failure budget (bounded
@@ -272,14 +478,3 @@ class TrainController:
                 self.failure_count,
                 self.ckpt.latest.path if self.ckpt.latest else "scratch",
             )
-
-    def _purge_staging(self):
-        import shutil
-
-        try:
-            for name in os.listdir(self.ckpt.run_dir):
-                if name.startswith(".staging_checkpoint_"):
-                    shutil.rmtree(os.path.join(self.ckpt.run_dir, name),
-                                  ignore_errors=True)
-        except OSError:
-            pass

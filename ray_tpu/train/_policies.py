@@ -8,8 +8,9 @@ failure is retryable.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Dict, List, Optional, Union
 
 
 @dataclass
@@ -18,9 +19,38 @@ class ScalingDecision:
     reason: str = ""
 
 
+def usable_cluster_resources(
+    nodes: List[dict],
+    death_fresh_window_s: float = 120.0,
+    now: Optional[float] = None,
+) -> Dict[str, float]:
+    """Capacity a worker group can actually be (re)placed on.
+
+    A raw `cluster_resources()` sum over-counts during a planned removal:
+    DRAINING nodes still appear in the node table (and a node that just
+    received a drain notice may briefly still read ALIVE), so a post-drain
+    re-create would target a width the shrunken cluster can't hold and
+    immediately resize again. Subtract every node that is DRAINING, is
+    carrying a drain reason, or has a fresh expected-death record before
+    computing the fit."""
+    now = time.time() if now is None else now
+    total: Dict[str, float] = {}
+    for n in nodes:
+        if n.get("state") != "ALIVE":
+            continue  # DEAD and DRAINING nodes host nothing new
+        if n.get("drain_reason"):
+            continue  # notice landed, state transition racing
+        death = n.get("death")
+        if (death and death.get("expected")
+                and now - death.get("ts", 0.0) < death_fresh_window_s):
+            continue  # going away: a record beat the state field
+        for k, v in (n.get("resources") or {}).items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
 class ScalingPolicy:
-    def target_size(self, cluster_cpus: float,
-                    resources_per_worker: dict) -> ScalingDecision:
+    def target_size(self, cluster_cpus, resources_per_worker) -> ScalingDecision:
         raise NotImplementedError
 
 
@@ -34,16 +64,31 @@ class FixedScalingPolicy(ScalingPolicy):
 
 class ElasticScalingPolicy(ScalingPolicy):
     """Size the group to what the cluster can currently hold, within
-    [min_workers, max_workers] (reference: scaling_policy/elastic.py)."""
+    [min_workers, max_workers] (reference: scaling_policy/elastic.py).
+
+    `cluster_resources` may be a full {resource: amount} dict (preferred:
+    the fit respects every requested resource shape, e.g. custom "spot" or
+    "TPU" markers, not just CPU) or a bare CPU count for compatibility.
+    Feed it `usable_cluster_resources(...)` — sizing against a raw
+    cluster sum counts DRAINING nodes and targets a width the cluster
+    can't actually hold."""
 
     def __init__(self, min_workers: int, max_workers: int):
         assert 1 <= min_workers <= max_workers
         self.min_workers = min_workers
         self.max_workers = max_workers
 
-    def target_size(self, cluster_cpus, resources_per_worker):
-        per = max(float(resources_per_worker.get("CPU", 1.0)), 1e-9)
-        fit = int(cluster_cpus // per)
+    def target_size(self, cluster_resources: Union[float, Dict[str, float]],
+                    resources_per_worker):
+        if not isinstance(cluster_resources, dict):
+            cluster_resources = {"CPU": float(cluster_resources)}
+        per = {k: float(v) for k, v in (resources_per_worker or {}).items()
+               if float(v) > 0}
+        if not per:
+            per = {"CPU": 1.0}
+        fit = min(
+            int(cluster_resources.get(k, 0.0) // v) for k, v in per.items()
+        )
         n = max(self.min_workers, min(self.max_workers, fit))
         return ScalingDecision(n, f"elastic fit={fit}")
 

@@ -30,7 +30,14 @@ from ray_tpu.train._policies import (
 
 @dataclass
 class ScalingConfig:
-    """Reference: ray.train.ScalingConfig (+ TPU fields of v2/jax/config.py)."""
+    """Reference: ray.train.ScalingConfig (+ TPU fields of v2/jax/config.py).
+
+    Setting `elastic_min_workers` makes the run ELASTIC: the group sizes
+    to current usable capacity within [elastic_min_workers, num_workers],
+    and — when the train fn drives `ctx.elastic.sync()` each step — a
+    planned node removal (drain/preemption) with enough survivors resizes
+    the live gang instead of tearing it down, re-expanding when capacity
+    returns (see train/_elastic.py; knob: `train_live_resize`)."""
 
     num_workers: int = 1
     resources_per_worker: Dict[str, float] = field(default_factory=dict)

@@ -470,7 +470,17 @@ def test_preemption_notice_mid_train_rejoins_from_checkpoint(seed, tmp_path):
             victim = next(s for s in spots if s.node_id == target)
             drained["node"] = target
             try:
-                _drain_daemon(cw, victim.address, "preemption", 30.0)
+                # short deadline: the daemon holds a node open for
+                # migratable/cooperative actor workers (the elastic
+                # live-resize window), so a 30s deadline would let this
+                # ~4s workload FINISH in place — this scenario exercises
+                # the checkpoint-restore fallback, which needs the workers
+                # to die mid-run. 4s = death at ~2.4s (0.6 budget), still
+                # mid-training, with enough tail for the replicate/
+                # unregister phases under chaos delays + machine load (a
+                # blown deadline records an UNEXPECTED death and would
+                # falsely charge the zero failure budget).
+                _drain_daemon(cw, victim.address, "preemption", 4.0)
             except Exception:  # noqa: BLE001
                 pass
 

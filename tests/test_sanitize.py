@@ -79,14 +79,17 @@ def test_native_surface_under_asan_ubsan():
 @pytest.mark.skipif(not build.sanitizer_preload(),
                     reason="libasan runtime not installed")
 def test_drain_recovery_under_asan_ubsan():
-    """Run the drain/recovery suites with the native libs instrumented:
-    the graceful-drain path drives the shm store hard (replication pulls,
-    peer fetch_chunks into freshly created segments, deletes racing reads)
-    and must stay clean under ASan/UBSan."""
+    """Run the drain/recovery/elastic suites with the native libs
+    instrumented: the graceful-drain path drives the shm store hard
+    (replication pulls, peer fetch_chunks into freshly created segments,
+    deletes racing reads), and the elastic live-resize path moves shard
+    payloads through the object plane mid-drain — all must stay clean
+    under ASan/UBSan."""
     env = _sanitize_env()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest",
          "tests/test_drain.py", "tests/test_lineage.py",
+         "tests/test_elastic_train.py",
          "-q", "-p", "no:cacheprovider", "-m", "not slow"],
         env=env, cwd=_REPO, capture_output=True, text=True, timeout=1500,
     )
