@@ -187,6 +187,19 @@ _flag("retry_base_s", 0.2, "Unified retry policy: first backoff delay (reference
 _flag("retry_max_s", 5.0, "Unified retry policy: backoff cap (decorrelated jitter draws in [base, prev*3] clipped here).")
 _flag("shutdown_timeout_s", 30.0, "Total deadline on ray_tpu.shutdown(): bounds job-finish + close so a drain or control-store failover in progress cannot hang driver exit (deadline machinery from _private.retry).")
 
+# --- serve overload plane (serve/_replica.py, _handle.py, _http.py) ---
+_flag("serve_max_queued_requests", 1000, "Default bounded queue per serve replica: admitted-but-not-running requests beyond this are rejected with BackpressureError (HTTP 503 + Retry-After). Per-deployment override: @serve.deployment(max_queued_requests=); -1 = unbounded (reference: serve max_queued_requests admission control).")
+_flag("serve_default_timeout_s", 0.0, "Default end-to-end request deadline applied by handles when the caller sets none (0 = no deadline). Explicit handle.options(timeout_s=) / the X-Serve-Timeout-S HTTP header / rt-serve-timeout-s gRPC metadata always win.")
+_flag("serve_retry_after_s", 1.0, "Suggested client backoff carried on BackpressureError and emitted as the HTTP Retry-After header on 503 sheds.")
+_flag("serve_retry_budget_ratio", 0.2, "Serve handle retry budget: tokens deposited per successful request (each failover retry spends one) — sustained retry throughput is capped at this fraction of recent goodput so overload can't amplify itself (reference: envoy retry budgets).")
+_flag("serve_retry_budget_min", 3, "Initial retry-budget floor per handle: failovers available before any success has been observed (cold handles must still ride out one replica death).")
+_flag("serve_outlier_consecutive_failures", 3, "Consecutive failures/timeouts on one replica before the handle ejects it from the routing set (reference: envoy outlier detection).")
+_flag("serve_outlier_probation_s", 5.0, "How long an ejected replica stays out of the routing set; the first request after the window is the probation re-probe (one more failure re-ejects immediately).")
+_flag("serve_shed_at_ingress", True, "Shed at the handle/proxy BEFORE spending a replica RPC when every replica's freshly probed load is at capacity (max_concurrent + max_queued). Requires a bounded queue; stale probes read as headroom.")
+_flag("serve_refresh_timeout_s", 5.0, "Deadline on one handle->controller routing-table refresh attempt; expiry (controller outage) keeps the last-known replica set serving and retries on this cadence instead of the full refresh TTL.")
+_flag("serve_health_probe_timeout_s", 10.0, "Serve controller reconcile-loop replica health/stats probe deadline; a probe that expires marks the replica unhealthy (wedged replicas are killed and replaced instead of freezing the deployment's reconcile forever).")
+_flag("serve_replica_init_timeout_s", 60.0, "Deadline on a new replica's construction gate (first health probe); a replica wedged in __init__ is reaped instead of holding the controller's scale lock forever.")
+
 # --- graceful drain & preemption (reference: DrainNode protocol, NodeDeathInfo) ---
 _flag("drain_deadline_s", 30.0, "Default drain deadline: how long a draining node lets running work finish before it replicates primaries, migrates actors, and exits with an expected-termination record.")
 _flag("drain_replicate_max_objects", 4096, "Max primary object copies a draining node proactively replicates to live peers before exiting (objects beyond the cap fall back to lineage reconstruction).")

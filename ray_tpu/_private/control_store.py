@@ -1168,7 +1168,7 @@ class ControlStore:
             if info.state != pb.NODE_ALIVE or nid in exclude:
                 continue
             if strategy.label_selector:
-                if not all(info.labels.get(k) == v for k, v in strategy.label_selector.items()):
+                if not pb.labels_match(info.labels, strategy.label_selector):
                     continue
             avail = self.node_available.get(nid)
             if avail is None or not spec.resources.is_subset_of(avail):
@@ -1299,10 +1299,7 @@ class ControlStore:
             nid: ResourceSet.from_wire(a.to_wire())
             for nid, a in self.node_available.items()
             if nid in self.nodes and self.nodes[nid].state == pb.NODE_ALIVE
-            and all(
-                self.nodes[nid].labels.get(k) == v
-                for k, v in rec.label_selector.items()
-            )
+            and pb.labels_match(self.nodes[nid].labels, rec.label_selector)
         }
         placements: Dict[int, bytes] = {}
         if rec.strategy == pb.PG_TOPOLOGY_STRICT_PACK:

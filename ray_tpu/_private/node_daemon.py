@@ -135,6 +135,12 @@ class NodeDaemon:
         if "CPU" not in res:
             res["CPU"] = float(os.cpu_count() or 1)
         self.labels = dict(labels or {})
+        # spot/preemptible marker normalization: a node advertising the
+        # "spot" custom resource IS spot capacity — mirror it into the
+        # label plane so anti-affinity selectors (label_selector=
+        # {"spot": "!true"}) can keep coordination actors off it
+        if res.get("spot"):
+            self.labels.setdefault("spot", "true")
         if "TPU" not in res and os.environ.get("RT_TPU_AUTODETECT"):
             # env-only detection: the daemon must not touch libtpu (that
             # would claim the chips workers need). Opt-in: on shared-sandbox
@@ -961,13 +967,10 @@ class NodeDaemon:
     def _labels_match(labels: Optional[Dict[str, str]],
                       selector: Optional[Dict[str, str]]) -> bool:
         """One definition of label-selector matching for every scheduling
-        decision (choose/grant/spill/feasibility) — reference:
-        node_label_scheduling_policy.h."""
-        if not selector:
-            return True
-        if labels is None:
-            return False
-        return all(labels.get(k) == v for k, v in selector.items())
+        decision (choose/grant/spill/feasibility) — shared with the
+        control store via pb.labels_match; supports "!value" anti-affinity
+        (reference: node_label_scheduling_policy.h)."""
+        return pb.labels_match(labels, selector)
 
     def _choose_node(self, res: ResourceSet, strategy: pb.SchedulingStrategy,
                      exclude_self: bool = False) -> Optional[str]:

@@ -108,6 +108,26 @@ STRATEGY_NODE_AFFINITY = "NODE_AFFINITY"
 STRATEGY_PLACEMENT_GROUP = "PLACEMENT_GROUP"
 
 
+def labels_match(labels: Optional[Dict[str, str]],
+                 selector: Optional[Dict[str, str]]) -> bool:
+    """ONE definition of label-selector matching for every scheduling
+    decision (choose/grant/spill/feasibility/PG bin-pack) — reference:
+    node_label_scheduling_policy.h + scheduling/label_selector.h's `!`
+    operator. A selector value of "!v" matches nodes whose label is
+    ABSENT or different — the anti-affinity form used to keep
+    coordination actors off spot/preemptible capacity."""
+    if not selector:
+        return True
+    labels = labels or {}
+    for k, v in selector.items():
+        if v.startswith("!"):
+            if labels.get(k) == v[1:]:
+                return False
+        elif labels.get(k) != v:
+            return False
+    return True
+
+
 @dataclass
 class SchedulingStrategy:
     kind: str = STRATEGY_DEFAULT

@@ -17,6 +17,8 @@ from ray_tpu.serve._controller import (
     get_or_create_controller,
 )
 from ray_tpu.serve._batching import batch
+from ray_tpu.serve._context import get_request_deadline, remaining_s
+from ray_tpu.serve._errors import BackpressureError, DeadlineExceededError
 from ray_tpu.serve._handle import DeploymentHandle
 from ray_tpu.serve._multiplex import get_multiplexed_model_id, multiplexed
 
@@ -30,13 +32,19 @@ class Deployment:
                  ray_actor_options: Optional[dict] = None,
                  max_concurrent_queries: int = 100,
                  init_args: tuple = (), init_kwargs: Optional[dict] = None,
-                 version: Optional[str] = None):
+                 version: Optional[str] = None,
+                 max_queued_requests: Optional[int] = None):
         self._target = target
         self.name = name
         self.num_replicas = num_replicas
         self.autoscaling_config = autoscaling_config
         self.ray_actor_options = dict(ray_actor_options or {})
         self.max_concurrent_queries = max_concurrent_queries
+        # bounded replica queue (reference: serve max_queued_requests):
+        # admitted-but-not-running requests beyond this are rejected with
+        # BackpressureError. None = the serve_max_queued_requests config
+        # flag; -1 = explicitly unbounded.
+        self.max_queued_requests = max_queued_requests
         self._init_args = init_args
         self._init_kwargs = dict(init_kwargs or {})
         # Stable code identity: redeploying with the same version is a pure
@@ -52,6 +60,7 @@ class Deployment:
             autoscaling_config=self.autoscaling_config,
             ray_actor_options=self.ray_actor_options,
             max_concurrent_queries=self.max_concurrent_queries,
+            max_queued_requests=self.max_queued_requests,
             init_args=self._init_args,
             init_kwargs=self._init_kwargs,
             name=self.name,
@@ -69,6 +78,7 @@ class Deployment:
             autoscaling_config=self.autoscaling_config,
             ray_actor_options=self.ray_actor_options,
             max_concurrent_queries=self.max_concurrent_queries,
+            max_queued_requests=self.max_queued_requests,
             init_args=args, init_kwargs=kwargs,
             version=self.version,
         )
@@ -79,7 +89,8 @@ def deployment(_target=None, *, name: Optional[str] = None,
                autoscaling_config: Optional[dict] = None,
                ray_actor_options: Optional[dict] = None,
                max_concurrent_queries: int = 100,
-               version: Optional[str] = None):
+               version: Optional[str] = None,
+               max_queued_requests: Optional[int] = None):
     """`@serve.deployment` decorator (reference: serve.api.deployment)."""
 
     def wrap(target):
@@ -90,6 +101,7 @@ def deployment(_target=None, *, name: Optional[str] = None,
             ray_actor_options=ray_actor_options,
             max_concurrent_queries=max_concurrent_queries,
             version=version,
+            max_queued_requests=max_queued_requests,
         )
 
     if _target is not None:
@@ -121,6 +133,7 @@ def run(dep: Deployment, *, wait_for_ready: bool = True,
             actor_options=dep.ray_actor_options,
             max_concurrent=dep.max_concurrent_queries,
             version=dep.version,
+            max_queued=dep.max_queued_requests,
         ),
         timeout=timeout,
     )
@@ -239,6 +252,10 @@ __all__ = [
     "deploy_config",
     "multiplexed",
     "get_multiplexed_model_id",
+    "get_request_deadline",
+    "remaining_s",
+    "BackpressureError",
+    "DeadlineExceededError",
     "Deployment",
     "DeploymentHandle",
     "deployment",

@@ -19,7 +19,7 @@ class _BatchQueue:
     __slots__ = ("items", "timer")
 
     def __init__(self):
-        self.items: List[tuple] = []  # (item, future)
+        self.items: List[tuple] = []  # (item, future, deadline-or-0)
         self.timer: Optional[asyncio.TimerHandle] = None
 
 
@@ -75,6 +75,27 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
             items, q.items = q.items, []
             if not items:
                 return
+            # deadline-aware batch admission: a request whose end-to-end
+            # deadline expired while waiting for the batch window must not
+            # ride into the model invocation — its caller is gone, and its
+            # slot in the batch would be pure waste. Fail it typed, run
+            # the batch on the survivors.
+            import time as _time
+
+            from ray_tpu.serve._errors import DeadlineExceededError
+
+            now = _time.time()
+            live = []
+            for it, fut, deadline in items:
+                if deadline and now >= deadline:
+                    if not fut.done():
+                        fut.set_exception(DeadlineExceededError(
+                            "request deadline expired in the batch queue"))
+                else:
+                    live.append((it, fut))
+            items = live
+            if not items:
+                return
             batch_in = [it for it, _ in items]
             try:
                 out = fn(self_obj, batch_in) if is_method else fn(batch_in)
@@ -103,7 +124,11 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
             loop = asyncio.get_running_loop()
             q = queue_for(self_obj, wrapper)
             fut = loop.create_future()
-            q.items.append((item, fut))
+            # snapshot the caller's deadline at ENQUEUE time: the flush
+            # runs outside the request's context (timer callback)
+            from ray_tpu.serve._context import get_request_deadline
+
+            q.items.append((item, fut, get_request_deadline()))
             if len(q.items) >= max_batch_size:
                 await flush(q, self_obj)
             elif q.timer is None:

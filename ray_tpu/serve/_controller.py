@@ -26,6 +26,11 @@ class ServeController:
     def __init__(self):
         # name -> {"config": {...}, "replicas": [handles], "target": int}
         self.deployments: Dict[str, dict] = {}
+        # tombstones: deletion must stay distinguishable from "this
+        # controller never heard of it" (an amnesiac auto-recreated
+        # controller) — handles honor a deleted deployment's empty set
+        # but keep serving a last-known set through an amnesiac one
+        self._deleted: set = set()
         self._reconcile_task = None
         self._running = True
         # All replica-set mutations interleave on the actor's event loop
@@ -69,8 +74,9 @@ class ServeController:
         callable, so a byte mismatch alone must not force a roll when the
         user pinned a version (reference: serve deployment `version=` and
         the lightweight-config-update path in deployment_state.py)."""
-        for k in ("autoscaling", "actor_options", "max_concurrent"):
-            if old_cfg[k] != new_cfg[k]:
+        for k in ("autoscaling", "actor_options", "max_concurrent",
+                  "max_queued"):
+            if old_cfg.get(k) != new_cfg.get(k):
                 return k
         if old_cfg.get("version") is not None \
                 and old_cfg.get("version") == new_cfg.get("version"):
@@ -88,17 +94,24 @@ class ServeController:
                      autoscaling: Optional[dict] = None,
                      actor_options: Optional[dict] = None,
                      max_concurrent: int = 100,
-                     version: Optional[str] = None) -> bool:
+                     version: Optional[str] = None,
+                     max_queued: Optional[int] = None) -> bool:
         await self._ensure_loop()
+        if max_queued is None:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            max_queued = GLOBAL_CONFIG.get("serve_max_queued_requests")
         config = {
             "callable_blob": callable_blob,
             "init_args_blob": init_args_blob,
             "autoscaling": autoscaling,
             "actor_options": dict(actor_options or {}),
             "max_concurrent": max_concurrent,
+            "max_queued": max_queued,
             "version": version,
         }
         async with self._scale_lock:
+            self._deleted.discard(name)
             old = self.deployments.get(name)
             differs = (None if old is None
                        else self._config_matches(old["config"], config))
@@ -135,6 +148,7 @@ class ServeController:
             if name in self.deployments:
                 await self._scale_to_locked(name, 0)
                 del self.deployments[name]
+                self._deleted.add(name)
         return True
 
     async def get_replicas(self, name: str) -> list:
@@ -155,6 +169,26 @@ class ServeController:
                 continue
             live.append(r)
         return live
+
+    async def get_routing_info(self, name: str) -> dict:
+        """Replica set + admission capacity for the handle's router. The
+        `known` bit lets a handle distinguish "deployment deleted" (honor
+        the empty set) from "this controller has never heard of it" (an
+        amnesiac controller freshly auto-created after a crash — the
+        handle keeps serving its last-known set)."""
+        d = self.deployments.get(name)
+        if d is None:
+            # a tombstoned name IS known — deleted: the empty set is
+            # authoritative and handles must stop routing to the corpses
+            return {"known": name in self._deleted, "replicas": [],
+                    "max_concurrent": 0, "max_queued": -1}
+        cfg = d["config"]
+        return {
+            "known": True,
+            "replicas": await self.get_replicas(name),
+            "max_concurrent": cfg["max_concurrent"],
+            "max_queued": cfg.get("max_queued", -1),
+        }
 
     async def list_deployments(self) -> dict:
         return {
@@ -193,6 +227,23 @@ class ServeController:
 
     # -- reconciliation -------------------------------------------------
 
+    @staticmethod
+    async def _await_ref(ref):
+        # plain-coroutine wrapper: asyncio.wait_for needs something
+        # ensure_future understands on every supported Python
+        return await ref
+
+    async def _probe(self, ref, timeout: Optional[float] = None):
+        """Deadline-bounded replica probe. Every await of a replica's
+        health/stats from the reconcile path MUST ride this: an unbounded
+        await on a wedged replica freezes the deployment's reconcile (and
+        with it scaling and failure replacement) forever."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        if timeout is None:
+            timeout = GLOBAL_CONFIG.get("serve_health_probe_timeout_s")
+        return await asyncio.wait_for(self._await_ref(ref), timeout=timeout)
+
     async def _kill_replica(self, replica):
         """Awaited kill: ray_tpu.kill from the controller's event loop is
         fire-and-forget, and a controller torn down right after scheduling
@@ -228,11 +279,19 @@ class ServeController:
             ).remote(
                 name, rid, cfg["callable_blob"], cfg["init_args_blob"],
                 max_concurrent=cfg["max_concurrent"],
+                max_queued=cfg.get("max_queued", -1),
             )
             # fail fast if the replica can't construct — and reap the actor,
-            # or a late start would leak a detached replica holding resources
+            # or a late start would leak a detached replica holding
+            # resources. BOUNDED: a replica wedged in __init__ (chaos
+            # stall, deadlocked model load) must not freeze this
+            # deployment's reconcile forever — expiry is unhealthy.
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
             try:
-                await replica.health.remote()
+                await asyncio.wait_for(
+                    self._await_ref(replica.health.remote()),
+                    timeout=GLOBAL_CONFIG.get("serve_replica_init_timeout_s"))
             except Exception:
                 await self._kill_replica(replica)
                 raise
@@ -372,28 +431,56 @@ class ServeController:
             if self.deployments.get(name) is not d:
                 return  # deleted or redeployed while we waited for the lock
             auto = d["config"]["autoscaling"]
-            # replace dead replicas
-            alive = []
-            for r in d["replicas"]:
+            # replace dead replicas. Probes are DEADLINE-BOUNDED: a replica
+            # stalled by chaos (testing_rpc_stall) or wedged user code
+            # previously froze this await — and the whole deployment's
+            # reconcile — forever. Expiry is unhealthy: the replica is
+            # killed (it still exists but can't serve; dropping it without
+            # the kill would leak a detached actor) and replaced below.
+            # Probes run CONCURRENTLY: this holds _scale_lock, and N wedged
+            # replicas probed serially would stall deploys for N timeouts.
+            async def health_of(r):
                 try:
-                    await r.health.remote()
-                    alive.append(r)
+                    await self._probe(r.health.remote())
+                    return "alive"
+                except asyncio.TimeoutError:
+                    return "wedged"
                 except Exception:  # noqa: BLE001 — replica died
-                    pass
+                    return "dead"
+
+            verdicts = await asyncio.gather(
+                *[health_of(r) for r in d["replicas"]])
+            alive = []
+            for r, verdict in zip(d["replicas"], verdicts):
+                if verdict == "alive":
+                    alive.append(r)
+                elif verdict == "wedged":
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "serve deployment %s: replica health probe timed "
+                        "out — ejecting the wedged replica", name)
+                    await self._kill_replica(r)
             if self.deployments.get(name) is not d:
                 return
             d["replicas"] = alive
+
             if auto is None:
                 if len(d["replicas"]) < d["target"]:
                     await self._scale_to_locked(name, d["target"])
                 return
-            ongoing = 0
-            for r in d["replicas"]:
+
+            async def stats_of(r):
                 try:
-                    st = await r.stats.remote()
-                    ongoing += max(st["ongoing"], st.get("peak_ongoing", 0))
+                    return await self._probe(r.stats.remote())
                 except Exception:  # noqa: BLE001
-                    pass
+                    return None
+
+            ongoing = 0
+            for st in await asyncio.gather(
+                    *[stats_of(r) for r in d["replicas"]]):
+                if st is not None:
+                    ongoing += max(st["ongoing"], st.get("peak_ongoing", 0))
             if self.deployments.get(name) is not d:
                 return
             target_per = max(1, auto.get("target_ongoing_requests", 2))

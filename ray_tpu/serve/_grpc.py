@@ -22,10 +22,16 @@ import asyncio
 import json
 
 import ray_tpu
+from ray_tpu.serve._errors import (
+    BackpressureError,
+    DeadlineExceededError,
+    unwrap,
+)
 
 GRPC_PROXY_NAME = "serve-grpc-proxy"
 SERVICE = "ray_tpu.serve.Serve"
 DEPLOYMENT_KEY = "rt-serve-deployment"
+TIMEOUT_KEY = "rt-serve-timeout-s"
 
 
 @ray_tpu.remote
@@ -101,6 +107,33 @@ class GrpcProxy:
                 return value
         return None
 
+    @staticmethod
+    def _timeout_from(context):
+        """End-to-end deadline: the rt-serve-timeout-s metadata key, or
+        the client's own gRPC deadline (time_remaining) — whichever is
+        tighter propagates to the replica so work the caller will never
+        see is not done."""
+        meta = None
+        for key, value in context.invocation_metadata():
+            if key == TIMEOUT_KEY:
+                try:
+                    meta = float(value)
+                except (TypeError, ValueError):
+                    pass
+        native = context.time_remaining()
+        bounds = [t for t in (meta, native) if t is not None and t > 0]
+        return min(bounds) if bounds else None
+
+    async def _abort_typed(self, context, e: Exception):
+        import grpc
+
+        err = unwrap(e)
+        if isinstance(err, BackpressureError):
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(err))
+        if isinstance(err, (DeadlineExceededError, ray_tpu.GetTimeoutError)):
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(err))
+        await context.abort(grpc.StatusCode.INTERNAL, str(err))
+
     async def _resolve(self, request: bytes, context):
         import grpc
 
@@ -124,26 +157,33 @@ class GrpcProxy:
         return handle, payload
 
     async def _call(self, request: bytes, context):
-        import grpc
-
         handle, payload = await self._resolve(request, context)
+        timeout_s = self._timeout_from(context)
+        caller = (handle if timeout_s is None
+                  else handle.options(timeout_s=timeout_s))
         try:
-            result = await handle.remote(payload)
-        except Exception as e:  # noqa: BLE001 — surface as gRPC status
-            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+            result = await caller.remote(payload)
+        except Exception as e:  # noqa: BLE001 — typed gRPC status mapping
+            await self._abort_typed(context, e)
         return json.dumps({"result": result}, default=str).encode()
 
     async def _call_stream(self, request: bytes, context):
-        import grpc
-
         handle, payload = await self._resolve(request, context)
+        timeout_s = self._timeout_from(context)
+        caller = (handle if timeout_s is None
+                  else handle.options(timeout_s=timeout_s))
+        stream = None
         try:
-            stream = handle.options(stream=True).remote(payload)
+            stream = caller.options(stream=True).remote(payload)
             async for ref in stream:
                 item = await ref
                 yield json.dumps(item, default=str).encode()
         except Exception as e:  # noqa: BLE001
-            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+            # replica errors arrive on the awaited item ref, outside the
+            # iterator — report them so ejection/refresh still happen
+            if stream is not None and hasattr(stream, "note_failure"):
+                e = stream.note_failure(e)
+            await self._abort_typed(context, e)
 
     async def drain(self) -> bool:
         self._draining = True

@@ -6,6 +6,11 @@ shutdown). aiohttp server inside a detached actor:
 
 - POST /<deployment> with a JSON (or raw bytes) body routes to the
   deployment's __call__ and returns the JSON-encoded result.
+- a request carrying `X-Serve-Timeout-S: <float>` (or `?timeout_s=`)
+  gets an END-TO-END deadline stamped at ingress; it propagates through
+  the handle to the replica, and expiry maps to 504. Admission-control
+  rejections (bounded replica queues / ingress shed) map to 503 with a
+  Retry-After header.
 - a request carrying `?stream=1` or a JSON body with `"stream": true`
   rides the STREAMING path end-to-end: the replica drives the user's
   generator, items flow back over the actor streaming plane, and the proxy
@@ -24,9 +29,31 @@ import json
 from typing import Optional
 
 import ray_tpu
+from ray_tpu.serve._errors import (
+    BackpressureError,
+    DeadlineExceededError,
+    unwrap,
+)
 
 PROXY_NAME = "serve-http-proxy"
 SERVE_NAMESPACE = "_serve"
+TIMEOUT_HEADER = "X-Serve-Timeout-S"
+_SENTINEL = object()
+
+
+def _error_response(e: Exception):
+    """Map a serve-plane error to (status, headers, body-dict): typed
+    overload errors carry their semantics to the client — 503 +
+    Retry-After for sheds (retry elsewhere/later), 504 for spent
+    deadlines (do NOT retry: the budget is gone)."""
+    err = unwrap(e)
+    if isinstance(err, BackpressureError):
+        return 503, {"Retry-After": str(max(1, round(err.retry_after_s)))}, {
+            "error": str(err), "type": "backpressure",
+            "retry_after_s": err.retry_after_s}
+    if isinstance(err, (DeadlineExceededError, ray_tpu.GetTimeoutError)):
+        return 504, {}, {"error": str(err), "type": "deadline_exceeded"}
+    return 500, {}, {"error": str(err), "type": "internal"}
 
 
 @ray_tpu.remote
@@ -42,6 +69,10 @@ class HttpProxy:
         self._started = None
         self._inflight = 0
         self._draining = False
+        # overload-plane counters surfaced on /-/healthz (and scraped by
+        # bench_serve): how much traffic this proxy shed / timed out
+        self._shed = 0
+        self._deadline_exceeded = 0
 
     async def _start(self):
         from aiohttp import web
@@ -85,7 +116,9 @@ class HttpProxy:
 
         return web.json_response(
             {"status": "draining" if self._draining else "ok",
-             "inflight": self._inflight},
+             "inflight": self._inflight,
+             "shed": self._shed,
+             "deadline_exceeded": self._deadline_exceeded},
             status=503 if self._draining else 200)
 
     async def _get_handle(self, name: str):
@@ -131,41 +164,101 @@ class HttpProxy:
             payload = None
         stream = request.query.get("stream", "") in ("1", "true") or (
             isinstance(payload, dict) and bool(payload.get("stream")))
+        timeout_s = self._timeout_from(request)
+        caller = (handle if timeout_s is None
+                  else handle.options(timeout_s=timeout_s))
         if stream:
-            return await self._dispatch_stream(request, handle, payload)
+            return await self._dispatch_stream(request, caller, payload)
         try:
-            result = await handle.remote(payload)
-        except Exception as e:  # noqa: BLE001 — surface as 500
-            return web.json_response({"error": str(e)}, status=500)
+            result = await caller.remote(payload)
+        except Exception as e:  # noqa: BLE001 — typed mapping below
+            status, headers, body = _error_response(e)
+            if status == 503:
+                self._shed += 1
+            elif status == 504:
+                self._deadline_exceeded += 1
+            return web.json_response(body, status=status, headers=headers)
         try:
             return web.json_response({"result": result})
         except TypeError:
             return web.Response(body=bytes(result))
 
+    @staticmethod
+    def _timeout_from(request) -> Optional[float]:
+        raw = request.headers.get(TIMEOUT_HEADER) or request.query.get(
+            "timeout_s")
+        if not raw:
+            return None
+        try:
+            t = float(raw)
+        except ValueError:
+            return None
+        return t if t > 0 else None
+
     async def _dispatch_stream(self, request, handle, payload):
         """SSE: one `data:` event per generator item, flushed as produced
-        (reference: proxy.py:1031 ASGI streaming)."""
+        (reference: proxy.py:1031 ASGI streaming). Admission failures
+        (shed / expired deadline) happen BEFORE the response starts and
+        map to real 503/504 statuses; a deadline that expires mid-stream
+        can only be an SSE error event — the 200 is already on the wire."""
         from aiohttp import web
 
+        # Defer the 200/SSE headers until the FIRST item arrives: replica
+        # admission control (queue full, spent deadline) rejects a stream
+        # on its first chunk, and that must be a clean 503/504 — once the
+        # event-stream response has started, only error events remain.
+        first = _SENTINEL
+        try:
+            stream = handle.options(stream=True).remote(payload)
+            it = stream.__aiter__()
+            try:
+                first = await (await it.__anext__())
+            except StopAsyncIteration:
+                pass
+        except Exception as e:  # noqa: BLE001 — typed mapping
+            status, headers, body = _error_response(e)
+            if status == 503:
+                self._shed += 1
+            elif status == 504:
+                self._deadline_exceeded += 1
+            return web.json_response(body, status=status, headers=headers)
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
             "X-Accel-Buffering": "no",
         })
         await resp.prepare(request)
+
+        def encode(item) -> bytes:
+            try:
+                data = json.dumps(item)
+            except TypeError:
+                data = json.dumps(str(item))
+            return f"data: {data}\n\n".encode()
+
         try:
-            stream = handle.options(stream=True).remote(payload)
-            async for ref in stream:
-                item = await ref
-                try:
-                    data = json.dumps(item)
-                except TypeError:
-                    data = json.dumps(str(item))
-                await resp.write(f"data: {data}\n\n".encode())
+            if first is not _SENTINEL:
+                await resp.write(encode(first))
+                async for ref in it:
+                    await resp.write(encode(await ref))
             await resp.write(b"data: [DONE]\n\n")
         except Exception as e:  # noqa: BLE001 — mid-stream error event
+            # route the failure through the stream's health bookkeeping:
+            # replica errors ride the final ITEM ref, which we await here
+            # (outside the iterator), so the iterator can't see them
+            err = stream.note_failure(e) if hasattr(
+                stream, "note_failure") else unwrap(e)
+            if isinstance(err, DeadlineExceededError):
+                kind = "deadline_exceeded"
+                self._deadline_exceeded += 1
+            elif isinstance(err, BackpressureError):
+                kind = "backpressure"
+                self._shed += 1
+            else:
+                kind = "error"
             await resp.write(
-                f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+                f"data: {json.dumps({'error': str(err), 'type': kind})}"
+                f"\n\n".encode())
         await resp.write_eof()
         return resp
 

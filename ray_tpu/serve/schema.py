@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional
 
 _DEPLOYMENT_OVERRIDES = (
     "num_replicas", "autoscaling_config", "ray_actor_options",
-    "max_concurrent_queries",
+    "max_concurrent_queries", "max_queued_requests",
 )
 
 
@@ -120,6 +120,8 @@ def build_config(*deployments, http_host: str = "127.0.0.1",
             "num_replicas": dep.num_replicas,
             "max_concurrent_queries": dep.max_concurrent_queries,
         }
+        if dep.max_queued_requests is not None:
+            app["max_queued_requests"] = dep.max_queued_requests
         if dep.autoscaling_config:
             app["autoscaling_config"] = dep.autoscaling_config
         if dep.ray_actor_options:

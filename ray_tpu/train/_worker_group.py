@@ -338,10 +338,41 @@ class WorkerGroup:
         # workers receive it in start()); the name is only for debugging.
         import os as _os
 
+        placement = self._sync_actor_placement()
         self.sync_actor = SyncActor.options(
             name=f"{self.run_name}-sync-{_os.urandom(4).hex()}",
             namespace="_train",
+            **placement,
         ).remote()
+        if placement:
+            # the anti-spot selector was chosen from a SNAPSHOT: if the
+            # last non-spot node left between the check and placement, the
+            # selector is unmatchable and the actor queues infeasible
+            # forever. Probe readiness; on expiry RE-CHECK feasibility —
+            # only a genuinely all-spot cluster falls back to
+            # unconstrained placement (a merely slow scheduler must not
+            # silently trade away the anti-spot protection).
+            try:
+                ray_tpu.get(self.sync_actor.generation.remote(), timeout=20)
+            except (ray_tpu.GetTimeoutError, ray_tpu.ActorDiedError,
+                    ray_tpu.ActorUnavailableError):
+                if self._sync_actor_placement():
+                    logger.warning(
+                        "anti-spot SyncActor slow to place but non-spot "
+                        "capacity still exists — keeping the constraint")
+                else:
+                    logger.warning(
+                        "anti-spot SyncActor placement infeasible "
+                        "(non-spot capacity gone) — falling back to "
+                        "unconstrained placement")
+                    try:
+                        ray_tpu.kill(self.sync_actor)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self.sync_actor = SyncActor.options(
+                        name=f"{self.run_name}-sync-{_os.urandom(4).hex()}",
+                        namespace="_train",
+                    ).remote()
 
         if self.use_tpu_slices:
             from ray_tpu.tpu.slice import slice_placement_group
@@ -391,6 +422,35 @@ class WorkerGroup:
         self._latest = latest_checkpoint
         self._resolve_worker_nodes()
         return self
+
+    @staticmethod
+    def _sync_actor_placement() -> Dict[str, Any]:
+        """Pin the rendezvous/barrier actor OFF spot/preemptible capacity
+        (nodes labeled spot=true / preemptible=true): every elastic resize
+        rendezvouses through the SyncActor, so losing it to a reclaimed
+        spot node mid-resize turns a planned shrink into a full
+        checkpoint-restore. Anti-affinity via the "!value" label selector;
+        falls back to unconstrained placement when every usable node
+        carries the marker (an all-spot cluster must still train)."""
+        try:
+            from ray_tpu._private.worker import nodes as _nodes
+
+            usable = [n for n in _nodes()
+                      if n["state"] == "ALIVE" and not n["drain_reason"]]
+        except Exception:  # noqa: BLE001 — control store unreachable
+            return {}
+
+        def on_spot(n) -> bool:
+            labels = n.get("labels") or {}
+            return (labels.get("spot") == "true"
+                    or labels.get("preemptible") == "true")
+
+        if usable and all(on_spot(n) for n in usable):
+            logger.warning(
+                "every usable node carries the spot/preemptible marker — "
+                "placing the rendezvous SyncActor on spot capacity")
+            return {}
+        return {"label_selector": {"spot": "!true", "preemptible": "!true"}}
 
     def _worker_options(self, pg=None, bundle_index: int = -1):
         opts: Dict[str, Any] = {"resources": self.resources_per_worker}

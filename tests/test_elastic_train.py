@@ -625,3 +625,94 @@ def test_chaos_preempt_shrink_regrow_mid_training(seed, tmp_path):
         except Exception:  # noqa: BLE001
             pass
         cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# rendezvous SyncActor pinned off spot/preemptible capacity (PR-5 follow-up)
+# ---------------------------------------------------------------------------
+
+
+def test_sync_actor_placement_selector_unit(monkeypatch):
+    """Placement resolution: anti-spot selector when mixed capacity
+    exists; unconstrained fallback when EVERY usable node is spot (an
+    all-spot cluster must still train); control-store outage -> no
+    constraint rather than no actor."""
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.train._worker_group import WorkerGroup
+
+    def fake_nodes(nodes):
+        return lambda: nodes
+
+    mixed = [
+        {"state": "ALIVE", "drain_reason": "", "labels": {}},
+        {"state": "ALIVE", "drain_reason": "", "labels": {"spot": "true"}},
+    ]
+    monkeypatch.setattr(worker_mod, "nodes", fake_nodes(mixed))
+    assert WorkerGroup._sync_actor_placement() == {
+        "label_selector": {"spot": "!true", "preemptible": "!true"}}
+
+    all_spot = [
+        {"state": "ALIVE", "drain_reason": "", "labels": {"spot": "true"}},
+        {"state": "ALIVE", "drain_reason": "",
+         "labels": {"preemptible": "true"}},
+    ]
+    monkeypatch.setattr(worker_mod, "nodes", fake_nodes(all_spot))
+    assert WorkerGroup._sync_actor_placement() == {}
+
+    # a draining non-spot node does not count as usable anti-spot capacity
+    draining_mix = [
+        {"state": "ALIVE", "drain_reason": "preemption", "labels": {}},
+        {"state": "ALIVE", "drain_reason": "", "labels": {"spot": "true"}},
+    ]
+    monkeypatch.setattr(worker_mod, "nodes", fake_nodes(draining_mix))
+    assert WorkerGroup._sync_actor_placement() == {}
+
+    def boom():
+        raise RuntimeError("control store down")
+
+    monkeypatch.setattr(worker_mod, "nodes", boom)
+    assert WorkerGroup._sync_actor_placement() == {}
+
+
+def test_sync_actor_pinned_off_spot_nodes(tmp_path):
+    """Regression (ROADMAP PR-5 follow-up): the rendezvous SyncActor must
+    not ride spot capacity — a reclaimed spot node would take the barrier
+    actor down mid-resize. Nodes advertising the "spot" resource are
+    label-marked by their daemon; the group's sync actor lands elsewhere
+    while the (spot-constrained) workers land on the spot nodes."""
+    from ray_tpu._private.core_worker import get_core_worker
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train._worker_group import WorkerGroup
+
+    from ray_tpu.train._worker_group import SyncActor
+
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 2})
+    try:
+        spot = cluster.add_node(resources={"CPU": 4, "spot": 2})
+        ray_tpu.init(address=cluster.address)
+        cw = get_core_worker()
+        # the daemon normalized the "spot" resource into a spot=true label
+        labels = {n["node_id"]: n["labels"] for n in ray_tpu.nodes()}
+        assert labels[spot.node_id].get("spot") == "true"
+        # the group's placement resolution picks the anti-spot selector...
+        opts = WorkerGroup._sync_actor_placement()
+        assert opts == {"label_selector": {"spot": "!true",
+                                           "preemptible": "!true"}}
+        # ...and the scheduler honors it: the actor lands off the spot node
+        sa = SyncActor.options(name="pin-test-sync", namespace="_train",
+                               **opts).remote()
+        assert ray_tpu.get(sa.generation.remote(), timeout=60) == 0
+        info = cw.run_sync(cw.control.call(
+            "get_actor_info",
+            {"actor_id": sa._actor_id.binary()}), 30)["actor"]
+        sync_node = info["node_id"].hex()
+        assert sync_node != spot.node_id, (
+            "rendezvous SyncActor placed on spot capacity")
+        assert sync_node == cluster.head_node.node_id
+        ray_tpu.kill(sa)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()

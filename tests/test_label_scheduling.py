@@ -46,6 +46,14 @@ def test_task_label_selector_targets_matching_node(cluster):
         where.options(label_selector={"zone": "b", "tier": "accel"}).remote(),
         timeout=120)
     assert {both} == zones["b"]
+    # negated selector ("!value" = absent-or-different): "!accel" excludes
+    # the accel node and pins everything onto zone a (reuses this
+    # cluster — anti-affinity is how the train plane keeps its rendezvous
+    # SyncActor off spot capacity)
+    not_accel = set(rt.get(
+        [where.options(label_selector={"tier": "!accel"}).remote()
+         for _ in range(4)], timeout=120))
+    assert not_accel == zones["a"]
 
 
 def test_unmatchable_selector_reported_infeasible(cluster):
@@ -53,3 +61,21 @@ def test_unmatchable_selector_reported_infeasible(cluster):
     with pytest.raises(ray_tpu.GetTimeoutError):
         ray_tpu.get(ref, timeout=4)  # queued as infeasible, never granted
     ray_tpu.cancel(ref)
+
+
+def test_labels_match_negation_semantics():
+    """"!value" selector entries are anti-affinity: absent-or-different
+    labels match (shared matcher for daemon + control store scheduling)."""
+    from ray_tpu._private.protocol import labels_match
+
+    assert labels_match({"spot": "true"}, {"spot": "true"})
+    assert not labels_match({"spot": "true"}, {"spot": "!true"})
+    assert labels_match({"spot": "false"}, {"spot": "!true"})
+    assert labels_match({}, {"spot": "!true"})          # absent key matches
+    assert labels_match(None, {"spot": "!true"})        # unlabeled node too
+    assert not labels_match(None, {"zone": "a"})        # positive still strict
+    assert labels_match({"zone": "a", "spot": "true"},
+                        {"zone": "a", "spot": "!false"})
+    assert not labels_match({"zone": "b"}, {"zone": "a", "spot": "!true"})
+    assert labels_match({"anything": "x"}, None)
+    assert labels_match(None, {})

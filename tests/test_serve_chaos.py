@@ -1,0 +1,382 @@
+"""Serve chaos-soak: the overload/failure plane exercised adversarially
+under seeded event-loop delay chaos, deterministically replayable.
+
+Four scenarios x three seeds (matching the test_chaos_soak.py
+convention — tier-1 runs every scenario on the first seed, the other
+seeds are slow-marked; full matrix: `pytest tests/test_serve_chaos.py -m ''`):
+
+  1. replica kill mid-request AND mid-stream — failover rides the retry
+     budget, the stream surfaces a prompt typed error (no wedge), and
+     the controller replaces the dead replica under traffic
+  2. stalled replica — a replica wedged in user code keeps timing out;
+     outlier ejection steers traffic to the healthy replica and goodput
+     continues. A replica wedged on its EVENT LOOP (blocking
+     check_health) is killed and replaced by the controller's bounded
+     health probe instead of freezing the reconcile forever; a
+     deployment wedged in __init__ fails its deploy within the bounded
+     construction gate, and the controller keeps serving other
+     deployments.
+  3. overload burst — a burst far above capacity sheds typed, accepted
+     requests all complete, and the replica queue bound provably holds
+     (peak_queued counter)
+  4. controller kill during traffic — handles and proxies keep serving
+     from the last-known replica set (graceful degradation), and a fresh
+     deploy works afterwards
+
+Assertions are on STATE (replica admission counters, handle overload
+stats, deployment status), never on bare sleeps.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.serve import BackpressureError, DeadlineExceededError
+
+SEEDS = [
+    101,
+    pytest.param(202, marks=pytest.mark.slow),
+    pytest.param(303, marks=pytest.mark.slow),
+]
+
+_CHAOS = {
+    # control-plane handlers get 0.5-8ms of injected delay: enough to
+    # shuffle orderings, small enough for tier-1 wall clock
+    "testing_event_loop_delay_us": "*:500:8000",
+    # controller-side probe bounds must be in the PRE-INIT config: the
+    # controller actor's process inherits overrides at spawn, not from
+    # later driver-side apply_system_config calls
+    "serve_replica_init_timeout_s": 2.0,
+    "serve_health_probe_timeout_s": 1.5,
+}
+
+
+# module-scoped and seed-parametrized: all four scenarios share ONE
+# cluster per seed (pytest groups module-scoped params), keeping the
+# tier-1 bill at one init/shutdown — each scenario deletes its own
+# deployments so cross-scenario state is limited to the shared session
+@pytest.fixture(scope="module", params=SEEDS)
+def chaos_init(request):
+    cfg = dict(_CHAOS)
+    cfg["testing_chaos_seed"] = request.param
+    GLOBAL_CONFIG.apply_system_config(cfg)
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    try:
+        serve.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+    ray_tpu.shutdown()
+    GLOBAL_CONFIG.reset()
+
+
+def _delete_quiet(*names):
+    for name in names:
+        try:
+            serve.delete(name)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _await_running(name, n, timeout=45):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if serve.status()[name]["running"] >= n:
+                return True
+        except Exception:  # noqa: BLE001 — controller mid-recreate
+            pass
+        time.sleep(0.25)
+    return False
+
+
+def test_chaos_replica_kill_mid_request_and_mid_stream(chaos_init):
+    @serve.deployment(num_replicas=2, name="Killable")
+    class Killable:
+        def __call__(self, payload=0.0):
+            import os
+
+            if isinstance(payload, dict) and payload.get("stream"):
+                def gen(n):
+                    for i in range(int(n)):
+                        time.sleep(0.15)
+                        yield {"i": i, "pid": os.getpid()}
+
+                return gen(payload["n"])
+            if payload:
+                time.sleep(payload)
+            return os.getpid()
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    handle = serve.run(Killable.bind())
+    pid_of = {
+        r._actor_id.binary(): ray_tpu.get(
+            r.call_method.remote("pid"), timeout=30)
+        for r in handle._replicas
+    }
+
+    # -- mid-request: in-flight slow calls ride out a replica kill ------
+    refs = [handle.remote(0.8) for _ in range(6)]
+    time.sleep(0.2)
+    victim = handle._replicas[0]
+    victim_pid = pid_of[victim._actor_id.binary()]
+    try:
+        victim.call_method.remote("die")
+    except Exception:  # noqa: BLE001
+        pass
+    results, failures = [], []
+    for r in refs:
+        try:
+            results.append(r.result(timeout=60))
+        except Exception as e:  # noqa: BLE001
+            failures.append(e)
+    # every request whose replica survived — or that failed over under
+    # the retry budget — completed; nothing wedged
+    assert len(results) >= 3, (results, failures)
+    assert all(isinstance(p, int) for p in results)
+    assert handle.overload_stats["retries"] >= 1 or not failures
+
+    # the controller replaces the dead replica under traffic
+    assert _await_running("Killable", 2), serve.status()
+
+    # -- mid-stream: a kill surfaces a prompt error, no wedge -----------
+    handle._refresh(force=True)
+    stream = handle.options(stream=True).remote({"stream": True, "n": 20})
+    first = ray_tpu.get(next(iter(stream)), timeout=30)
+    streaming_pid = first["pid"]
+    target = next(r for r in handle._replicas
+                  if ray_tpu.get(r.call_method.remote("pid"), timeout=30)
+                  == streaming_pid)
+    try:
+        target.call_method.remote("die")
+    except Exception:  # noqa: BLE001
+        pass
+    t0 = time.monotonic()
+    with pytest.raises(Exception):
+        for ref in stream:
+            ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 30, "mid-stream kill wedged the consumer"
+    # and the deployment heals + serves again
+    assert _await_running("Killable", 2)
+    handle._refresh(force=True)
+    assert isinstance(handle.remote(0.0).result(timeout=60), int)
+    _delete_quiet("Killable")
+
+
+def test_chaos_stalled_replica_ejected_and_wedged_replica_replaced(chaos_init):
+    # handle-side knobs: these are read in the driver, so a mid-test
+    # apply works (the controller-side probe bounds ride the fixture cfg)
+    GLOBAL_CONFIG.apply_system_config({
+        "serve_outlier_consecutive_failures": 1,
+        "serve_outlier_probation_s": 30.0,
+    })
+
+    # -- user-code stall: deadlines + ejection keep goodput -------------
+    @serve.deployment(num_replicas=2, name="Stalls")
+    class Stalls:
+        def __init__(self):
+            self.stall = False
+
+        def __call__(self, _x=None):
+            import os
+
+            if self.stall:
+                time.sleep(60)
+            return os.getpid()
+
+        def make_slow(self):
+            self.stall = True
+            return True
+
+    handle = serve.run(Stalls.bind())
+    assert ray_tpu.get(
+        handle._replicas[0].call_method.remote("make_slow"), timeout=30)
+    ok, timed_out = 0, 0
+    for i in range(12):
+        try:
+            p = handle.options(timeout_s=0.6).remote(i).result(timeout=30)
+            assert isinstance(p, int)
+            ok += 1
+        except (DeadlineExceededError, ray_tpu.GetTimeoutError):
+            timed_out += 1
+    assert ok >= 8, f"goodput collapsed: ok={ok} timed_out={timed_out}"
+    assert handle.overload_stats["ejections"] >= 1, (
+        "stalled replica never ejected")
+    # post-ejection, requests flow to the healthy replica only
+    post = {handle.remote().result(timeout=30) for _ in range(5)}
+    assert len(post) == 1
+
+    # -- event-loop wedge: the bounded reconcile probe kills+replaces ---
+    @serve.deployment(num_replicas=1, name="Wedged")
+    class Wedged:
+        def __init__(self):
+            self.uptime_marker = time.time()
+
+        def __call__(self, _x=None):
+            return self.uptime_marker
+
+        def wedge(self):
+            self.block = True
+            return True
+
+        def check_health(self):
+            # a blocking health check models a replica whose event loop
+            # is wedged: EVERY actor method stalls behind it
+            if getattr(self, "block", False):
+                time.sleep(3600)
+
+    whandle = serve.run(Wedged.bind())
+    marker0 = whandle.remote().result(timeout=60)
+    ray_tpu.get(whandle._replicas[0].call_method.remote("wedge"), timeout=30)
+    # the probe must time out, kill the wedged replica, and start a fresh
+    # one — visible as a NEW uptime marker serving requests
+    deadline = time.time() + 60
+    marker1 = None
+    while time.time() < deadline:
+        try:
+            whandle._refresh(force=True)
+            marker1 = whandle.options(timeout_s=2.0).remote().result(
+                timeout=10)
+            if marker1 != marker0:
+                break
+        except Exception:  # noqa: BLE001 — mid-replacement
+            time.sleep(0.5)
+    assert marker1 is not None and marker1 != marker0, (
+        "wedged replica never replaced — reconcile is frozen")
+
+    # -- wedged __init__: bounded construction gate (2s via fixture cfg)
+    @serve.deployment(num_replicas=1, name="InitWedge")
+    class InitWedge:
+        def __init__(self):
+            time.sleep(3600)
+
+        def __call__(self, _x=None):
+            return "never"
+
+    t0 = time.monotonic()
+    with pytest.raises(Exception):
+        serve.run(InitWedge.bind(), timeout=60)
+    assert time.monotonic() - t0 < 45, "construction gate not bounded"
+    # the controller survived and serves OTHER deployments (scale lock
+    # was not wedged by the stuck constructor)
+    assert isinstance(handle.remote().result(timeout=60), int)
+    # InitWedge especially: leaving it deployed would have the reconcile
+    # loop re-attempting (and gate-killing) the wedged constructor every
+    # tick for the rest of the shared session
+    _delete_quiet("Stalls", "Wedged", "InitWedge")
+
+
+def test_chaos_overload_burst_bounded_queues(chaos_init):
+    GLOBAL_CONFIG.apply_system_config({
+        "serve_retry_budget_min": 0,
+        "serve_retry_budget_ratio": 0.0,
+    })
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=2,
+                      max_queued_requests=2, name="Burst")
+    class Burst:
+        def __call__(self, _x=None):
+            time.sleep(0.15)
+            return "ok"
+
+    handle = serve.run(Burst.bind())
+    results = []
+    lock = threading.Lock()
+
+    def fire(i):
+        try:
+            out = handle.remote(i).result(timeout=60)
+        except BackpressureError:
+            out = "shed"
+        except Exception as e:  # noqa: BLE001
+            out = f"error:{e}"
+        with lock:
+            results.append(out)
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(40)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert not any(t.is_alive() for t in threads), "burst wedged callers"
+    assert len(results) == 40
+    ok = results.count("ok")
+    shed = results.count("shed")
+    assert ok + shed == 40, f"unexpected outcomes: {results}"
+    assert shed > 0, "a 5x-capacity burst must shed"
+    assert ok >= 8, f"accepted goodput collapsed: {results}"
+    # the queue bound provably held on every replica, and every admitted
+    # request actually ran (total counts admissions; sheds never admit)
+    for i in range(2):
+        st = ray_tpu.get(handle._replicas[i].stats.remote(), timeout=30)
+        assert st["peak_queued"] <= st["max_queued"], st
+        assert st["started"] == st["total"], st
+        assert st["shed"] > 0, st
+    # the system drains: a fresh request succeeds promptly
+    assert time.monotonic() - t0 < 60
+    time.sleep(2.1)  # saturation cache ages out
+    assert handle.remote().result(timeout=30) == "ok"
+    _delete_quiet("Burst")
+
+
+def test_chaos_controller_kill_during_traffic(chaos_init):
+    @serve.deployment(num_replicas=2, name="SurviveCtl")
+    class Steady:
+        def __call__(self, _x=None):
+            return "up"
+
+    handle = serve.run(Steady.bind())
+    stop = threading.Event()
+    outcomes = {"ok": 0, "fail": 0}
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                assert handle.remote().result(timeout=30) == "up"
+                outcomes["ok"] += 1
+            except Exception:  # noqa: BLE001
+                outcomes["fail"] += 1
+            time.sleep(0.02)
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    try:
+        time.sleep(0.5)
+        controller = ray_tpu.get_actor("serve-controller",
+                                       namespace="_serve")
+        ray_tpu.kill(controller)
+        # force refreshes through the outage window: the handle must
+        # degrade to its last-known replica set, not fail
+        import math
+
+        for _ in range(6):
+            handle._last_refresh = -math.inf
+            time.sleep(0.5)
+    finally:
+        stop.set()
+        t.join(timeout=60)
+    assert outcomes["ok"] >= 20, outcomes
+    assert outcomes["fail"] == 0, (
+        f"requests failed during the controller outage: {outcomes}")
+    assert handle.overload_stats["stale_serves"] >= 1
+    # a fresh controller comes up on demand and serves NEW deployments
+    @serve.deployment(num_replicas=1, name="PostOutage")
+    def hello(_x=None):
+        return "hi"
+
+    h2 = serve.run(hello.bind())
+    assert h2.remote().result(timeout=60) == "hi"

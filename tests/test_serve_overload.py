@@ -1,0 +1,482 @@
+"""Overload-plane tests: end-to-end deadlines, bounded queues + load
+shedding, retry budgets, outlier ejection, and graceful degradation
+through a controller outage (reference: serve max_queued_requests
+admission + deadline-aware routing; envoy retry budgets / outlier
+detection; DAGOR / The Tail at Scale).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.serve import BackpressureError, DeadlineExceededError
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_deployments(ray_init):
+    yield
+    try:
+        for name in list(serve.status()):
+            serve.delete(name)
+    except Exception:
+        pass
+
+
+def _no_retries():
+    """Disable handle failover so admission errors surface raw."""
+    GLOBAL_CONFIG.apply_system_config({
+        "serve_retry_budget_min": 0,
+        "serve_retry_budget_ratio": 0.0,
+    })
+
+
+def _stats(handle, i=0):
+    return ray_tpu.get(handle._replicas[i].stats.remote(), timeout=30)
+
+
+def test_bounded_queue_sheds_with_typed_error(ray_init):
+    """max_queued_requests bounds the replica queue; excess requests get
+    a typed BackpressureError carrying retry_after_s, and the queue
+    high-water provably never exceeds the bound."""
+    _no_retries()
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=1,
+                      max_queued_requests=1)
+    class Slow:
+        def __call__(self, x=None):
+            time.sleep(0.5)
+            return "ok"
+
+    handle = serve.run(Slow.bind())
+    refs = [handle.remote(i) for i in range(6)]
+    outcomes = []
+    for r in refs:
+        try:
+            outcomes.append(r.result(timeout=30))
+        except BackpressureError as e:
+            assert e.retry_after_s > 0
+            outcomes.append("shed")
+    # 1 running + 1 queued admitted; the rest shed
+    assert outcomes.count("ok") >= 2
+    assert outcomes.count("shed") >= 3
+    st = _stats(handle)
+    assert st["shed"] >= 3
+    assert st["max_queued"] == 1
+    assert st["peak_queued"] <= 1, st
+    # accepted + shed + deadline partitions admissions
+    assert st["started"] == outcomes.count("ok")
+
+
+def test_ingress_shed_before_replica_rpc(ray_init):
+    """Once a queue rejection pins the probed-load cache at capacity, the
+    handle sheds at ingress — no replica RPC, counted handle-side."""
+    _no_retries()
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=1,
+                      max_queued_requests=0)
+    class Busy:
+        def __call__(self, x=None):
+            time.sleep(0.8)
+            return "ok"
+
+    handle = serve.run(Busy.bind())
+    first = handle.remote(0)  # occupies the only slot
+    time.sleep(0.1)
+    shed_replica = shed_ingress = 0
+    for i in range(4):
+        try:
+            handle.remote(i).result(timeout=10)
+        except BackpressureError:
+            if handle.overload_stats["shed_ingress"] > shed_ingress:
+                shed_ingress = handle.overload_stats["shed_ingress"]
+            else:
+                shed_replica += 1
+    assert shed_replica >= 1, "first rejection must come from the replica"
+    assert shed_ingress >= 1, (
+        "later rejections must shed at ingress off the pinned load cache")
+    assert first.result(timeout=30) == "ok"
+
+
+def test_deadline_never_reaches_callable(ray_init):
+    """A request whose deadline is spent is failed by the replica's
+    admission gate — the user callable provably never runs."""
+    _no_retries()
+
+    @serve.deployment(num_replicas=1)
+    class Counting:
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, x=None):
+            self.calls += 1
+            return self.calls
+
+        def count(self):
+            return self.calls
+
+    handle = serve.run(Counting.bind())
+    # expired on ARRIVAL at the replica (bypasses the handle's local
+    # fast-fail by stamping the wire kwarg directly)
+    from ray_tpu.serve._context import DEADLINE_KWARG
+    from ray_tpu._private.errors import TaskError
+
+    ref = handle._replicas[0].handle_request.remote(
+        "x", **{DEADLINE_KWARG: time.time() - 1.0})
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(ref, timeout=30)
+    assert isinstance(ei.value.__cause__, DeadlineExceededError)
+    # expired BEFORE send: the handle fails it without any RPC (a tiny
+    # positive budget is spent by the time routing checks it)
+    with pytest.raises(DeadlineExceededError):
+        handle.options(timeout_s=1e-9).remote("y")
+    assert handle.overload_stats["expired_before_send"] >= 1
+    st = _stats(handle)
+    assert st["deadline_rejected"] >= 1
+    assert ray_tpu.get(
+        handle._replicas[0].call_method.remote("count"), timeout=30) == 0
+    # the callable-started counter never moved for either request
+    assert st["started"] == 0
+    # explicit timeout_s=0 means NO deadline (matches the config flag's
+    # "0 = no deadline" contract), not instant expiry
+    assert handle.options(timeout_s=0).remote("z").result(timeout=30) == 1
+
+
+def test_deadline_expires_in_queue(ray_init):
+    """A queued request whose deadline passes while waiting for a
+    concurrency slot dies in the queue, not in user code."""
+    _no_retries()
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=1,
+                      max_queued_requests=8)
+    class Counting:
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, delay):
+            self.calls += 1
+            time.sleep(delay)
+            return self.calls
+
+    handle = serve.run(Counting.bind())
+    long = handle.remote(0.8)
+    time.sleep(0.1)
+    with pytest.raises(DeadlineExceededError):
+        handle.options(timeout_s=0.3).remote(0.0).result(timeout=30)
+    assert long.result(timeout=30) == 1
+    st = _stats(handle)
+    assert st["deadline_rejected"] >= 1
+    assert st["started"] == 1  # only the long request ran
+
+
+def test_deadline_visible_in_request_context(ray_init):
+    """The deadline propagates handle -> replica request context:
+    serve.get_request_deadline()/remaining_s() see it inside user code."""
+
+    @serve.deployment(num_replicas=1)
+    def probe(_x=None):
+        from ray_tpu import serve as s
+
+        return {"deadline": s.get_request_deadline(),
+                "remaining": s.remaining_s()}
+
+    handle = serve.run(probe.bind())
+    t0 = time.time()
+    out = handle.options(timeout_s=5.0).remote().result(timeout=30)
+    assert abs(out["deadline"] - (t0 + 5.0)) < 1.0
+    assert 0 < out["remaining"] <= 5.0
+    # no deadline -> context reads empty
+    out2 = handle.remote().result(timeout=30)
+    assert out2 == {"deadline": 0.0, "remaining": None}
+
+
+def test_deadline_mid_stream(ray_init):
+    """A stream whose consumer budget runs out stops mid-generation with
+    a typed error — the replica checks between chunks."""
+    _no_retries()
+
+    @serve.deployment(num_replicas=1)
+    class Gen:
+        def __call__(self, _payload=None):
+            for i in range(10):
+                time.sleep(0.25)
+                yield {"i": i}
+
+    handle = serve.run(Gen.bind())
+    stream = handle.options(stream=True, timeout_s=0.6).remote()
+    got = []
+    from ray_tpu._private.errors import TaskError
+
+    try:
+        for ref in stream:
+            got.append(ray_tpu.get(ref, timeout=10))
+        raise AssertionError("stream ran past its deadline")
+    except DeadlineExceededError:
+        pass
+    except TaskError as e:
+        # the replica's mid-stream error can surface on an item ref
+        assert isinstance(e.__cause__, DeadlineExceededError), e
+    assert 1 <= len(got) < 10
+    st = _stats(handle)
+    assert st["deadline_mid_stream"] >= 1 or st["deadline_rejected"] >= 1
+
+
+def test_retry_budget_retries_queue_rejections_then_exhausts(ray_init):
+    """Queue rejections fail over under the token-bucket budget; once the
+    budget is spent the BackpressureError surfaces un-retried."""
+    GLOBAL_CONFIG.apply_system_config({
+        "serve_retry_budget_min": 2,
+        "serve_retry_budget_ratio": 0.0,  # no deposits: only the floor
+        "serve_shed_at_ingress": False,   # force replica-side rejections
+    })
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=1,
+                      max_queued_requests=0)
+    class Slow:
+        def __call__(self, x=None):
+            time.sleep(0.6)
+            return "ok"
+
+    handle = serve.run(Slow.bind())
+    first = handle.remote(0)
+    time.sleep(0.1)
+    with pytest.raises(BackpressureError):
+        handle.remote(1).result(timeout=30)
+    stats = handle.overload_stats
+    assert stats["retries"] >= 1, "budget floor must fund retries"
+    assert stats["retries_denied"] >= 1, "exhausted budget must deny"
+    assert first.result(timeout=30) == "ok"
+
+
+def test_outlier_ejection_and_probation(ray_init):
+    """Consecutive failures eject a replica from routing; after the
+    probation window it re-enters (first request = re-probe)."""
+    GLOBAL_CONFIG.apply_system_config({
+        "serve_outlier_consecutive_failures": 3,
+        "serve_outlier_probation_s": 0.8,
+    })
+
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __call__(self, _x=None):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind())
+    pids = {handle.remote().result(timeout=30) for _ in range(20)}
+    assert len(pids) == 2
+    bad_rid = handle._replicas[0]._actor_id.binary()
+    bad_pid = ray_tpu.get(
+        handle._replicas[0].call_method.remote("__call__"), timeout=30)
+    for _ in range(3):
+        handle._record_failure(bad_rid)
+    assert handle.overload_stats["ejections"] == 1
+    picks = [handle.remote().result(timeout=30) for _ in range(10)]
+    assert bad_pid not in picks, "ejected replica still routed"
+    # probation: after the window the replica serves again
+    time.sleep(1.0)
+    deadline = time.time() + 10
+    seen = set()
+    while time.time() < deadline and bad_pid not in seen:
+        seen.add(handle.remote().result(timeout=30))
+    assert bad_pid in seen, "probation re-probe never reached the replica"
+
+
+def test_degradation_serves_through_controller_outage(ray_init):
+    """A controller kill (and the amnesiac auto-recreated controller that
+    follows) must not wipe a handle's live routing table."""
+
+    @serve.deployment(num_replicas=1)
+    def steady(x=None):
+        return "up"
+
+    handle = serve.run(steady.bind())
+    assert handle.remote().result(timeout=30) == "up"
+    controller = ray_tpu.get_actor("serve-controller", namespace="_serve")
+    ray_tpu.kill(controller)
+    time.sleep(0.2)
+    import math
+
+    # dead-controller refresh: degrade, keep serving
+    handle._last_refresh = -math.inf
+    assert handle.remote().result(timeout=30) == "up"
+    assert handle.overload_stats["stale_serves"] >= 1
+    # amnesiac-controller refresh (fresh controller, no deployments):
+    # known=False must NOT be treated as deletion
+    handle._controller = None
+    handle._last_refresh = -math.inf
+    assert handle.remote().result(timeout=30) == "up"
+    assert len(handle._replicas) == 1
+
+
+def test_batch_deadline_admission():
+    """@serve.batch fails queued items whose deadline expired before the
+    flush instead of spending batch slots on them (no cluster needed)."""
+    import asyncio
+
+    from ray_tpu.serve import _context
+
+    calls = []
+
+    @serve.batch(max_batch_size=10, batch_wait_timeout_s=0.05)
+    async def handler(items):
+        calls.append(list(items))
+        return [x * 2 for x in items]
+
+    async def drive():
+        tok = _context._set_deadline(time.time() - 1.0)  # already dead
+        dead = asyncio.ensure_future(handler(1))
+        _context._deadline_var.reset(tok)
+        live = asyncio.ensure_future(handler(2))
+        return await asyncio.gather(dead, live, return_exceptions=True)
+
+    dead_res, live_res = asyncio.run(drive())
+    assert isinstance(dead_res, DeadlineExceededError)
+    assert live_res == 4
+    assert calls == [[2]], "expired item must not ride into the batch"
+
+
+def test_http_maps_backpressure_and_deadline(ray_init):
+    """HTTP ingress: shed -> 503 + Retry-After; spent deadline -> 504."""
+    import httpx
+
+    _no_retries()
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=1,
+                      max_queued_requests=0, name="OverHTTP")
+    class Slow:
+        def __call__(self, payload=None):
+            time.sleep(0.8)
+            return "done"
+
+    serve.run(Slow.bind())
+    base = serve.start(http_port=18479)
+    deadline = time.time() + 30
+    while True:
+        try:
+            httpx.get(f"{base}/-/healthz", timeout=10)
+            break
+        except httpx.TransportError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.3)
+
+    import threading
+
+    codes = {}
+
+    def long_call():
+        codes["long"] = httpx.post(f"{base}/OverHTTP", json=1,
+                                   timeout=30).status_code
+
+    t = threading.Thread(target=long_call)
+    t.start()
+    time.sleep(0.25)
+    r = httpx.post(f"{base}/OverHTTP", json=2, timeout=30)
+    assert r.status_code == 503, r.text
+    assert "Retry-After" in r.headers
+    assert r.json()["type"] == "backpressure"
+    t.join()
+    assert codes["long"] == 200
+    # deadline: X-Serve-Timeout-S expires while the callable runs -> 504.
+    # A separate deployment so the 503 leg's pinned saturation reading
+    # (fresh-at-capacity for ~2s) can't shed this request at ingress.
+    @serve.deployment(num_replicas=1, name="OverHTTP2")
+    class Slow2:
+        def __call__(self, payload=None):
+            time.sleep(0.8)
+            return "done"
+
+    serve.run(Slow2.bind())
+    r2 = httpx.post(f"{base}/OverHTTP2", json=3, timeout=30,
+                    headers={"X-Serve-Timeout-S": "0.2"})
+    assert r2.status_code == 504, r2.text
+    assert r2.json()["type"] == "deadline_exceeded"
+    hz = httpx.get(f"{base}/-/healthz", timeout=10).json()
+    assert hz["shed"] >= 1 and hz["deadline_exceeded"] >= 1
+
+
+def test_serve_overload_knobs_promoted_to_config():
+    """Every overload-plane knob is a first-class config flag with a help
+    string (tunable via env RAY_TPU_* / ray_tpu.init(system_config=))."""
+    flags = GLOBAL_CONFIG.all_flags()
+    for name in (
+        "serve_max_queued_requests",
+        "serve_default_timeout_s",
+        "serve_retry_after_s",
+        "serve_retry_budget_ratio",
+        "serve_retry_budget_min",
+        "serve_outlier_consecutive_failures",
+        "serve_outlier_probation_s",
+        "serve_shed_at_ingress",
+        "serve_refresh_timeout_s",
+        "serve_health_probe_timeout_s",
+        "serve_replica_init_timeout_s",
+    ):
+        assert name in flags, name
+        assert flags[name].doc, f"{name} missing help string"
+
+
+def test_default_timeout_config_applies(ray_init):
+    """serve_default_timeout_s supplies a deadline when the caller sets
+    none — and an explicit timeout_s always wins."""
+    GLOBAL_CONFIG.apply_system_config({"serve_default_timeout_s": 5.0})
+
+    @serve.deployment(num_replicas=1, name="DefaultTimeout")
+    def probe(_x=None):
+        from ray_tpu import serve as s
+
+        return s.get_request_deadline()
+
+    handle = serve.run(probe.bind())
+    t0 = time.time()
+    d = handle.remote().result(timeout=30)
+    assert abs(d - (t0 + 5.0)) < 1.5
+    d2 = handle.options(timeout_s=60.0).remote().result(timeout=30)
+    assert d2 > time.time() + 30
+
+
+def test_sticky_multiplexed_requests_shed_at_ingress(ray_init):
+    """Multiplexed (sticky-affinity) traffic rides the same ingress-shed
+    machinery as pow-2 traffic: a saturated sticky replica sheds the
+    request without a replica RPC instead of silently bypassing admission
+    (sticky requests can only go to their replica, so its saturation
+    alone justifies the shed)."""
+    _no_retries()
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=1,
+                      max_queued_requests=0, name="StickyShed")
+    class M:
+        def __call__(self, x=None):
+            return "ok"
+
+    handle = serve.run(M.bind())
+    sticky = handle.options(multiplexed_model_id="m1")
+    assert sticky.remote(1).result(timeout=30) == "ok"
+    rid = handle._model_affinity["m1"]
+    # pin the sticky replica saturated on both ingress-shed signals
+    with handle._lock:
+        handle._inflight[rid] = handle._capacity
+        handle._qlen_cache[rid] = (
+            handle._capacity, handle._sent.get(rid, 0), time.monotonic())
+    with pytest.raises(BackpressureError):
+        sticky.remote(2)
+    assert handle.overload_stats["shed_ingress"] >= 1
+    # releasing the pin lets sticky traffic through again
+    with handle._lock:
+        handle._inflight[rid] = 0
+    assert sticky.remote(3).result(timeout=30) == "ok"
