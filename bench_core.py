@@ -267,6 +267,54 @@ def bench_collective_allreduce_standalone(quick: bool):
             "unit": "MB/s", "mode": "standalone", "error": "no output"}
 
 
+def bench_hop_breakdown(ray_tpu, n):
+    """Per-hop decomposition of the SYNC task path (requires tracing on):
+    run n sequential round trips, let telemetry flush, then read the
+    cluster-merged rt_task_hop_seconds series back and name the dominant
+    hop — the ROADMAP item-2 'latency-bound on thread hops + RPC RTT'
+    thesis, confirmed or refuted by data instead of guesses."""
+    from ray_tpu._private import hops
+    from ray_tpu._private.core_worker import get_core_worker
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote(), timeout=60)
+
+    def run():
+        for _ in range(n):
+            ray_tpu.get(nop.remote(), timeout=60)
+
+    dt = timed(run)
+    time.sleep(2.5)  # two telemetry flush periods: worker-side hops land
+    cw = get_core_worker()
+    reply = cw.run_sync(cw.control.call("get_metrics", {}), 30)
+    series = []
+    for w in reply["workers"].values():
+        series += [s for s in w.get("metrics", [])
+                   if s.get("name") == "rt_task_hop_seconds"]
+    bd = hops.breakdown(series)
+    return {"bench": "task_hop_breakdown", "value": round(n / dt, 1),
+            "unit": "tasks/s", "hops": bd,
+            "dominant_hop": hops.dominant_hop(bd)}
+
+
+def run_obs_suite(ray_tpu, scale: int, results: list, obs_on: bool):
+    """The observability A/B's benches: sync round-trip rate and the
+    100k-queue submit/drain rates — the paths the per-hop stamps touch.
+    (The flight recorder and delta telemetry have no off switch: they are
+    the always-on baseline in BOTH columns; `obs on` adds tracing + hop
+    folding + span records on top.)"""
+    results.append(bench_tasks_sync(ray_tpu, 100 * scale))
+    if obs_on:
+        # BEFORE the queue-depth bench: the histograms are cumulative, and
+        # the sync-path decomposition must not absorb a 100k-burst's queue
+        # waits
+        results.append(bench_hop_breakdown(ray_tpu, 100 * scale))
+    results.append(bench_queued_task_depth(ray_tpu, 20000 * scale))
+
+
 def run_suite(ray_tpu, scale: int, results: list, quick: bool = False):
     results.append(bench_tasks_sync(ray_tpu, 100 * scale))
     results.append(bench_tasks_async(ray_tpu, 200 * scale))
@@ -300,6 +348,15 @@ def main():
         "and emits one JSON line per bench per mode, tagged with a "
         "'fastpath' column.")
     parser.add_argument(
+        "--obs", choices=["on", "off", "both"], default=None,
+        help="A/B the observability plane: 'on' enables tracing + per-hop "
+        "latency folding (rt_task_hop_seconds) via the tracing_enabled "
+        "flag (workers inherit it); 'off' pins it off. 'both' runs the "
+        "submit-path benches once per mode in FRESH subprocesses and the "
+        "'on' run additionally emits the per-hop breakdown naming the "
+        "dominant hop. The flight recorder and delta telemetry are "
+        "always-on in both columns.")
+    parser.add_argument(
         "--core-only", action="store_true",
         help="only the task/actor throughput + queue-depth benches "
         "(the probes the fast path targets)")
@@ -310,14 +367,16 @@ def main():
         "number)")
     args = parser.parse_args()
 
-    if args.fastpath == "both":
+    if args.fastpath == "both" or args.obs == "both":
         import os
         import subprocess
         import sys
 
+        flag = "--fastpath" if args.fastpath == "both" else "--obs"
         for mode in ("off", "on"):
-            cmd = [sys.executable, os.path.abspath(__file__),
-                   "--fastpath", mode, "--core-only"]
+            cmd = [sys.executable, os.path.abspath(__file__), flag, mode]
+            if flag == "--fastpath":
+                cmd.append("--core-only")
             if args.quick:
                 cmd.append("--quick")
             proc = subprocess.run(cmd, text=True, capture_output=True)
@@ -334,6 +393,8 @@ def main():
     system_config = {}
     if args.fastpath is not None:
         system_config["native_fastpath"] = args.fastpath == "on"
+    if args.obs is not None:
+        system_config["tracing_enabled"] = args.obs == "on"
     ray_tpu.init(num_cpus=4, system_config=system_config)
     if args.fastpath is not None:
         from ray_tpu._private import fastpath as _fp
@@ -346,6 +407,8 @@ def main():
         if args.allreduce_only:
             results.append(
                 bench_collective_allreduce(ray_tpu, 8 * scale, reps=6))
+        elif args.obs is not None:
+            run_obs_suite(ray_tpu, scale, results, obs_on=args.obs == "on")
         elif args.core_only:
             results.append(bench_tasks_sync(ray_tpu, 100 * scale))
             results.append(bench_tasks_async(ray_tpu, 200 * scale))
@@ -354,10 +417,11 @@ def main():
         else:
             run_suite(ray_tpu, scale, results, quick=args.quick)
     finally:
-        tag = args.fastpath
         for r in results:
-            if tag is not None:
-                r["fastpath"] = tag
+            if args.fastpath is not None:
+                r["fastpath"] = args.fastpath
+            if args.obs is not None:
+                r["obs"] = args.obs
             print(json.dumps(r))
         ray_tpu.shutdown()
 

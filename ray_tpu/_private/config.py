@@ -147,6 +147,12 @@ _flag("cgroup_worker_memory_max_bytes", 0, "memory.max hard cap for the workers 
 _flag("cgroup_worker_cpu_weight", 0, "cpu.weight for the workers cgroup (0 = unset).")
 _flag("task_event_buffer_max", 10000, "Profile/task events buffered per worker before drop.")
 _flag("telemetry_flush_period_s", 1.0, "Task-event + metrics flush cadence to the control store.")
+
+# --- observability plane (tracing, per-hop decomposition, flight recorder,
+# metrics aggregation) ---
+_flag("tracing_enabled", False, "Distributed tracing + per-hop latency decomposition: spans propagate through task specs, execution spans are recorded into the task-event plane, and every hop of the task path (submit encode, ring wait, frame build, wire RTT, lease grant, worker dequeue, user fn, completion delivery) folds into rt_task_hop_seconds{hop=...}. The legacy RT_TRACING_ENABLED env var is kept as an override; enable_tracing() sets both.")
+_flag("flight_recorder_ring_size", 2048, "Per-process flight-recorder ring capacity (coarse control-plane events: state transitions, RPC edges, lease grants, recovery/drain/resize decisions). Dump on demand via ray_tpu.util.state.dump_flight_recorder(); the chaos harness auto-dumps failing scenarios and crash paths dump to the log dir.")
+_flag("metrics_node_series_max", 4096, "Cardinality cap on the per-node metric pre-aggregation: distinct series (name+tags) beyond this are dropped at the node daemon (counted in rt_metrics_series_dropped_total) instead of flooding the control store.")
 _flag("control_store_port", 0, "Port for the control store (0 = auto).")
 _flag("scheduler_spread_threshold", 0.5, "Hybrid policy: pack below this utilization, then spread (reference: hybrid_scheduling_policy.h:50).")
 _flag("log_to_driver", True, "Forward worker stdout/stderr to the driver.")

@@ -28,6 +28,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import flight_recorder
 from ray_tpu._private import protocol as pb
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.errors import RpcError
@@ -219,9 +220,14 @@ class ControlStore:
         self.actors: Dict[bytes, ActorRecord] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (namespace, name) -> actor_id
         self.placement_groups: Dict[bytes, PlacementGroupRecord] = {}
-        # observability: bounded task-event history + per-worker metric
-        # snapshots (reference: GcsTaskManager, metrics agent)
+        # observability: bounded task-event history + per-reporter metric
+        # accumulation (reference: GcsTaskManager, metrics agent). Reporters
+        # are node daemons (pre-aggregated per node) or direct workers
+        # (fallback); delta payloads accumulate into `acc`, legacy full
+        # snapshots replace it. Drop accounting: trims here + drops the
+        # reporters confessed to ride `task_events_dropped`.
         self.task_events: "collections.deque[dict]" = collections.deque()
+        self.task_events_dropped = 0
         self.metrics_by_worker: Dict[bytes, dict] = {}
         # worker-process liveness records (reference: the GCS workers table
         # + worker-failure pubsub): live worker/driver RPC addresses with
@@ -430,6 +436,8 @@ class ControlStore:
         info = self.nodes.get(node_id)
         if info is None or info.state == pb.NODE_DEAD:
             return
+        flight_recorder.record("node", "dead", node=info.node_id.hex()[:12],
+                               reason=reason, expected=expected)
         info.state = pb.NODE_DEAD
         # planned vs unexpected termination recorded in the node table
         # (reference: NodeDeathInfo) — owners choose replica failover vs
@@ -494,6 +502,9 @@ class ControlStore:
 
     async def rpc_register_node(self, conn_id: int, payload: dict) -> dict:
         info = NodeInfo.from_wire(payload["node"])
+        flight_recorder.record("node", "register",
+                               node=info.node_id.hex()[:12],
+                               address=info.address)
         self.nodes[info.node_id.binary()] = info
         self.node_available[info.node_id.binary()] = info.resources
         self.node_last_beat[info.node_id.binary()] = time.monotonic()
@@ -647,6 +658,8 @@ class ControlStore:
             return {"ok": False}
         reason = payload.get("reason") or pb.DRAIN_REASON_MANUAL
         deadline_s = float(payload.get("deadline_s") or 0.0)
+        flight_recorder.record("node", "drain", node=info.node_id.hex()[:12],
+                               reason=reason, deadline_s=deadline_s)
         info.state = pb.NODE_DRAINING
         info.drain_reason = reason
         info.drain_deadline = time.time() + deadline_s if deadline_s else 0.0
@@ -753,6 +766,8 @@ class ControlStore:
 
     def _mark_worker_dead(self, address: str, reason: str = "",
                           exit_code: Optional[int] = None):
+        flight_recorder.record("worker", "dead", address=address,
+                               reason=reason, exit_code=exit_code)
         self.dead_worker_addresses[address] = {
             "ts": time.time(), "reason": reason, "exit_code": exit_code,
         }
@@ -1198,6 +1213,10 @@ class ControlStore:
                                      planned: bool = False):
         if rec.state == pb.ACTOR_DEAD:
             return
+        actor_hex = rec.spec.actor_id.hex() if rec.spec.actor_id else ""
+        flight_recorder.record(
+            "actor", "worker_death", actor=actor_hex[:12],
+            reason=reason, planned=planned, restarts=rec.num_restarts)
         max_restarts = rec.spec.max_restarts
         # planned removals (drain/preemption) never charge the user's
         # restart budget: only unplanned crashes count against max_restarts.
@@ -1477,10 +1496,15 @@ class ControlStore:
 
     async def rpc_report_task_events(self, conn_id: int, payload: dict) -> dict:
         cap = GLOBAL_CONFIG.get("task_event_buffer_max")
+        self.task_events_dropped += int(payload.get("dropped", 0) or 0)
         for ev in payload.get("events", []):
             self.task_events.append(ev)
-        while len(self.task_events) > cap:
-            self.task_events.popleft()
+        if len(self.task_events) > cap:
+            # store-side trims are loss too: the history the timeline reads
+            # must confess its own gaps
+            self.task_events_dropped += len(self.task_events) - cap
+            while len(self.task_events) > cap:
+                self.task_events.popleft()
         return {"ok": True}
 
     async def rpc_list_task_events(self, conn_id: int, payload) -> dict:
@@ -1488,24 +1512,60 @@ class ControlStore:
         events = list(self.task_events)
         if limit:
             events = events[-limit:]
-        return {"events": events}
+        return {"events": events, "dropped": self.task_events_dropped}
 
     async def rpc_report_metrics(self, conn_id: int, payload: dict) -> dict:
-        # latest snapshot per reporting worker; aggregation happens at read
-        self.metrics_by_worker[payload["worker_id"]] = {
-            "ts": time.time(),
-            "metrics": payload.get("metrics", []),
-        }
-        # prune workers that stopped reporting (died/reaped) — without this
-        # the table grows per worker ever seen and exports stale gauges
+        """Metric ingestion: delta payloads ACCUMULATE per reporter
+        (counters/histogram buckets add, gauges replace — histograms merge
+        exactly across flushes and across processes), legacy full snapshots
+        replace the reporter's series wholesale."""
+        from ray_tpu.util.metrics import merge_series
+
+        wid = payload["worker_id"]
+        series = payload.get("metrics", [])
+        if payload.get("delta"):
+            rec = self.metrics_by_worker.get(wid)
+            if rec is None or "acc" not in rec:
+                rec = self.metrics_by_worker[wid] = {"ts": time.time(),
+                                                     "acc": {}}
+            seq = payload.get("seq")
+            if seq is not None:
+                # reporters retry a frozen batch verbatim until acked:
+                # dedup by sequence so an applied-but-unacked flush never
+                # double-counts (the exactly-once half of delta shipping)
+                if rec.get("last_seq") is not None \
+                        and seq <= rec["last_seq"]:
+                    rec["ts"] = time.time()
+                    return {"ok": True, "dup": True}
+                rec["last_seq"] = seq
+            rec["ts"] = time.time()
+            # merge only; the flat series list is materialized lazily at
+            # scrape time (get_metrics) — per-report rebuilds would be
+            # O(series) on the ingestion path at every flush from every node
+            merge_series(rec["acc"], series, True)
+        else:
+            self.metrics_by_worker[wid] = {
+                "ts": time.time(),
+                "metrics": series,
+            }
+        # prune reporters that stopped (died/reaped) — without this the
+        # table grows per reporter ever seen and exports stale gauges
         stale = time.time() - 60.0
-        for wid in [w for w, s in self.metrics_by_worker.items()
-                    if s["ts"] < stale]:
-            del self.metrics_by_worker[wid]
+        for w in [w for w, s in self.metrics_by_worker.items()
+                  if s["ts"] < stale]:
+            del self.metrics_by_worker[w]
         return {"ok": True}
 
     async def rpc_get_metrics(self, conn_id: int, payload) -> dict:
-        return {"workers": self.metrics_by_worker}
+        return {"workers": {
+            w: {"ts": s["ts"],
+                "metrics": (list(s["acc"].values()) if "acc" in s
+                            else s.get("metrics", []))}
+            for w, s in self.metrics_by_worker.items()
+        }}
+
+    async def rpc_dump_flight_recorder(self, conn_id: int, payload) -> dict:
+        return flight_recorder.dump()
 
     async def rpc_remove_placement_group(self, conn_id: int, payload: dict) -> dict:
         rec = self.placement_groups.get(payload["pg_id"])

@@ -32,6 +32,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from ray_tpu._private import fastpath as _fp
+from ray_tpu._private import flight_recorder
+from ray_tpu._private import hops
 from ray_tpu._private import protocol as pb
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -61,6 +63,19 @@ def _trace_inject():
     from ray_tpu.util.tracing import inject_context
 
     return inject_context()
+
+
+_DERIVE_CTX_CACHE = None
+
+
+def _tracing_DERIVE_CTX():
+    # cached: this sits on the traced fast-lane eligibility check
+    global _DERIVE_CTX_CACHE
+    if _DERIVE_CTX_CACHE is None:
+        from ray_tpu.util.tracing import DERIVE_CTX
+
+        _DERIVE_CTX_CACHE = DERIVE_CTX
+    return _DERIVE_CTX_CACHE
 
 MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
@@ -649,6 +664,9 @@ class CoreWorker:
     async def rpc_ping(self, conn_id: int, payload: dict) -> dict:
         return {"ok": True}
 
+    async def rpc_dump_flight_recorder(self, conn_id: int, payload) -> dict:
+        return flight_recorder.dump()
+
     async def rpc_chaos_set(self, conn_id: int, payload: dict) -> dict:
         """Chaos scenario hook (testing only): apply chaos/testing config
         flags to this worker/driver process at runtime."""
@@ -725,6 +743,11 @@ class CoreWorker:
         self._fan_out_node_notice(message)
         state = message.get("state")
         daemon_addr = message.get("address", "")
+        if state in (pb.NODE_DRAINING, pb.NODE_DEAD):
+            flight_recorder.record(
+                "node", state,
+                node=(message.get("node_id") or b"").hex()[:12],
+                expected=(message.get("death") or {}).get("expected"))
         if state == pb.NODE_DRAINING:
             if daemon_addr:
                 # cached leases on the draining node would be refused (or
@@ -777,6 +800,8 @@ class CoreWorker:
         addr = message.get("address", "")
         if not addr:
             return
+        flight_recorder.record("worker", "death_notice", address=addr,
+                               reason=message.get("reason") or "")
         dropped = self.ref_counter.drop_borrower_process(addr)
         if dropped:
             logger.info(
@@ -861,33 +886,66 @@ class CoreWorker:
                     spawn(dead.close())
 
     async def _telemetry_loop(self):
-        """Flush buffered task events + metric snapshots to the control
-        store (reference: task_event_buffer.h periodic GCS flush; metrics
-        agent push)."""
+        """Flush buffered task events (with their drop accounting) to the
+        control store, and ship metric DELTAS node-locally: the daemon
+        pre-aggregates every worker's series into one per-node set (with a
+        cardinality cap) before the control store sees them — at 1000 nodes
+        the store accumulates per-node aggregates, not per-worker snapshots
+        (reference: task_event_buffer.h periodic GCS flush; the per-node
+        metrics agent)."""
         from ray_tpu.util import metrics as metrics_mod
 
         period = GLOBAL_CONFIG.get("telemetry_flush_period_s")
+        # Exactly-once delta shipping: a taken delta batch is FROZEN with a
+        # sequence number and re-sent verbatim until acked — receivers
+        # dedup by (reporter, seq), so an applied-but-unacked flush (reply
+        # lost to a timeout OR a dropped connection) cannot double-count.
+        # The destination is fixed for the process (the daemon when one
+        # exists, else the store): falling back across destinations on a
+        # connection error would escape the per-reporter dedup domain and
+        # double-count exactly the batches the machinery exists to protect.
+        # An idle interval still sends an EMPTY keepalive report — the
+        # store's stale-reporter prune must never collect a live
+        # reporter's accumulated totals.
+        pending: Optional[list] = None  # [seq, series]
+        seq = 0
         while not self._closed:
             await asyncio.sleep(period)
-            events = self.task_events.drain()
+            events, dropped = self.task_events.drain()
             try:
-                if events:
+                if events or dropped:
                     await self.control.call(
-                        "report_task_events", {"events": events}, timeout=10)
-                    events = []
-                snap = metrics_mod.snapshot_all()
-                if snap:
-                    await self.control.call(
-                        "report_metrics",
-                        {"worker_id": self.worker_id.binary(), "metrics": snap},
-                        timeout=10,
-                    )
+                        "report_task_events",
+                        {"events": events, "dropped": dropped}, timeout=10)
+                    events, dropped = [], 0
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — telemetry must never kill the worker
-                if events:
-                    # control store blip: keep the batch for the next flush
-                    self.task_events.requeue(events)
+                # control store blip: keep the batch for the next flush
+                self.task_events.requeue(events, dropped)
+            if pending is None:
+                snap = metrics_mod.take_delta()
+                if snap:
+                    seq += 1
+                    pending = [seq, snap]
+            payload = {"worker_id": self.worker_id.binary(),
+                       "delta": True,
+                       "metrics": pending[1] if pending else [],
+                       **({"seq": pending[0]} if pending else {})}
+            daemon = getattr(self, "daemon", None)
+            try:
+                if daemon is not None:
+                    await daemon.call("report_metrics", payload, timeout=10)
+                else:
+                    await self.control.call(
+                        "report_metrics", payload, timeout=10)
+                pending = None
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — retry the SAME frozen batch
+                # (same seq) next tick; workers fate-share with the daemon,
+                # so a dead destination resolves itself shortly
+                pass
 
     async def _daemon_fate_watch(self):
         """Exit the worker process when its daemon is gone (reference:
@@ -1856,6 +1914,10 @@ class CoreWorker:
             ObjectRef(oid, self.address, self.worker_id.binary())
             for oid in spec.return_ids()
         ]
+        if spec.trace_ctx is not None:
+            # per-hop decomposition stamps ride the spec OBJECT (owner-side
+            # only — nothing extra crosses the wire on the submit side)
+            spec._hop = {"sub_ns": time.monotonic_ns(), "wall0": time.time()}
         if spec.is_streaming:
             self._streams[task_id.binary()] = StreamState(task_id.binary())
 
@@ -1882,7 +1944,13 @@ class CoreWorker:
             # it rides the batched cross-thread drain — a deep burst's
             # caller-side cost must stay at spec+refs+append (the encode is
             # cheap but the submission entry bookkeeping is not).
-            if self._fastpath is not None and spec.trace_ctx is None:
+            # trace_ctx: the ROOT sentinel (DERIVE_CTX, identity-compared) is
+            # per-task-invariant and bakes into the template — tracing ON
+            # keeps the native engine engaged. Explicit per-task contexts
+            # (nested submissions, serve requests) ride the Python queue.
+            if self._fastpath is not None and (
+                    spec.trace_ctx is None
+                    or spec.trace_ctx is _tracing_DERIVE_CTX()):
                 if self._loop_running_here():
                     if self._fp_submit(key, spec, pyrefs):
                         return refs
@@ -1929,11 +1997,28 @@ class CoreWorker:
             return ObjectRefGenerator(self, task_id.binary())
         return refs
 
+    @staticmethod
+    def _hop_enqueue_stamp(spec: TaskSpec):
+        """Stamp the spec's queue-entry time. submit_encode is observed
+        ONCE (first enqueue only): a RETRY re-entering the queue would
+        otherwise fold the whole failed attempt — lease wait, RPC, backoff
+        — into a microsecond-scale hop and corrupt the dominant-hop
+        answer. The enqueue stamp itself always refreshes so ring_wait
+        measures the CURRENT attempt's queue residency."""
+        hop = getattr(spec, "_hop", None)
+        if hop is None:
+            return
+        now = time.monotonic_ns()
+        if "enq_ns" not in hop:
+            hops.observe_ns("submit_encode", now - hop["sub_ns"])
+        hop["enq_ns"] = now
+
     def _enqueue_fast(self, key: tuple, item: tuple):
         spec = item[0]
         if self._closed:
             self._fail_task(spec, RayTpuError("core worker closed"))
             return
+        self._hop_enqueue_stamp(spec)
         tid = spec.task_id.binary()
         entry = {
             "state": "pending", "worker": "", "cancelled": False,
@@ -1993,8 +2078,12 @@ class CoreWorker:
         return ring
 
     def _fp_template_for(self, spec: TaskSpec, key: tuple) -> int:
+        # trace marker in the key: a template encodes trace_ctx as a
+        # constant fragment (None vs the DERIVE sentinel), so the same
+        # shape templated with tracing off must not serve traced specs
         tkey = (spec.function_key, spec.num_returns, spec.max_retries,
-                spec.name, spec.stream_backpressure, key)
+                spec.name, spec.stream_backpressure,
+                spec.trace_ctx is not None, key)
         tmpl = self._fp_templates.get(tkey)
         if tmpl is None:
             with self._lock:
@@ -2048,6 +2137,17 @@ class CoreWorker:
             for oid in spec.return_ids():
                 self._return_to_task.pop(oid.binary(), None)
             return False
+        hop = getattr(spec, "_hop", None)
+        if hop is not None:
+            # the C++ encode stamped the ring-enqueue time inside the entry
+            # (pop returns the residency); this side closes submit_encode.
+            # enq_ns doubles as the observed-once marker: a retry of this
+            # spec re-entering via the Python queue must not re-fold the
+            # failed attempt into submit_encode
+            now = time.monotonic_ns()
+            if "enq_ns" not in hop:
+                hops.observe_ns("submit_encode", now - hop["sub_ns"])
+            hop["enq_ns"] = now
         # always on the loop thread (inline fast lane or the xthread drain)
         self._ensure_push_feeders(key, spec)
         return True
@@ -2429,6 +2529,7 @@ class CoreWorker:
         q = self._push_queues.get(key)
         if q is None:
             q = self._push_queues[key] = collections.deque()
+        self._hop_enqueue_stamp(spec)
         fut = self.loop.create_future()
         q.append((spec, fut))
         self._ensure_push_feeders(key, spec)
@@ -2467,6 +2568,7 @@ class CoreWorker:
                 if not q and not fp_n:
                     return
                 try:
+                    t_lease_ns = time.monotonic_ns()
                     lease = await self._pool_lease(key, template_spec)
                 except Exception as e:  # noqa: BLE001 — lease unobtainable
                     # e.g. worker spawn failed (broken pip env): deliver the
@@ -2490,7 +2592,7 @@ class CoreWorker:
                             break
                     if not delivered and fp_n and self._fastpath is not None:
                         # native-ring entries only: fail one of those instead
-                        for handle, tid in self._fastpath.pop(
+                        for handle, tid, _wait in self._fastpath.pop(
                                 self._fp_rings[key], 1):
                             self._fastpath.entry_free(handle)
                             sub = self._submissions.get(tid)
@@ -2499,6 +2601,12 @@ class CoreWorker:
                                 self._untrack_submission(sub["spec"])
                     continue
                 cached = not lease.pop("fresh", False)
+                grant_ns = lease.pop("grant_wait_ns", None)
+                if not cached and hops.enabled():
+                    # the grant hop: daemon-side queue-to-grant time when the
+                    # reply carries it, else the owner-observed fetch wait
+                    hops.observe_ns("grant", grant_ns if grant_ns is not None
+                                    else time.monotonic_ns() - t_lease_ns)
                 # fair share: don't let one feeder swallow the whole queue
                 # into a single worker's (sequential) batch while sibling
                 # feeders could drain it onto other workers in parallel
@@ -2537,6 +2645,14 @@ class CoreWorker:
                     self._lease_pool_put(key, lease)
                     continue
                 worker_addr = lease["worker_address"]
+                traced = hops.enabled()
+                if traced:
+                    t_pop = time.monotonic_ns()
+                    waits = [t_pop - s._hop["enq_ns"] for s, _ in batch
+                             if getattr(s, "_hop", None)
+                             and "enq_ns" in s._hop]
+                    if waits:
+                        hops.observe_many_ns("ring_wait", waits)
                 for spec, fut in batch:
                     sub = self._submissions.get(spec.task_id.binary())
                     if sub is not None:
@@ -2544,11 +2660,24 @@ class CoreWorker:
                         sub["worker"] = worker_addr
                 try:
                     client = await self._worker_client(worker_addr)
+                    payload = {"specs": [s.to_wire() for s, _ in batch]}
+                    if traced:
+                        t_send = time.monotonic_ns()
+                        hops.observe_ns("frame_build", t_send - t_pop)
+                        t_send_wall = time.time()
                     reply = await client.call(
-                        "push_task_batch",
-                        {"specs": [s.to_wire() for s, _ in batch]},
-                        timeout=None,
+                        "push_task_batch", payload, timeout=None,
                     )
+                    if traced:
+                        t_reply = time.monotonic_ns()
+                        if "srv_ns" in reply:
+                            # srv_ns missing = the worker's tracing flag is
+                            # off (runtime-enabled driver, pre-spawn
+                            # worker): skip rather than fold the whole
+                            # server-side execution into the wire hop
+                            hops.observe_ns(
+                                "wire_rtt",
+                                t_reply - t_send - reply["srv_ns"])
                 except (RpcError, ConnectionError) as e:
                     self.schedule(self._return_lease_quiet(
                         lease["daemon_address"], lease["lease_id"]))
@@ -2558,6 +2687,7 @@ class CoreWorker:
                         # rather than burning task retries
                         self._drop_pooled_leases_from(lease["daemon_address"])
                         for item in reversed(batch):
+                            self._hop_enqueue_stamp(item[0])
                             q.appendleft(item)
                         continue
                     err = WorkerCrashedError(
@@ -2592,6 +2722,8 @@ class CoreWorker:
                         elif not fut.done():
                             fut.set_exception(e)
                         continue
+                    if traced and getattr(spec, "_hop", None) is not None:
+                        self._note_hop_spans(spec, r, t_send_wall)
                     if fut is None:
                         sub = self._submissions.get(spec.task_id.binary())
                         self._record_lineage(
@@ -2599,6 +2731,9 @@ class CoreWorker:
                         self._untrack_submission(spec)
                     elif not fut.done():
                         fut.set_result(None)
+                if traced:
+                    hops.observe_ns("completion",
+                                    time.monotonic_ns() - t_reply)
         finally:
             n = self._push_feeders.get(key, 1) - 1
             if n <= 0:
@@ -2623,8 +2758,12 @@ class CoreWorker:
         popped = eng.pop(ring, maxb)
         if not popped:
             return False
+        traced = hops.enabled()
+        if traced:
+            # ring residency stamped by the C++ engine at encode time
+            hops.observe_many_ns("ring_wait", [w for _h, _t, w in popped])
         handles, specs = [], []
-        for handle, tid in popped:
+        for handle, tid, _wait in popped:
             sub = self._submissions.get(tid)
             if sub is None or sub.get("cancelled"):
                 eng.entry_free(handle)
@@ -2648,14 +2787,19 @@ class CoreWorker:
                 sub["worker"] = worker_addr
 
         consumed = [False]  # build() owns the entries once entered
+        t_sent = [0]
 
         def build(req_id: int) -> bytes:
             consumed[0] = True
+            t0 = time.monotonic_ns() if traced else 0
             frame = eng.build_frame(handles, req_id)
             if frame is None:  # over the transport limit (absurd batch)
                 for h in handles:
                     eng.entry_free(h)
                 raise RpcError("fastpath batch frame exceeds transport limit")
+            if traced:
+                t_sent[0] = time.monotonic_ns()
+                hops.observe_ns("frame_build", t_sent[0] - t0)
             return frame
 
         def free_unconsumed():
@@ -2668,6 +2812,13 @@ class CoreWorker:
         try:
             client = await self._worker_client(worker_addr)
             reply = await client.call_frame(build, timeout=None)
+            if traced:
+                t_reply = time.monotonic_ns()
+                if "srv_ns" in reply:
+                    # see the Python-batch site: a tracing-off worker's
+                    # reply carries no srv_ns — skip, don't absorb exec time
+                    hops.observe_ns(
+                        "wire_rtt", t_reply - t_sent[0] - reply["srv_ns"])
         except (RpcError, ConnectionError) as e:
             free_unconsumed()
             self.schedule(self._return_lease_quiet(
@@ -2680,6 +2831,7 @@ class CoreWorker:
                 # consumed), so the retry rides the Python queue
                 self._drop_pooled_leases_from(lease["daemon_address"])
                 for spec in reversed(specs):
+                    self._hop_enqueue_stamp(spec)
                     q.appendleft((spec, None))
             else:
                 err = WorkerCrashedError(
@@ -2709,6 +2861,8 @@ class CoreWorker:
             sub = self._submissions.get(spec.task_id.binary())
             self._record_lineage(spec, sub["keepalive"] if sub else [])
             self._untrack_submission(spec)
+        if traced:
+            hops.observe_ns("completion", time.monotonic_ns() - t_reply)
         return True
 
     def _fast_lane_retry(self, key: tuple, q: collections.deque,
@@ -2733,6 +2887,7 @@ class CoreWorker:
             return
         sub["state"] = "pending"
         sub["worker"] = ""
+        self._hop_enqueue_stamp(spec)
         q.append((spec, None))
 
     def _drop_pooled_leases_from(self, daemon_address: str):
@@ -2769,6 +2924,41 @@ class CoreWorker:
                 pool["idle"] = keep
                 if not keep and not pool["waiters"]:
                     self._lease_pools.pop(key, None)
+
+    def _note_hop_spans(self, spec: TaskSpec, reply: dict,
+                        t_send_wall: float):
+        """Fold one EXPLICITLY-traced task's hop stamps into span records so
+        timeline() shows the call split into its hops (root-sentinel tasks
+        fold into the rt_task_hop_seconds histograms only — per-task span
+        records at 100k/s would be their own overhead)."""
+        ctx = spec.trace_ctx
+        if not isinstance(ctx, dict) or not ctx.get("trace_id"):
+            return
+        hop = getattr(spec, "_hop", None)
+        if hop is None or "enq_ns" not in hop:
+            return
+        from ray_tpu.util import tracing
+
+        wall0 = hop["wall0"]
+        enq_wall = wall0 + (hop["enq_ns"] - hop["sub_ns"]) / 1e9
+        segments = [("hop:submit", wall0, enq_wall),
+                    ("hop:queue", enq_wall, t_send_wall)]
+        whops = reply.get("hops") or {}
+        recv = whops.get("recv")
+        end = whops.get("end")
+        if recv:
+            segments.append(("hop:flight", t_send_wall, recv))
+            if whops.get("start"):
+                segments.append(("hop:exec_wait", recv, whops["start"]))
+        if end:
+            segments.append(("hop:reply", end, time.time()))
+        for name, start, stop in segments:
+            tracing.record_span({
+                "trace_id": ctx["trace_id"],
+                "span_id": os.urandom(8).hex(),
+                "parent_span_id": ctx.get("parent_span_id", ""),
+                "name": name, "start": start, "end": max(start, stop),
+            }, task_id=spec.task_id.binary())
 
     def _record_task_reply(self, spec: TaskSpec, reply: dict):
         sub = self._submissions.get(spec.task_id.binary())
@@ -3548,10 +3738,22 @@ class CoreWorker:
     async def rpc_push_task_batch(self, conn_id: int, payload: dict) -> dict:
         """Pipelined batch delivery (reference: back-to-back PushNormalTask
         on one granted lease): tasks run sequentially — the lease grants one
-        worker — and the replies travel in one frame."""
+        worker — and the replies travel in one frame. The reply carries the
+        server-side residency (`srv_ns`) so the owner's wire_rtt hop
+        excludes execution time without any cross-host clock comparison."""
         assert self.executor is not None, "push_task_batch on a non-worker process"
+        traced = hops.enabled()
+        t_recv = time.monotonic_ns() if traced else 0
         specs = [TaskSpec.from_wire(w) for w in payload["specs"]]
-        return {"replies": await self.executor.execute_batch(specs)}
+        if traced:
+            recv_wall = time.time()
+            for spec in specs:
+                spec._recv_ns = t_recv
+                spec._recv_wall = recv_wall
+        reply = {"replies": await self.executor.execute_batch(specs)}
+        if traced:
+            reply["srv_ns"] = time.monotonic_ns() - t_recv
+        return reply
 
     async def resolve_arg(self, arg: dict) -> Any:
         if "inline" in arg:

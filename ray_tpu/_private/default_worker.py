@@ -124,6 +124,14 @@ def main():
         amain()
     except KeyboardInterrupt:
         pass
+    except BaseException:
+        # fatal worker crash: leave the flight-recorder ring next to the
+        # worker logs before propagating (RT_SESSION_DIR is set by the
+        # daemon's worker pool)
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.crash_dump("worker_fatal")
+        raise
     finally:
         if prof is not None:
             prof.disable()

@@ -71,7 +71,8 @@ def _load():
             lib.rt_fp_pop.restype = ctypes.c_int32
             lib.rt_fp_pop.argtypes = [
                 ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
-                ctypes.POINTER(ctypes.c_uint64), u8p]
+                ctypes.POINTER(ctypes.c_uint64), u8p,
+                ctypes.POINTER(ctypes.c_uint64)]
             lib.rt_fp_entry_free.argtypes = [ctypes.c_uint64]
             lib.rt_fp_batch_frame_size.restype = ctypes.c_uint64
             lib.rt_fp_batch_frame_size.argtypes = [
@@ -100,7 +101,7 @@ def _load():
                 ctypes.POINTER(ctypes.c_uint32),
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64)]
-            if lib.rt_fp_abi_version() != 1:
+            if lib.rt_fp_abi_version() != 2:
                 raise RuntimeError("fastpath ABI mismatch")
             _lib = lib
         except Exception:  # noqa: BLE001 — no compiler / bad toolchain / ...
@@ -140,6 +141,7 @@ class FastPathEngine:
         self._pop_cap = 0
         self._pop_handles = None
         self._pop_tids = None
+        self._pop_waits = None
 
     def __del__(self):
         lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
@@ -169,22 +171,27 @@ class FastPathEngine:
     def ring_len(self, ring: int) -> int:
         return self._lib.rt_fp_ring_len(self._h, ring)
 
-    def pop(self, ring: int, max_n: int) -> List[Tuple[int, bytes]]:
-        """Pop up to max_n encoded specs; returns [(handle, task_id)].
-        The caller owns every popped handle: each must reach either
-        build_frame() or entry_free()."""
+    def pop(self, ring: int, max_n: int) -> List[Tuple[int, bytes, int]]:
+        """Pop up to max_n encoded specs; returns
+        [(handle, task_id, ring_wait_ns)] — the wait is the entry's ring
+        residency stamped by the C++ side (the ring_wait hop). The caller
+        owns every popped handle: each must reach either build_frame() or
+        entry_free()."""
         if max_n > self._pop_cap:
             self._pop_cap = max_n
             self._pop_handles = (ctypes.c_uint64 * max_n)()
             self._pop_tids = (ctypes.c_uint8 * (_TID_SLOT * max_n))()
+            self._pop_waits = (ctypes.c_uint64 * max_n)()
         n = self._lib.rt_fp_pop(
             self._h, ring, max_n, self._pop_handles,
-            ctypes.cast(self._pop_tids, ctypes.POINTER(ctypes.c_uint8)))
+            ctypes.cast(self._pop_tids, ctypes.POINTER(ctypes.c_uint8)),
+            self._pop_waits)
         out = []
         raw = bytes(self._pop_tids[:n * _TID_SLOT])
         for i in range(n):
             slot = raw[i * _TID_SLOT:(i + 1) * _TID_SLOT]
-            out.append((self._pop_handles[i], slot[1:1 + slot[0]]))
+            out.append((self._pop_handles[i], slot[1:1 + slot[0]],
+                        self._pop_waits[i]))
         return out
 
     def entry_free(self, handle: int) -> None:

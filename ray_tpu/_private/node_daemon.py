@@ -32,6 +32,7 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import chaos
+from ray_tpu._private import flight_recorder
 from ray_tpu._private import protocol as pb
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.errors import ObjectStoreFullError
@@ -90,7 +91,7 @@ class WorkerHandle:
 
 class PendingLease:
     __slots__ = ("spec_resources", "strategy", "job_id", "future", "hops",
-                 "runtime_env")
+                 "runtime_env", "t0_ns")
 
     def __init__(self, spec_resources: ResourceSet, strategy: pb.SchedulingStrategy,
                  job_id: bytes, hops: int,
@@ -100,6 +101,9 @@ class PendingLease:
         self.job_id = job_id
         self.future: asyncio.Future = asyncio.get_running_loop().create_future()
         self.hops = hops
+        # request-arrival stamp: the grant reply carries queue-to-grant time
+        # (the per-hop decomposition's `grant` hop, daemon-side truth)
+        self.t0_ns = time.monotonic_ns()
         # wire runtime env when it needs a dedicated worker (pip venv,
         # working_dir); None for plain leases
         self.runtime_env = runtime_env
@@ -214,6 +218,17 @@ class NodeDaemon:
         # "nodes" channel (control_store stamps every notice with _seq)
         self._nodes_seq: Optional[int] = None
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        # per-node metric pre-aggregation (reference: the per-node metrics
+        # agent): workers ship DELTAS here; this daemon merges them into one
+        # per-node series set under a cardinality cap and forwards the
+        # merged deltas to the control store on the telemetry cadence
+        self._metrics_pending: Dict[tuple, dict] = {}
+        self._metrics_keys: Set[tuple] = set()
+        self._metrics_dropped = 0
+        # (reporter -> last applied seq): report_metrics is retried
+        # verbatim by workers until acked, so ingestion dedups by sequence
+        # — an applied-but-unacked flush must not double-count
+        self._metrics_last_seq: "OrderedDict[bytes, int]" = OrderedDict()
         # daemon addresses declared dead by the control store: pulls from
         # them fail fast instead of retrying into a void (authoritative
         # death beats connect timeouts)
@@ -270,6 +285,7 @@ class NodeDaemon:
             self._on_node_update(nw)
         self._tasks.append(spawn(self._heartbeat_loop()))
         self._tasks.append(spawn(self._reap_loop()))
+        self._tasks.append(spawn(self._metrics_ship_loop()))
         if GLOBAL_CONFIG.get("log_to_driver"):
             self._tasks.append(spawn(self._log_forward_loop()))
         if GLOBAL_CONFIG.get("object_spill_enabled"):
@@ -822,6 +838,9 @@ class NodeDaemon:
             "worker %s died (state=%s, code=%s): %s",
             w.worker_id.hex()[:8], prev_state, exit_code, reason,
         )
+        flight_recorder.record(
+            "worker", "death", worker=w.worker_id.hex()[:8],
+            state=prev_state, exit_code=exit_code, reason=reason)
         if w.lease_id is not None:
             self._release_lease(w.lease_id)
         self._release_actor_resources(w)
@@ -1114,12 +1133,16 @@ class NodeDaemon:
             w.worker_id.binary(), p.spec_resources, pg_id, bundle_index
         )
         if not p.future.done():
+            flight_recorder.record(
+                "lease", "grant", worker=w.worker_id.hex()[:8],
+                job=p.job_id.hex()[:8])
             p.future.set_result({
                 "granted": True,
                 "lease_id": lease_id,
                 "worker_id": w.worker_id.binary(),
                 "worker_address": w.address,
                 "node_id": self.node_id.hex(),
+                "grant_wait_ns": time.monotonic_ns() - p.t0_ns,
             })
         else:  # caller gave up (timeout) — reclaim
             self._release_lease(lease_id)
@@ -1570,6 +1593,9 @@ class NodeDaemon:
                     frac * 100, victim.worker_id.hex()[:8], victim.state,
                     victim.job_id.hex()[:8], self._oom_kills,
                 )
+                flight_recorder.record(
+                    "oom", "kill_worker", worker=victim.worker_id.hex()[:8],
+                    usage_frac=round(frac, 3), kill_no=self._oom_kills)
                 lease_id = victim.lease_id
                 self._kill_worker_proc(victim, "OOM: node memory pressure")
                 if lease_id is not None:
@@ -1951,6 +1977,125 @@ class NodeDaemon:
     # env-driven — these add runtime aim-ability, since daemon/worker
     # addresses are only known after spawn) -----------------------------
 
+    # ------------------------------------------------------------------
+    # metrics pre-aggregation + flight recorder (observability plane)
+    # ------------------------------------------------------------------
+
+    async def rpc_report_metrics(self, conn_id: int, payload: dict) -> dict:
+        """Per-node metric aggregation point: every worker's delta series
+        merge into one node-level pending set (counters/histograms add,
+        gauges replace), capped in cardinality — the control store sees one
+        reporter per NODE, not one per worker (reference: the per-node
+        metrics agent in dashboard/modules/reporter)."""
+        from ray_tpu.util.metrics import merge_series
+
+        series = payload.get("metrics") or []
+        delta = bool(payload.get("delta"))
+        seq = payload.get("seq")
+        reporter = payload.get("worker_id", b"")
+        if delta and seq is not None:
+            last = self._metrics_last_seq.get(reporter)
+            if last is not None and seq <= last:
+                return {"ok": True, "dup": True}
+            self._metrics_last_seq[reporter] = seq
+            self._metrics_last_seq.move_to_end(reporter)
+            while len(self._metrics_last_seq) > 4096:
+                self._metrics_last_seq.popitem(last=False)
+        cap = GLOBAL_CONFIG.get("metrics_node_series_max")
+        admitted = []
+        for s in series:
+            try:
+                key = (s["name"], tuple(sorted(s["tags"].items())))
+            except (KeyError, TypeError, AttributeError):
+                continue
+            if key not in self._metrics_keys:
+                if len(self._metrics_keys) >= cap:
+                    self._metrics_dropped += 1
+                    continue
+                self._metrics_keys.add(key)
+            admitted.append(s)
+        merge_series(self._metrics_pending, admitted, delta)
+        return {"ok": True, "dropped_total": self._metrics_dropped}
+
+    async def _metrics_ship_loop(self):
+        """Forward the node's pending metric deltas (plus this daemon's own
+        registry and the cardinality-drop counter) to the control store."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        period = GLOBAL_CONFIG.get("telemetry_flush_period_s")
+        # eagerly registered at zero so the series exists on the scrape
+        # before the first drop happens
+        dropped_counter = metrics_mod.get_or_create_counter(
+            "rt_metrics_series_dropped_total",
+            "Metric series dropped by the node daemon's cardinality cap "
+            "(metrics_node_series_max)")
+        dropped_counter.inc(0)
+        shipped_drops = 0
+        # frozen outbound batch (exactly-once: same seq retried verbatim
+        # until the store acks; the store dedups by (node, seq))
+        batch: Optional[list] = None  # [seq, series]
+        seq = 0
+        while not self._stopped:
+            await asyncio.sleep(period)
+            try:
+                if self._metrics_dropped > shipped_drops:
+                    metrics_mod.get_or_create_counter(
+                        "rt_metrics_series_dropped_total").inc(
+                            self._metrics_dropped - shipped_drops)
+                    shipped_drops = self._metrics_dropped
+                if batch is None:
+                    own = metrics_mod.take_delta()
+                    pending, self._metrics_pending = (
+                        self._metrics_pending, {})
+                    series = list(pending.values()) + own
+                    if series:
+                        seq += 1
+                        batch = [seq, series]
+                # an idle interval still sends an empty keepalive: the
+                # store's stale prune must not collect this node's
+                # accumulated totals while it merely has nothing new
+                payload = {"worker_id": self.node_id.binary(),
+                           "delta": True,
+                           "metrics": batch[1] if batch else [],
+                           **({"seq": batch[0]} if batch else {})}
+                try:
+                    await self.control.call(
+                        "report_metrics", payload, timeout=10)
+                    batch = None
+                except Exception:  # noqa: BLE001 — store blip: the frozen
+                    # batch retries with the same seq next tick (new worker
+                    # reports keep accumulating in _metrics_pending)
+                    pass
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — telemetry must never kill
+                logger.debug("metrics ship loop error", exc_info=True)
+
+    async def rpc_dump_flight_recorder(self, conn_id: int, payload) -> dict:
+        return flight_recorder.dump()
+
+    async def rpc_collect_flight_recorders(self, conn_id: int,
+                                           payload) -> dict:
+        """This daemon's ring plus every live local worker's — the one-stop
+        per-node pull the dashboard's /api/flight_recorder endpoint and the
+        cluster-wide dump use."""
+        out = {"daemon": flight_recorder.dump(), "workers": {}}
+        for w in list(self.workers.values()):
+            if w.state == W_DEAD or not w.address:
+                continue
+            try:
+                client = RpcClient(w.address, name="daemon->worker-fr",
+                                   retries=0)
+                await client.connect()
+                try:
+                    out["workers"][w.worker_id.hex()] = await client.call(
+                        "dump_flight_recorder", {}, timeout=5)
+                finally:
+                    await client.close()
+            except Exception:  # noqa: BLE001 — wedged worker: skip it
+                continue
+        return out
+
     async def rpc_chaos_set(self, conn_id: int, payload: dict) -> dict:
         """Apply chaos/testing config flags to THIS daemon process at
         runtime (e.g. partition it from one peer address)."""
@@ -1964,7 +2109,9 @@ class NodeDaemon:
         at a specific live process."""
         if payload.get("die"):
             # reply first so the injector isn't stuck on a lost RPC; the
-            # exit runs after the response flushes
+            # exit runs after the response flushes. Crash path = flight
+            # recorder dump: the post-mortem artifact survives the process.
+            flight_recorder.crash_dump("chaos_kill")
             asyncio.get_running_loop().call_later(0.05, os._exit, 137)
             return {"ok": True, "target": "daemon"}
         wid = payload.get("worker_id")
@@ -2000,6 +2147,8 @@ class NodeDaemon:
         return await self._self_drain(reason, deadline_s)
 
     async def _self_drain(self, reason: str, deadline_s: float) -> dict:
+        flight_recorder.record("drain", "start", reason=reason,
+                               deadline_s=deadline_s)
         try:
             await self.control.call(
                 "drain_node",
@@ -2121,10 +2270,13 @@ class NodeDaemon:
                 # an (unexpected) death instead; replicas still serve
                 logger.warning("drain unregister_node failed", exc_info=True)
             logger.info("drain complete (%s): exiting", reason)
+            flight_recorder.record("drain", "complete", reason=reason,
+                                   replicas=len(replicas or {}))
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — never die silently mid-drain
             logger.exception("drain orchestration failed; exiting anyway")
+            flight_recorder.crash_dump("drain_failed")
         finally:
             self._stopped = True
             if self._exit_cb is not None:
@@ -2287,6 +2439,13 @@ def main():
         asyncio.run(run_daemon(args))
     except KeyboardInterrupt:
         pass
+    except BaseException:
+        # fatal daemon crash: leave the flight-recorder ring next to the
+        # logs before propagating (the post-mortem artifact)
+        from ray_tpu._private import flight_recorder as _fr
+
+        _fr.crash_dump("daemon_fatal")
+        raise
 
 
 if __name__ == "__main__":

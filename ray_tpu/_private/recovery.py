@@ -198,6 +198,11 @@ class ObjectRecoveryManager:
         happening to trip over the stale location."""
         if node_hex in self.dead_nodes:
             return
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record(
+            "recovery", "node_death", node=node_hex[:12], reason=reason,
+            expected=expected, replicas=len(replicas or {}))
         self.dead_nodes[node_hex] = reason
         ms = self.cw.memory_store
         replicas = replicas or {}
@@ -257,6 +262,11 @@ class ObjectRecoveryManager:
         earlier recovery refreshed it and no new re-execution is needed."""
         op = self._object_ops.get(oid)
         if op is None:
+            from ray_tpu._private import flight_recorder
+
+            flight_recorder.record("recovery", "recover_object",
+                                   object=oid.hex()[:12],
+                                   failed_node=(failed_node or "")[:12])
             op = spawn(self._recover_once(oid, failed_node))
             self._object_ops[oid] = op
             op.add_done_callback(lambda _t: self._object_ops.pop(oid, None))

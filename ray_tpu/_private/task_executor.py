@@ -300,29 +300,48 @@ class TaskExecutor:
 
     async def _execute_fast_group(self, group: list) -> list:
         t0 = time.time()
+        from ray_tpu._private import hops
+
+        hop_on = hops.enabled()
 
         def run_all():
             outs = []
+            dequeues, fn_times = [], []
             for spec, _fut, fn, args, kwargs, prep_err in group:
                 tid = spec.task_id.binary()
                 if prep_err is not None:
-                    outs.append((None, prep_err))
+                    outs.append((None, prep_err, None))
                     continue
                 if tid in self._cancelled:
                     outs.append((None, TaskCancelledError(
-                        f"task {spec.name} was cancelled")))
+                        f"task {spec.name} was cancelled"), None))
                     continue
                 # puts inside the fn derive ids from the current task
                 self.cw.current_task_id = spec.task_id
+                whop = None
                 try:
                     rec = (self._record_span(spec) if spec.trace_ctx
                            else None)
+                    if hop_on:
+                        t_start_ns = time.monotonic_ns()
+                        recv_ns = getattr(spec, "_recv_ns", None)
+                        if recv_ns is not None:
+                            dequeues.append(t_start_ns - recv_ns)
+                        whop = {"recv": getattr(spec, "_recv_wall", 0.0),
+                                "start": time.time()}
                     with execution_span(spec, rec):
-                        outs.append(
-                            (self._call_traced(tid, fn, *args, **kwargs),
-                             None))
+                        result = self._call_traced(tid, fn, *args, **kwargs)
+                    if hop_on:
+                        t_end_ns = time.monotonic_ns()
+                        fn_times.append(t_end_ns - t_start_ns)
+                        whop["end"] = time.time()
+                    outs.append((result, None, whop))
                 except BaseException as e:  # noqa: BLE001 — per-task error
-                    outs.append((None, e))
+                    outs.append((None, e, whop))
+            if dequeues:
+                hops.observe_many_ns("exec_dequeue", dequeues)
+            if fn_times:
+                hops.observe_many_ns("user_fn", fn_times)
             return outs
 
         try:
@@ -336,7 +355,7 @@ class TaskExecutor:
                     fut.exception()
             raise
         replies = []
-        for (spec, fut, *_rest), (result, err) in zip(group, outs):
+        for (spec, fut, *_rest), (result, err, whop) in zip(group, outs):
             tid = spec.task_id.binary()
             if err is None:
                 try:
@@ -345,6 +364,11 @@ class TaskExecutor:
                     reply = self._error_reply(spec, e)
             else:
                 reply = self._error_reply(spec, err)
+            if whop is not None and isinstance(spec.trace_ctx, dict) \
+                    and spec.trace_ctx.get("trace_id"):
+                # explicit traces get per-task wall stamps in the reply so
+                # the owner can render the call's hop spans on the timeline
+                reply["hops"] = whop
             self._in_flight.pop(tid, None)
             self._cancelled.discard(tid)
             if spec.kind == pb.TASK_KIND_ACTOR_TASK:
@@ -432,6 +456,16 @@ class TaskExecutor:
             args, kwargs = await self._resolve_args(spec.args)
             self.cw.current_task_id = spec.task_id
             rec = self._record_span(spec) if spec.trace_ctx else None
+            from ray_tpu._private import hops
+
+            whop = None
+            if hops.enabled():
+                t_start = time.monotonic_ns()
+                recv_ns = getattr(spec, "_recv_ns", None)
+                if recv_ns is not None:
+                    hops.observe_ns("exec_dequeue", t_start - recv_ns)
+                whop = {"recv": getattr(spec, "_recv_wall", 0.0),
+                        "start": time.time()}
             with execution_span(spec, rec) as span:
                 if span is not None and not inspect.iscoroutinefunction(fn):
                     fn = bind_span(fn, span)
@@ -443,7 +477,14 @@ class TaskExecutor:
                     if span is not None and inspect.isgenerator(result):
                         result = bind_generator(result, span)
                     return await self._stream_out(spec, result)
-            return await self._returns_reply(spec, result)
+            if whop is not None:
+                whop["end"] = time.time()
+                hops.observe_ns("user_fn", time.monotonic_ns() - t_start)
+            reply = await self._returns_reply(spec, result)
+            if whop is not None and isinstance(spec.trace_ctx, dict) \
+                    and spec.trace_ctx.get("trace_id"):
+                reply["hops"] = whop
+            return reply
         except BaseException as e:  # noqa: BLE001 — all errors cross the wire
             return self._error_reply(spec, e)
 

@@ -91,6 +91,7 @@ class DashboardActor:
         app.router.add_get("/api/workers", self._workers)
         app.router.add_get("/api/profile", self._profile)
         app.router.add_get("/api/jax_profile", self._jax_profile)
+        app.router.add_get("/api/flight_recorder", self._flight_recorder)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -296,6 +297,8 @@ class DashboardActor:
         counts: Dict[str, int] = {}
         latest: Dict[bytes, str] = {}
         for ev in reply["events"]:
+            if ev.get("event") == "SPAN":
+                continue  # trace annotations, not task state
             latest[ev["task_id"]] = ev["event"]
         for st in latest.values():
             counts[st] = counts.get(st, 0) + 1
@@ -337,6 +340,30 @@ class DashboardActor:
 
         return web.json_response(await self._control("get_cluster_load"))
 
+    async def _flight_recorder(self, request):
+        """?node=<hex>: that node's flight-recorder rings (daemon + its
+        workers, collected daemon-side); without ?node, the control store's
+        ring — the on-demand post-mortem pull (see
+        ray_tpu.util.state.dump_flight_recorder for the cluster-wide CLI
+        form)."""
+        from aiohttp import web
+
+        from ray_tpu.runtime.rpc import RpcError
+
+        node = request.query.get("node", "")
+        if not node:
+            reply = await self._control("dump_flight_recorder")
+            return web.json_response({"control_store": reply})
+        try:
+            reply = await self._daemon_call(
+                node, "collect_flight_recorders", {})
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except (RpcError, ConnectionError, OSError) as e:
+            return web.json_response(
+                {"error": f"daemon unreachable: {e}"}, status=502)
+        return web.json_response(reply)
+
     async def _metrics(self, request):
         """User metrics + built-in system series (rt_node_*, rt_tasks_*,
         rt_actors_*) in one Prometheus exposition — the scrape target the
@@ -344,67 +371,89 @@ class DashboardActor:
         metrics/ ships Prometheus+Grafana configs the same way)."""
         from aiohttp import web
 
-        from ray_tpu.util.metrics import render_prometheus
-
-        reply = await self._control("get_metrics")
-        lines = [render_prometheus(reply["workers"]).rstrip()]
-
-        # system series are best-effort: a transient control-store error on
-        # any of them must not 500 the scrape and drop the user metrics
-        async def _system_series():
-            out = []
-            try:
-                stats = (await self._control("get_node_stats"))["stats"]
-            except Exception:  # noqa: BLE001
-                stats = {}
-            gauges = {"cpu_percent": "rt_node_cpu_percent",
-                      "mem_percent": "rt_node_mem_percent",
-                      "store_bytes": "rt_node_store_bytes"}
-            for skey, mname in gauges.items():
-                rows = [(n, s[skey]) for n, s in stats.items() if skey in s]
-                if not rows:
-                    continue
-                out.append(f"# TYPE {mname} gauge")
-                for node, val in sorted(rows):
-                    out.append(f'{mname}{{node="{node[:12]}"}} {val}')
-
-            nodes = (await self._control("get_all_nodes"))["nodes"]
-            alive = sum(1 for n in nodes if n["state"] == "ALIVE")
-            out.append("# TYPE rt_nodes_alive gauge")
-            out.append(f"rt_nodes_alive {alive}")
-
-            actors = (await self._control("list_actors"))["actors"]
-            acounts: Dict[str, int] = {}
-            for a in actors:
-                acounts[str(a["state"])] = acounts.get(str(a["state"]), 0) + 1
-            out.append("# TYPE rt_actors_total gauge")
-            for st, n in sorted(acounts.items()):
-                out.append(f'rt_actors_total{{state="{st}"}} {n}')
-
-            evs = await self._control("list_task_events", {"limit": 0})
-            latest: Dict[bytes, str] = {}
-            for ev in evs["events"]:
-                latest[ev["task_id"]] = ev["event"]
-            tcounts: Dict[str, int] = {}
-            for st in latest.values():
-                tcounts[st] = tcounts.get(st, 0) + 1
-            out.append("# TYPE rt_tasks_total gauge")
-            for st, n in sorted(tcounts.items()):
-                out.append(f'rt_tasks_total{{state="{st}"}} {n}')
-            return out
-
-        try:
-            lines.extend(await _system_series())
-        except Exception:  # noqa: BLE001 — user metrics still render
-            pass
-
-        return web.Response(text="\n".join(lines) + "\n",
-                            content_type="text/plain")
+        text = await render_metrics_text(self._control)
+        return web.Response(text=text, content_type="text/plain")
 
     async def stop(self) -> bool:
         if self._runner is not None:
             await self._runner.cleanup()
         return True
+
+
+async def render_metrics_text(control) -> str:
+    """The /metrics scrape body, given an async `control(method, payload)`
+    callable. Module-level (not actor state) so the outage/malformed-data
+    resilience is directly testable: a dead control store or a malformed
+    worker snapshot must degrade the scrape, never 500 it."""
+    from ray_tpu.util.metrics import render_prometheus
+
+    try:
+        reply = await control("get_metrics")
+        workers = reply["workers"]
+    except Exception:  # noqa: BLE001 — store outage: system series may
+        # still answer from a recovering store below; user metrics resume
+        # on the next scrape
+        workers = {}
+    lines = [render_prometheus(workers).rstrip()]
+
+    # system series are best-effort: a transient control-store error on
+    # any of them must not 500 the scrape and drop the user metrics
+    async def _system_series():
+        out = []
+        try:
+            stats = (await control("get_node_stats"))["stats"]
+        except Exception:  # noqa: BLE001
+            stats = {}
+        gauges = {"cpu_percent": "rt_node_cpu_percent",
+                  "mem_percent": "rt_node_mem_percent",
+                  "store_bytes": "rt_node_store_bytes"}
+        for skey, mname in gauges.items():
+            rows = [(n, s[skey]) for n, s in stats.items() if skey in s]
+            if not rows:
+                continue
+            out.append(f"# TYPE {mname} gauge")
+            for node, val in sorted(rows):
+                out.append(f'{mname}{{node="{node[:12]}"}} {val}')
+
+        nodes = (await control("get_all_nodes"))["nodes"]
+        alive = sum(1 for n in nodes if n["state"] == "ALIVE")
+        out.append("# TYPE rt_nodes_alive gauge")
+        out.append(f"rt_nodes_alive {alive}")
+
+        actors = (await control("list_actors"))["actors"]
+        acounts: Dict[str, int] = {}
+        for a in actors:
+            acounts[str(a["state"])] = acounts.get(str(a["state"]), 0) + 1
+        out.append("# TYPE rt_actors_total gauge")
+        for st, n in sorted(acounts.items()):
+            out.append(f'rt_actors_total{{state="{st}"}} {n}')
+
+        evs = await control("list_task_events", {"limit": 0})
+        latest: Dict[bytes, str] = {}
+        for ev in evs["events"]:
+            if ev.get("event") == "SPAN":
+                continue  # trace annotations, not task state
+            latest[ev["task_id"]] = ev["event"]
+        tcounts: Dict[str, int] = {}
+        for st in latest.values():
+            tcounts[st] = tcounts.get(st, 0) + 1
+        out.append("# TYPE rt_tasks_total gauge")
+        for st, n in sorted(tcounts.items()):
+            out.append(f'rt_tasks_total{{state="{st}"}} {n}')
+        # task-event loss accounting (store-side view; the per-process
+        # counter rides the user-metric plane as
+        # rt_task_events_dropped_total)
+        out.append("# TYPE rt_task_events_store_dropped_total counter")
+        out.append(
+            f"rt_task_events_store_dropped_total {evs.get('dropped', 0)}")
+        return out
+
+    try:
+        lines.extend(await _system_series())
+    except Exception:  # noqa: BLE001 — user metrics still render
+        pass
+
+    return "\n".join(lines) + "\n"
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> str:
@@ -432,4 +481,5 @@ def stop_dashboard():
     ray_tpu.kill(actor)
 
 
-__all__ = ["DashboardActor", "start_dashboard", "stop_dashboard"]
+__all__ = ["DashboardActor", "render_metrics_text", "start_dashboard",
+           "stop_dashboard"]

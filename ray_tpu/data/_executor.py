@@ -305,6 +305,7 @@ class _StageState:
         self.stats = OpStats(name=_stage_name(stage))
         self.avg_size = float(_SMALL_OBJECT_EST)
         self._bp_since: Optional[float] = None
+        self.named_run = None  # segment-named RemoteFunction, built lazily
 
 
 class StreamingExecutorV2:
@@ -342,7 +343,15 @@ class StreamingExecutorV2:
 
     def _submit(self, ss: _StageState, item, order: int):
         if ss.stage[0] == "tasks":
-            ref = self._run.remote(item, ss.stage[1])
+            run = ss.named_run
+            if run is None:
+                # one span per operator-segment task: the task NAME carries
+                # the segment's op chain, so its execution span (and the
+                # state API / timeline rows) read "data:read->map" instead
+                # of "_run_chain" — built lazily, cached per stage
+                run = ss.named_run = self._run.options(
+                    name=f"data:{ss.stats.name[:48]}")
+            ref = run.remote(item, ss.stage[1])
         else:
             ref = ss.pool.submit(item)
         ss.in_flight[ref._id.binary()] = (ref, time.perf_counter(), order,
@@ -427,6 +436,15 @@ class StreamingExecutorV2:
     def run(self) -> Iterator[Block]:
         import ray_tpu
 
+        from ray_tpu.util import tracing
+
+        # driver-side execution span: every segment task submitted by this
+        # loop chains under it, so one dataset consumption reads as one
+        # trace in timeline(). The contextvar is installed only around the
+        # submit/harvest region of each scheduling turn — never across a
+        # yield, where it would leak into (and mis-parent) whatever else
+        # the consumer does between blocks
+        exec_sp = tracing.start_manual_span(f"data:execute:{self.tag}")
         t_start = time.perf_counter()
         stats = DatasetStats()
         first = self.stages[0]
@@ -437,21 +455,22 @@ class StreamingExecutorV2:
         total = len(self.producers)
         try:
             while emitted < total:
-                # source admission rides the same budget as every stage and
-                # is additionally gated on delivery progress so a straggler
-                # at a low order can't pile finished blocks into out_buf
-                # (constant-footprint contract); an empty stage always
-                # admits one block even over budget
-                while src and src[0][0] - next_out < 2 * self.window and (
-                        not first.in_flight
-                        or (len(first.in_flight) < self.window
-                            and first.bytes_in_flight + first.avg_size
-                            <= self.max_bytes)):
-                    order, producer = src.popleft()
-                    self._submit(first, producer, order)
-                for order, ref in self._harvest(timeout=0.05):
-                    out_buf[order] = ref
-                self._admit()
+                with tracing.installed_span(exec_sp):
+                    # source admission rides the same budget as every stage
+                    # and is additionally gated on delivery progress so a
+                    # straggler at a low order can't pile finished blocks
+                    # into out_buf (constant-footprint contract); an empty
+                    # stage always admits one block even over budget
+                    while src and src[0][0] - next_out < 2 * self.window and (
+                            not first.in_flight
+                            or (len(first.in_flight) < self.window
+                                and first.bytes_in_flight + first.avg_size
+                                <= self.max_bytes)):
+                        order, producer = src.popleft()
+                        self._submit(first, producer, order)
+                    for order, ref in self._harvest(timeout=0.05):
+                        out_buf[order] = ref
+                    self._admit()
                 # in-order delivery; the pull is the final backpressure
                 while next_out in out_buf:
                     ref = out_buf.pop(next_out)
@@ -475,6 +494,7 @@ class StreamingExecutorV2:
             stats.wall_s = time.perf_counter() - t_start
             record_stats(self.tag, stats)
             self.last_stats = stats
+            tracing.end_manual_span(exec_sp, blocks=stats.output_blocks)
 
     def __iter__(self) -> Iterator[Block]:
         return self.run()

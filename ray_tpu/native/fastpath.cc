@@ -33,6 +33,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <mutex>
 
 namespace {
@@ -106,10 +107,20 @@ inline uint8_t* emit_bin8(uint8_t* p, const uint8_t* data, uint32_t len) {
 // entries and the Vyukov bounded MPMC ring
 // ---------------------------------------------------------------------------
 
+inline uint64_t mono_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ULL +
+         static_cast<uint64_t>(ts.tv_nsec);
+}
+
 struct FpEntry {
   uint32_t tid_len;
   uint8_t tid[kMaxTidLen];
-  uint64_t len;  // encoded spec bytes
+  uint64_t len;     // encoded spec bytes
+  uint64_t enq_ns;  // CLOCK_MONOTONIC stamp at ring enqueue (the per-hop
+                    // telemetry's ring_wait hop; ~20ns per encode, cheap
+                    // enough to stamp unconditionally)
   // spec bytes follow inline
   uint8_t* data() { return reinterpret_cast<uint8_t*>(this + 1); }
 };
@@ -215,7 +226,7 @@ uint8_t* dup_bytes(const uint8_t* p, uint64_t n) {
 
 extern "C" {
 
-int32_t rt_fp_abi_version() { return 1; }
+int32_t rt_fp_abi_version() { return 2; }
 
 void* rt_fp_engine_create(uint64_t ring_slots) {
   Engine* e = new Engine();
@@ -288,6 +299,7 @@ int32_t rt_fp_encode(void* h, int32_t ring, int32_t tmpl, const uint8_t* tid,
   ent->tid_len = tid_len;
   memcpy(ent->tid, tid, tid_len);
   ent->len = spec_len;
+  ent->enq_ns = mono_ns();
   uint8_t* p = ent->data();
   memcpy(p, t.pre, t.pre_len);
   p += t.pre_len;
@@ -319,6 +331,7 @@ int32_t rt_fp_encode_raw(void* h, int32_t ring, const uint8_t* tid,
   ent->tid_len = tid_len;
   memcpy(ent->tid, tid, tid_len);
   ent->len = spec_len;
+  ent->enq_ns = mono_ns();
   memcpy(ent->data(), spec, spec_len);
   if (!e->rings[ring]->push(ent)) {
     free(ent);
@@ -334,14 +347,17 @@ uint64_t rt_fp_ring_len(void* h, int32_t ring) {
 }
 
 // Pop up to `max_n` entries. Fills `out_handles` (opaque entry pointers the
-// caller now owns) and `out_tids` (max_n slots of [1-byte len][kMaxTidLen
-// bytes]). Returns the number popped.
+// caller now owns), `out_tids` (max_n slots of [1-byte len][kMaxTidLen
+// bytes]) and `out_wait_ns` (per-entry ring residency: now − enqueue stamp —
+// the ring_wait hop of the latency decomposition). Returns the number
+// popped.
 int32_t rt_fp_pop(void* h, int32_t ring, int32_t max_n, uint64_t* out_handles,
-                  uint8_t* out_tids) {
+                  uint8_t* out_tids, uint64_t* out_wait_ns) {
   Engine* e = static_cast<Engine*>(h);
   if (ring < 0 || ring >= e->nrings.load(std::memory_order_acquire)) return 0;
   Ring* r = e->rings[ring];
   int32_t n = 0;
+  uint64_t now = mono_ns();
   while (n < max_n) {
     FpEntry* ent = r->pop();
     if (!ent) break;
@@ -349,6 +365,7 @@ int32_t rt_fp_pop(void* h, int32_t ring, int32_t max_n, uint64_t* out_handles,
     uint8_t* slot = out_tids + n * (1 + kMaxTidLen);
     slot[0] = static_cast<uint8_t>(ent->tid_len);
     memcpy(slot + 1, ent->tid, ent->tid_len);
+    out_wait_ns[n] = now > ent->enq_ns ? now - ent->enq_ns : 0;
     n++;
   }
   return n;

@@ -19,7 +19,8 @@ class _BatchQueue:
     __slots__ = ("items", "timer")
 
     def __init__(self):
-        self.items: List[tuple] = []  # (item, future, deadline-or-0)
+        # (item, future, deadline-or-0, trace-span-or-None)
+        self.items: List[tuple] = []
         self.timer: Optional[asyncio.TimerHandle] = None
 
 
@@ -85,34 +86,45 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
             from ray_tpu.serve._errors import DeadlineExceededError
 
             now = _time.time()
-            live = []
-            for it, fut, deadline in items:
+            live, ctxs = [], []
+            for it, fut, deadline, ctx in items:
                 if deadline and now >= deadline:
                     if not fut.done():
                         fut.set_exception(DeadlineExceededError(
                             "request deadline expired in the batch queue"))
                 else:
                     live.append((it, fut))
+                    ctxs.append(ctx)
             items = live
             if not items:
                 return
             batch_in = [it for it, _ in items]
-            try:
-                out = fn(self_obj, batch_in) if is_method else fn(batch_in)
-                if inspect.isawaitable(out):
-                    out = await out
-                if len(out) != len(items):
-                    raise ValueError(
-                        f"batched function returned {len(out)} results for "
-                        f"{len(items)} requests"
-                    )
-                for (_, fut), r in zip(items, out):
-                    if not fut.done():
-                        fut.set_result(r)
-            except BaseException as e:  # noqa: BLE001 — fan the error out
-                for _, fut in items:
-                    if not fut.done():
-                        fut.set_exception(e)
+            # batch-flush span: runs in a timer callback OUTSIDE any
+            # request's context, so it parents explicitly to the first
+            # rider's span captured at enqueue time — the batch hop shows
+            # up on that request's trace with the batch size attached
+            from ray_tpu.util import tracing
+
+            parent = next((c for c in ctxs if c is not None), None)
+            with tracing.span(f"serve:batch:{fn.__name__}"
+                              f"[n={len(items)}]", parent=parent):
+                try:
+                    out = (fn(self_obj, batch_in) if is_method
+                           else fn(batch_in))
+                    if inspect.isawaitable(out):
+                        out = await out
+                    if len(out) != len(items):
+                        raise ValueError(
+                            f"batched function returned {len(out)} results "
+                            f"for {len(items)} requests"
+                        )
+                    for (_, fut), r in zip(items, out):
+                        if not fut.done():
+                            fut.set_result(r)
+                except BaseException as e:  # noqa: BLE001 — fan the error out
+                    for _, fut in items:
+                        if not fut.done():
+                            fut.set_exception(e)
 
         @functools.wraps(fn)
         async def wrapper(*call_args) -> Any:
@@ -124,11 +136,14 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
             loop = asyncio.get_running_loop()
             q = queue_for(self_obj, wrapper)
             fut = loop.create_future()
-            # snapshot the caller's deadline at ENQUEUE time: the flush
-            # runs outside the request's context (timer callback)
+            # snapshot the caller's deadline AND trace span at ENQUEUE
+            # time: the flush runs outside the request's context (timer
+            # callback)
             from ray_tpu.serve._context import get_request_deadline
+            from ray_tpu.util.tracing import current_span
 
-            q.items.append((item, fut, get_request_deadline()))
+            q.items.append((item, fut, get_request_deadline(),
+                            current_span()))
             if len(q.items) >= max_batch_size:
                 await flush(q, self_obj)
             elif q.timer is None:

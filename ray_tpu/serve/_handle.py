@@ -456,6 +456,12 @@ class DeploymentHandle:
                         sampled[1][0]) else 1
             if self._saturated_locked(shed_scope):
                 self.overload_stats["shed_ingress"] += 1
+                from ray_tpu._private import flight_recorder
+
+                flight_recorder.record(
+                    "serve", "shed_ingress",
+                    deployment=self.deployment_name,
+                    capacity=self._capacity)
                 shed = BackpressureError(
                     f"deployment {self.deployment_name}: every replica's "
                     f"probed load >= capacity ({self._capacity}) — shedding "
@@ -504,6 +510,12 @@ class DeploymentHandle:
                 self._ejected[rid] = (
                     time.monotonic() + _cfg("serve_outlier_probation_s"))
                 self.overload_stats["ejections"] += 1
+                from ray_tpu._private import flight_recorder
+
+                flight_recorder.record(
+                    "serve", "outlier_ejected",
+                    deployment=self.deployment_name,
+                    replica=rid.hex()[:12], streak=streak)
                 # drop the stale load reading: the probation re-probe must
                 # judge the replica on fresh evidence
                 self._qlen_cache.pop(rid, None)
@@ -545,8 +557,11 @@ class DeploymentHandle:
             raise DeadlineExceededError(
                 f"deployment {self.deployment_name}: request deadline "
                 f"expired before routing")
-        rid, replica = self._pick(model_id=spec.model_id,
-                                  deadline=spec.deadline)
+        from ray_tpu.util import tracing
+
+        with tracing.span(f"handle:pick:{self.deployment_name}"):
+            rid, replica = self._pick(model_id=spec.model_id,
+                                      deadline=spec.deadline)
         kwargs = dict(spec.kwargs)
         if spec.model_id:
             kwargs["__serve_model_id"] = spec.model_id
@@ -570,7 +585,10 @@ class DeploymentHandle:
             raise DeadlineExceededError(
                 f"deployment {self.deployment_name}: request deadline "
                 f"expired before routing")
-        rid, replica = self._pick(deadline=spec.deadline)
+        from ray_tpu.util import tracing
+
+        with tracing.span(f"handle:pick:{self.deployment_name}"):
+            rid, replica = self._pick(deadline=spec.deadline)
         kwargs = dict(spec.kwargs)
         if spec.deadline:
             kwargs[DEADLINE_KWARG] = spec.deadline

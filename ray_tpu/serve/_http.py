@@ -167,17 +167,25 @@ class HttpProxy:
         timeout_s = self._timeout_from(request)
         caller = (handle if timeout_s is None
                   else handle.options(timeout_s=timeout_s))
+        from ray_tpu.util import tracing
+
         if stream:
-            return await self._dispatch_stream(request, caller, payload)
-        try:
-            result = await caller.remote(payload)
-        except Exception as e:  # noqa: BLE001 — typed mapping below
-            status, headers, body = _error_response(e)
-            if status == 503:
-                self._shed += 1
-            elif status == 504:
-                self._deadline_exceeded += 1
-            return web.json_response(body, status=status, headers=headers)
+            return await self._dispatch_stream(request, caller, payload,
+                                               name)
+        # ingress span: the root of the request's trace — the handle's
+        # pick span and the replica-side admission/batch/execution spans
+        # all chain under it (stitched by trace id in timeline())
+        with tracing.span(f"ingress:{name}"):
+            try:
+                result = await caller.remote(payload)
+            except Exception as e:  # noqa: BLE001 — typed mapping below
+                status, headers, body = _error_response(e)
+                if status == 503:
+                    self._shed += 1
+                elif status == 504:
+                    self._deadline_exceeded += 1
+                return web.json_response(body, status=status,
+                                         headers=headers)
         try:
             return web.json_response({"result": result})
         except TypeError:
@@ -195,7 +203,8 @@ class HttpProxy:
             return None
         return t if t > 0 else None
 
-    async def _dispatch_stream(self, request, handle, payload):
+    async def _dispatch_stream(self, request, handle, payload,
+                               name: str = ""):
         """SSE: one `data:` event per generator item, flushed as produced
         (reference: proxy.py:1031 ASGI streaming). Admission failures
         (shed / expired deadline) happen BEFORE the response starts and
@@ -203,64 +212,81 @@ class HttpProxy:
         can only be an SSE error event — the 200 is already on the wire."""
         from aiohttp import web
 
-        # Defer the 200/SSE headers until the FIRST item arrives: replica
-        # admission control (queue full, spent deadline) rejects a stream
-        # on its first chunk, and that must be a clean 503/504 — once the
-        # event-stream response has started, only error events remain.
-        first = _SENTINEL
-        try:
-            stream = handle.options(stream=True).remote(payload)
-            it = stream.__aiter__()
-            try:
-                first = await (await it.__anext__())
-            except StopAsyncIteration:
-                pass
-        except Exception as e:  # noqa: BLE001 — typed mapping
-            status, headers, body = _error_response(e)
-            if status == 503:
-                self._shed += 1
-            elif status == 504:
-                self._deadline_exceeded += 1
-            return web.json_response(body, status=status, headers=headers)
-        resp = web.StreamResponse(headers={
-            "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache",
-            "X-Accel-Buffering": "no",
-        })
-        await resp.prepare(request)
+        from ray_tpu.util import tracing
 
-        def encode(item) -> bytes:
+        # ingress span: created manually (its END rides the stream outcome,
+        # not a lexical scope) and installed as the current context for the
+        # whole dispatch so the handle submission chains under it
+        ingress_sp = tracing.start_manual_span(f"ingress:{name}")
+        with tracing.installed_span(ingress_sp):
+            n_chunks = 0
+            # Defer the 200/SSE headers until the FIRST item arrives:
+            # replica admission control (queue full, spent deadline)
+            # rejects a stream on its first chunk, and that must be a clean
+            # 503/504 — once the event-stream response has started, only
+            # error events remain.
+            first = _SENTINEL
             try:
-                data = json.dumps(item)
-            except TypeError:
-                data = json.dumps(str(item))
-            return f"data: {data}\n\n".encode()
+                stream = handle.options(stream=True).remote(payload)
+                it = stream.__aiter__()
+                try:
+                    first = await (await it.__anext__())
+                except StopAsyncIteration:
+                    pass
+            except Exception as e:  # noqa: BLE001 — typed mapping
+                tracing.end_manual_span(ingress_sp, error=type(e).__name__)
+                status, headers, body = _error_response(e)
+                if status == 503:
+                    self._shed += 1
+                elif status == 504:
+                    self._deadline_exceeded += 1
+                return web.json_response(body, status=status,
+                                         headers=headers)
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Accel-Buffering": "no",
+            })
+            await resp.prepare(request)
 
-        try:
-            if first is not _SENTINEL:
-                await resp.write(encode(first))
-                async for ref in it:
-                    await resp.write(encode(await ref))
-            await resp.write(b"data: [DONE]\n\n")
-        except Exception as e:  # noqa: BLE001 — mid-stream error event
-            # route the failure through the stream's health bookkeeping:
-            # replica errors ride the final ITEM ref, which we await here
-            # (outside the iterator), so the iterator can't see them
-            err = stream.note_failure(e) if hasattr(
-                stream, "note_failure") else unwrap(e)
-            if isinstance(err, DeadlineExceededError):
-                kind = "deadline_exceeded"
-                self._deadline_exceeded += 1
-            elif isinstance(err, BackpressureError):
-                kind = "backpressure"
-                self._shed += 1
-            else:
-                kind = "error"
-            await resp.write(
-                f"data: {json.dumps({'error': str(err), 'type': kind})}"
-                f"\n\n".encode())
-        await resp.write_eof()
-        return resp
+            def encode(item) -> bytes:
+                try:
+                    data = json.dumps(item)
+                except TypeError:
+                    data = json.dumps(str(item))
+                return f"data: {data}\n\n".encode()
+
+            try:
+                if first is not _SENTINEL:
+                    await resp.write(encode(first))
+                    n_chunks = 1
+                    async for ref in it:
+                        await resp.write(encode(await ref))
+                        n_chunks += 1
+                await resp.write(b"data: [DONE]\n\n")
+                tracing.end_manual_span(ingress_sp, chunks=n_chunks)
+            except Exception as e:  # noqa: BLE001 — mid-stream error event
+                # route the failure through the stream's health
+                # bookkeeping: replica errors ride the final ITEM ref,
+                # which we await here (outside the iterator), so the
+                # iterator can't see them
+                err = stream.note_failure(e) if hasattr(
+                    stream, "note_failure") else unwrap(e)
+                if isinstance(err, DeadlineExceededError):
+                    kind = "deadline_exceeded"
+                    self._deadline_exceeded += 1
+                elif isinstance(err, BackpressureError):
+                    kind = "backpressure"
+                    self._shed += 1
+                else:
+                    kind = "error"
+                await resp.write(
+                    f"data: {json.dumps({'error': str(err), 'type': kind})}"
+                    f"\n\n".encode())
+                tracing.end_manual_span(ingress_sp, chunks=n_chunks,
+                                        error=kind)
+            await resp.write_eof()
+            return resp
 
     async def drain(self, timeout: float = 30.0) -> bool:
         """Stop admitting requests; resolve once in-flight ones finish."""

@@ -117,8 +117,14 @@ class ServeReplica:
     # -- execution ------------------------------------------------------
 
     async def _run(self, fn, deadline, *args, **kwargs) -> Any:
-        self._admit(deadline)
-        self._track()
+        from ray_tpu.util import tracing
+
+        # admission span: queue-full sheds and deadline-expired rejections
+        # are visible on the request's trace (chained under the actor-task
+        # execution span, which chains to the ingress span)
+        with tracing.span(f"replica:admit:{self.deployment_name}"):
+            self._admit(deadline)
+            self._track()
         try:
             await self._acquire_slot(deadline)
             self._running += 1
@@ -185,11 +191,19 @@ class ServeReplica:
         result yields exactly once, so callers may stream unconditionally.
         The deadline is re-checked between chunks: a stream whose consumer's
         budget is spent stops burning compute mid-generation."""
+        from ray_tpu.util import tracing
+
         fn = self._callable
         deadline = self._install_request_context(kwargs)
-        self._admit(deadline)
-        self._track()
+        with tracing.span(f"replica:admit:{self.deployment_name}"):
+            self._admit(deadline)
+            self._track()
         sentinel = object()
+        # manual span (not a `with`): the generator body runs across the
+        # consumer's pulls — chunk count lands in the span name on close
+        stream_sp = tracing.start_manual_span(
+            f"replica:stream:{self.deployment_name}")
+        n_chunks = 0
         try:
             await self._acquire_slot(deadline)
             self._running += 1
@@ -201,6 +215,7 @@ class ServeReplica:
                 if inspect.isasyncgen(result):
                     async for item in result:
                         self._check_stream_deadline(deadline)
+                        n_chunks += 1
                         yield item
                 elif inspect.isgenerator(result):
                     # a sync generator's next() may block (device steps):
@@ -212,14 +227,17 @@ class ServeReplica:
                         if item is sentinel:
                             break
                         self._check_stream_deadline(deadline)
+                        n_chunks += 1
                         yield item
                 else:
+                    n_chunks += 1
                     yield result
             finally:
                 self._running -= 1
                 self._sem.release()
         finally:
             self._ongoing -= 1
+            tracing.end_manual_span(stream_sp, chunks=n_chunks)
 
     def _check_stream_deadline(self, deadline: float):
         if expired(deadline):

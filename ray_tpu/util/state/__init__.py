@@ -87,6 +87,11 @@ def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
     reply = _control_call("list_task_events", {"limit": limit * 4})
     latest: Dict[bytes, dict] = {}
     for ev in reply["events"]:
+        # SPAN records (execution/hop/serve spans) are trace annotations,
+        # not task STATE — a traced task's hop spans land after FINISHED
+        # and must not masquerade as its latest execution state
+        if ev.get("event") == "SPAN":
+            continue
         latest[ev["task_id"]] = ev
     out = [
         {
@@ -145,6 +150,72 @@ def timeline(filename: Optional[str] = None) -> Any:
     return trace
 
 
+def dump_flight_recorder(dest_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Pull every process's flight-recorder ring: this driver's, the
+    control store's, and — per live node — the daemon's plus its workers'
+    (collected daemon-side in one hop). With `dest_dir`, each ring is also
+    written as `<dest_dir>/<process>.jsonl` and the returned dicts carry a
+    "path" key. Unreachable processes appear with an "error" key instead of
+    failing the whole dump — this runs exactly when things are broken (the
+    chaos harness invokes it on scenario failure; see tests/conftest.py)."""
+    import json as _json
+    import os
+
+    from ray_tpu._private import flight_recorder
+    from ray_tpu._private.core_worker import get_core_worker
+    from ray_tpu._private.protocol import NodeInfo
+    from ray_tpu.runtime.rpc import RpcClient
+
+    cw = get_core_worker()
+    out: Dict[str, Any] = {"driver": flight_recorder.dump()}
+    try:
+        out["control_store"] = cw.run_sync(
+            cw.control.call("dump_flight_recorder", {}, timeout=10), 15)
+    except Exception as e:  # noqa: BLE001 — store down: dump what we can
+        out["control_store"] = {"error": str(e)}
+    try:
+        nodes = cw.run_sync(cw.control.call("get_all_nodes", {}), 15)["nodes"]
+    except Exception as e:  # noqa: BLE001
+        nodes = []
+        out["nodes_error"] = str(e)
+    for n in nodes:
+        info = NodeInfo.from_wire(n)
+        if info.state == "DEAD":
+            continue
+        key = f"node_{info.node_id.hex()[:12]}"
+
+        async def pull(address=info.address):
+            client = RpcClient(address, name="fr-dump", retries=1)
+            await client.connect()
+            try:
+                return await client.call(
+                    "collect_flight_recorders", {}, timeout=15)
+            finally:
+                await client.close()
+
+        try:
+            reply = cw.run_sync(pull(), 20)
+        except Exception as e:  # noqa: BLE001 — dead/partitioned daemon
+            out[key] = {"error": str(e)}
+            continue
+        out[key] = reply["daemon"]
+        for wid, ring in reply.get("workers", {}).items():
+            out[f"{key}_worker_{wid[:12]}"] = ring
+    if dest_dir:
+        os.makedirs(dest_dir, exist_ok=True)
+        for name, ring in out.items():
+            if not isinstance(ring, dict):
+                continue
+            path = os.path.join(dest_dir, f"{name}.jsonl")
+            with open(path, "w") as f:
+                header = {k: v for k, v in ring.items() if k != "events"}
+                f.write(_json.dumps(header, default=str) + "\n")
+                for ev in ring.get("events", []):
+                    f.write(_json.dumps(ev, default=str) + "\n")
+            ring["path"] = path
+    return out
+
+
 def list_cluster_events(source: str = None, type: str = None,
                         limit: int = 1000):
     """Structured cluster events (node/actor/job/pg/autoscaler lifecycle;
@@ -189,6 +260,7 @@ def list_dataset_stats() -> List[Dict[str, Any]]:
 
 
 __all__ = [
+    "dump_flight_recorder",
     "export_cluster_events",
     "list_actors",
     "list_cluster_events",

@@ -46,7 +46,41 @@ def pytest_configure(config):
 def _reset_global_config():
     from ray_tpu._private import chaos
     from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.util.metrics import reset_registry
 
     yield
     GLOBAL_CONFIG.reset()
     chaos.reset()
+    # metric registry isolation: a test re-declaring a name with different
+    # tag_keys/boundaries must not trip over another test's registration
+    reset_registry()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Chaos-harness auto-dump: a FAILING chaos-soak scenario dumps the
+    flight-recorder rings of every involved process (driver, control
+    store, daemons, workers) to a temp dir before teardown destroys the
+    cluster — the post-mortem starts from recorded control-plane events,
+    not from log archaeology."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when != "call" or not rep.failed:
+        return
+    if "chaos" not in item.nodeid:
+        return
+    import re
+    import tempfile
+
+    try:
+        from ray_tpu.util.state import dump_flight_recorder
+
+        safe = re.sub(r"[^A-Za-z0-9_.-]+", "_", item.nodeid)[-80:]
+        dest = os.path.join(tempfile.gettempdir(), f"rt_flight_{safe}")
+        dump = dump_flight_recorder(dest)
+        paths = [v.get("path") for v in dump.values()
+                 if isinstance(v, dict) and v.get("path")]
+        print(f"\n[chaos] flight recorder auto-dump: {len(paths)} ring(s) "
+              f"written under {dest}")
+    except Exception as e:  # noqa: BLE001 — the cluster may be fully dead
+        print(f"\n[chaos] flight recorder auto-dump failed: {e!r}")

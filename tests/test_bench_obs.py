@@ -1,0 +1,61 @@
+"""Tier-1 smoke of the observability A/B in bench_core.py: tracing + hop
+folding + flight recorder + delta telemetry ON must stay within budget of
+the all-off baseline on the submit path, and the per-hop breakdown must
+name a dominant hop. The committed full-size run (BENCH_OBS_r13.json)
+asserts the tight < 5% submit-rate bound; this smoke uses a generous
+CI-noise floor so tier-1 stays deterministic."""
+
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def test_bench_obs_quick_in_process():
+    """The on-mode probes end to end in one process: hop breakdown
+    populated for every instrumented hop, dominant hop named, submit path
+    alive with everything on. (The deep-queue bench itself is exercised by
+    the slow-marked A/B below — tier-1 keeps this smoke lean.)"""
+    import bench_core
+
+    ray_tpu.init(num_cpus=4, system_config={"tracing_enabled": True})
+    try:
+        results = [bench_core.bench_tasks_sync(ray_tpu, 60),
+                   bench_core.bench_hop_breakdown(ray_tpu, 60)]
+        by = {r["bench"]: r for r in results}
+        assert by["tasks_sync"]["value"] > 0
+        bd = by["task_hop_breakdown"]["hops"]
+        for hop in ("submit_encode", "ring_wait", "frame_build", "wire_rtt",
+                    "exec_dequeue", "user_fn", "completion"):
+            assert bd.get(hop, {}).get("count", 0) > 0, (hop, bd)
+        assert by["task_hop_breakdown"]["dominant_hop"] in bd
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_bench_obs_ab_overhead_budget():
+    """Full A/B in fresh subprocesses (the honest comparison): the
+    everything-on submit rate stays within budget of the all-off run.
+    Tier-1 keeps the in-process smoke; this asserts the actual A/B."""
+    import json
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench_core.py"),
+         "--obs", "both", "--quick"],
+        text=True, capture_output=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(line) for line in proc.stdout.splitlines() if line]
+    by = {(r["bench"], r["obs"]): r for r in rows}
+    on = by[("queued_tasks_20000", "on")]
+    off = by[("queued_tasks_20000", "off")]
+    # generous CI floor (the committed full run holds < 5%): the plane
+    # must not cost a third of the submit rate even on a noisy runner
+    assert on["submit_rate"] >= 0.67 * off["submit_rate"], (on, off)
+    assert by[("task_hop_breakdown", "on")]["dominant_hop"]

@@ -78,14 +78,30 @@ def test_dashboard_endpoints(ray_init):
 def test_web_frontend_and_metrics_export(ray_init):
     """The static SPA (reference: dashboard/client React app) + the
     Grafana-ready system metrics: DOM structure, every API route the page
-    fetches, and the rt_* Prometheus series."""
+    fetches, and the rt_* Prometheus series (including the per-hop
+    histogram the new latency panels query)."""
     import json
     import os
     import re
 
     import httpx
 
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
     url = start_dashboard(port=18265)
+
+    # hop decomposition series must exist for the Grafana latency panels:
+    # driver-side tracing is enough to populate rt_task_hop_seconds. The
+    # flag form (not enable_tracing()) keeps the opt-in scoped to this
+    # test — conftest resets GLOBAL_CONFIG, while the env var would leak
+    # tracing into every later test in the pytest process.
+    GLOBAL_CONFIG.apply_system_config({"tracing_enabled": True})
+
+    @ray_tpu.remote
+    def hop_probe():
+        return 1
+
+    assert ray_tpu.get(hop_probe.remote(), timeout=60) == 1
 
     page = httpx.get(f"{url}/", timeout=30).text
     # nav + renderers for every view the SPA declares
@@ -100,10 +116,20 @@ def test_web_frontend_and_metrics_export(ray_init):
         assert r.status_code == 200, (path, r.status_code)
         r.json()
 
-    metrics = httpx.get(f"{url}/metrics", timeout=30).text
+    deadline = time.time() + 20
+    metrics = ""
+    while time.time() < deadline:
+        metrics = httpx.get(f"{url}/metrics", timeout=30).text
+        if ("rt_task_hop_seconds_bucket" in metrics
+                and "rt_task_events_dropped_total" in metrics
+                and "rt_metrics_series_dropped_total" in metrics):
+            break
+        time.sleep(0.5)
     assert "rt_nodes_alive 1" in metrics
     assert "rt_tasks_total{" in metrics
     assert "rt_actors_total{" in metrics
+    assert "rt_task_hop_seconds_bucket" in metrics
+    assert "rt_task_events_store_dropped_total" in metrics
 
     # the bundled Grafana dashboard parses and its panels query only
     # series the endpoint exports
@@ -120,3 +146,84 @@ def test_web_frontend_and_metrics_export(ray_init):
                 assert s in exported or s.startswith("rt_node_"), s
     with open(os.path.join(root, "prometheus.yml")) as f:
         assert "metrics_path: /metrics" in f.read()
+
+
+# ---------------------------------------------------------------------------
+# scrape resilience (no cluster needed): a dead control store or a malformed
+# worker snapshot must degrade the scrape, never 500 it
+# ---------------------------------------------------------------------------
+
+
+def _scrape(control):
+    import asyncio
+
+    from ray_tpu.dashboard import render_metrics_text
+
+    return asyncio.run(render_metrics_text(control))
+
+
+def test_metrics_scrape_survives_dead_control_store():
+    async def dead_control(method, payload=None):
+        raise ConnectionError("control store is down")
+
+    text = _scrape(dead_control)
+    # degraded but rendered: no exception, exposition-shaped output
+    assert text.endswith("\n")
+    assert "Traceback" not in text
+
+
+def test_metrics_scrape_survives_malformed_worker_snapshot():
+    """One broken reporter (missing keys, wrong shapes, half a histogram)
+    must not take down everyone else's series (dashboard/__init__ outage
+    path + render_prometheus hardening)."""
+    good_counter = {"name": "rt_good_total", "type": "counter",
+                    "tags": {"k": "v"}, "value": 3.0, "help": "good"}
+    good_hist = {"name": "rt_good_seconds", "type": "histogram",
+                 "tags": {}, "boundaries": [0.1, 1.0],
+                 "counts": [1, 2, 3], "sum": 4.5, "help": "hist"}
+    untyped = {"name": "rt_untyped_thing", "type": "untyped",
+               "tags": {}, "value": 7.0, "help": ""}
+    workers = {
+        b"good": {"metrics": [good_counter, good_hist, untyped]},
+        b"missing-keys": {"metrics": [{"name": "rt_broken"},
+                                      {"type": "counter"}, 42, None]},
+        b"bad-shape": {"metrics": "not-a-list"},
+        b"no-metrics": {"ts": 0},
+        b"bad-hist": {"metrics": [{"name": "rt_good_seconds",
+                                   "type": "histogram", "tags": {},
+                                   "counts": None, "sum": None,
+                                   "boundaries": None}]},
+    }
+
+    async def control(method, payload=None):
+        if method == "get_metrics":
+            return {"workers": workers}
+        raise ConnectionError("rest of the store is down")
+
+    text = _scrape(control)
+    assert 'rt_good_total{k="v"} 3.0' in text
+    assert 'rt_good_seconds_bucket{le="0.1"} 1' in text
+    assert 'rt_good_seconds_bucket{le="+Inf"} 6' in text
+    assert "rt_good_seconds_sum 4.5" in text
+    assert "rt_good_seconds_count 6" in text
+    # untyped series render as bare samples
+    assert "rt_untyped_thing 7.0" in text
+    assert "# TYPE rt_untyped_thing untyped" in text
+
+
+def test_render_prometheus_merges_histograms_across_processes():
+    """Bucket counts and sums ADD across reporters — the cross-process
+    histogram-merge contract the delta-telemetry plane relies on."""
+    from ray_tpu.util.metrics import render_prometheus
+
+    def hist(counts, s):
+        return {"name": "rt_m_seconds", "type": "histogram", "tags": {},
+                "boundaries": [0.5], "counts": counts, "sum": s, "help": ""}
+
+    text = render_prometheus({
+        b"w1": {"metrics": [hist([1, 2], 1.0)]},
+        b"w2": {"metrics": [hist([3, 4], 2.5)]},
+    })
+    assert 'rt_m_seconds_bucket{le="0.5"} 4' in text
+    assert 'rt_m_seconds_bucket{le="+Inf"} 10' in text
+    assert "rt_m_seconds_sum 3.5" in text

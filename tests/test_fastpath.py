@@ -61,8 +61,8 @@ def test_encode_matches_pure_python_wire_format():
     assert eng.ring_len(ring) == 2
 
     popped = eng.pop(ring, 16)
-    assert [tid for _h, tid in popped] == [t1.binary(), t2.binary()]
-    frame = eng.build_frame([h for h, _ in popped], req_id=77)
+    assert [tid for _h, tid, _w in popped] == [t1.binary(), t2.binary()]
+    frame = eng.build_frame([h for h, _tid, _w in popped], req_id=77)
     (ln,) = struct.unpack("<I", frame[:4])
     assert ln == len(frame) - 4
     kind, req_id, method, payload = msgpack.unpackb(frame[4:], raw=False)
@@ -96,7 +96,7 @@ def test_ring_overflow_reports_full():
     assert fills == 8  # capacity rounds to the requested power of two
     # popping frees capacity again
     popped = eng.pop(ring, 4)
-    for h, _tid in popped:
+    for h, _tid, _w in popped:
         eng.entry_free(h)
     assert eng.encode(ring, tmpl, t.binary(), b"\x90") == 0
 

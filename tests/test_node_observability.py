@@ -32,7 +32,9 @@ def test_node_stats_flow_through_heartbeats(ray_init):
     stats = {}
     while time.time() < deadline:
         stats = httpx.get(f"{url}/api/node_stats", timeout=30).json()
-        if stats:
+        # poll until the sample shows a spawned worker, not merely until
+        # the FIRST heartbeat lands: pool prestart races the early beats
+        if stats and stats.get(_node_hex(), {}).get("workers", 0) >= 1:
             break
         time.sleep(0.5)
     assert stats, "no node stats arrived via heartbeats"

@@ -1,0 +1,455 @@
+"""Observability plane: per-hop latency decomposition, the cluster flight
+recorder, delta telemetry, and the metric-registry/task-event-loss
+satellites (ISSUE 8)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import flight_recorder, hops
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.task_events import TaskEventBuffer
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, reset_registry
+
+
+# ---------------------------------------------------------------------------
+# satellite: metric registry re-registration semantics
+# ---------------------------------------------------------------------------
+
+
+def test_metric_reregistration_returns_existing_instance():
+    reset_registry()
+    c1 = Counter("obs_requests", "reqs", tag_keys=("k",))
+    c1.inc(3, tags={"k": "a"})
+    c2 = Counter("obs_requests", "reqs", tag_keys=("k",))
+    assert c2 is c1, "matching re-registration must return the instance"
+    c2.inc(2, tags={"k": "a"})
+    snap = c1._snapshot()
+    assert snap[0]["value"] == 5.0, "values must survive re-registration"
+
+
+def test_metric_reregistration_mismatch_raises():
+    reset_registry()
+    Counter("obs_m", tag_keys=("k",))
+    with pytest.raises(TypeError):
+        Gauge("obs_m")
+    with pytest.raises(TypeError):
+        Histogram("obs_m", boundaries=[1.0])
+    with pytest.raises(ValueError):
+        Counter("obs_m", tag_keys=("other",))
+    h = Histogram("obs_h", boundaries=[1.0, 2.0])
+    assert Histogram("obs_h", boundaries=[1.0, 2.0]) is h
+    with pytest.raises(ValueError):
+        Histogram("obs_h", boundaries=[5.0])
+
+
+def test_reset_registry_isolates():
+    reset_registry()
+    gen = metrics_mod.registry_generation()
+    Counter("obs_gone")
+    reset_registry()
+    assert metrics_mod.registry_generation() == gen + 1
+    # a different shape under the same name is now legal
+    Gauge("obs_gone")
+
+
+# ---------------------------------------------------------------------------
+# delta telemetry semantics (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_counter_delta_take_untake():
+    reset_registry()
+    c = Counter("obs_delta_total")
+    c.inc(5)
+    d1 = [s for s in metrics_mod.take_delta()
+          if s["name"] == "obs_delta_total"]
+    assert d1 and d1[0]["value"] == 5.0
+    # nothing new: no series shipped
+    assert not [s for s in metrics_mod.take_delta()
+                if s["name"] == "obs_delta_total"]
+    c.inc(2)
+    d2 = [s for s in metrics_mod.take_delta()
+          if s["name"] == "obs_delta_total"]
+    assert d2[0]["value"] == 2.0
+    # failed flush returns the delta for the next take
+    metrics_mod.untake(d2)
+    d3 = [s for s in metrics_mod.take_delta()
+          if s["name"] == "obs_delta_total"]
+    assert d3[0]["value"] == 2.0
+
+
+def test_histogram_delta_and_merge():
+    reset_registry()
+    h = Histogram("obs_lat_seconds", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    d1 = [s for s in metrics_mod.take_delta()
+          if s["name"] == "obs_lat_seconds"]
+    assert d1[0]["counts"] == [1, 1, 0]
+    h.observe(5.0)
+    d2 = [s for s in metrics_mod.take_delta()
+          if s["name"] == "obs_lat_seconds"]
+    assert d2[0]["counts"] == [0, 0, 1]
+    # the receiver accumulates the deltas exactly
+    acc = {}
+    metrics_mod.merge_series(acc, d1, True)
+    metrics_mod.merge_series(acc, d2, True)
+    merged = list(acc.values())[0]
+    assert merged["counts"] == [1, 1, 1]
+    assert abs(merged["sum"] - 5.55) < 1e-9
+
+
+def test_observe_many_matches_observe():
+    reset_registry()
+    a = Histogram("obs_a_seconds", boundaries=[0.1, 1.0])
+    b = Histogram("obs_b_seconds", boundaries=[0.1, 1.0])
+    vals = [0.01, 0.2, 0.5, 3.0, 0.05]
+    for v in vals:
+        a.observe(v)
+    b.observe_many(vals)
+    assert a._snapshot()[0]["counts"] == b._snapshot()[0]["counts"]
+    assert abs(a._snapshot()[0]["sum"] - b._snapshot()[0]["sum"]) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# satellite: task-event loss accounting
+# ---------------------------------------------------------------------------
+
+
+def test_task_event_buffer_counts_drops():
+    reset_registry()
+    GLOBAL_CONFIG.apply_system_config({"task_event_buffer_max": 10})
+    buf = TaskEventBuffer()
+    for i in range(25):
+        buf.record(task_id=bytes([i]), name=f"t{i}", kind=0,
+                   event="FINISHED", worker_id=b"w", node_id="n")
+    events, dropped = buf.drain()
+    assert len(events) == 10
+    assert dropped == 15
+    assert buf.dropped_total == 15
+    # the counter series carries the loss to the scrape
+    snap = [s for s in metrics_mod.snapshot_all()
+            if s["name"] == "rt_task_events_dropped_total"]
+    assert snap and snap[0]["value"] >= 15
+    # requeue over capacity counts too
+    buf.record(task_id=b"x", name="x", kind=0, event="FINISHED",
+               worker_id=b"w", node_id="n")
+    buf.requeue(events, dropped=3)
+    events2, dropped2 = buf.drain()
+    assert len(events2) == 10
+    assert dropped2 >= 4  # 1 trimmed on requeue merge + the 3 carried
+
+
+# ---------------------------------------------------------------------------
+# satellite: config knob promotion
+# ---------------------------------------------------------------------------
+
+
+def test_observability_knobs_promoted():
+    flags = GLOBAL_CONFIG.all_flags()
+    for name in ("tracing_enabled", "flight_recorder_ring_size",
+                 "metrics_node_series_max"):
+        assert name in flags, name
+        assert flags[name].doc, f"{name} needs a help string"
+    assert flags["tracing_enabled"].type is bool
+    assert flags["flight_recorder_ring_size"].type is int
+
+
+def test_tracing_flag_and_env_override():
+    assert not tracing.tracing_enabled()
+    GLOBAL_CONFIG.apply_system_config({"tracing_enabled": True})
+    assert tracing.tracing_enabled()
+    GLOBAL_CONFIG.reset()
+    assert not tracing.tracing_enabled()
+    os.environ["RT_TRACING_ENABLED"] = "1"
+    try:
+        assert tracing.tracing_enabled()
+    finally:
+        del os.environ["RT_TRACING_ENABLED"]
+
+
+def test_derive_ctx_is_template_constant():
+    GLOBAL_CONFIG.apply_system_config({"tracing_enabled": True})
+    try:
+        ctx1 = tracing.inject_context()
+        ctx2 = tracing.inject_context()
+        assert ctx1 is tracing.DERIVE_CTX and ctx2 is tracing.DERIVE_CTX
+        resolved = tracing.resolve_context(ctx1, b"\x01" * 20)
+        assert len(resolved["trace_id"]) == 32
+        assert resolved["parent_span_id"] == ""
+    finally:
+        GLOBAL_CONFIG.reset()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_bounded_with_drop_accounting():
+    rec = flight_recorder.FlightRecorder(capacity=32)
+    for i in range(100):
+        rec.record("cat", "ev", {"i": i})
+    d = rec.dump()
+    assert len(d["events"]) == 32
+    assert d["recorded_total"] == 100
+    assert d["dropped"] == 68
+    assert d["events"][-1]["detail"]["i"] == 99
+    assert d["pid"] == os.getpid()
+
+
+def test_flight_recorder_dump_to_file(tmp_path):
+    rec = flight_recorder.get_recorder()
+    flight_recorder.record("test", "hello", n=1)
+    path = flight_recorder.dump_to_file(str(tmp_path / "ring.jsonl"))
+    assert path is not None
+    lines = open(path).read().splitlines()
+    assert len(lines) >= 2  # header + >= 1 event
+    import json
+
+    header = json.loads(lines[0])
+    assert "role" in header and "recorded_total" in header
+    assert rec is flight_recorder.get_recorder()
+
+
+# ---------------------------------------------------------------------------
+# cluster: hops populate, rings collect cluster-wide
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def obs_cluster():
+    info = ray_tpu.init(num_cpus=4,
+                        system_config={"tracing_enabled": True})
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def traced(obs_cluster):
+    """Re-apply the tracing flag per test: the conftest config reset runs
+    after every test while the module cluster (whose workers inherited the
+    flag at spawn) stays up."""
+    GLOBAL_CONFIG.apply_system_config({"tracing_enabled": True})
+    yield
+
+
+def test_hop_histograms_populate(obs_cluster, traced):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote(), timeout=60)
+    for _ in range(30):
+        ray_tpu.get(nop.remote(), timeout=60)
+
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    deadline = time.time() + 30
+    bd = {}
+    while time.time() < deadline:
+        reply = cw.run_sync(cw.control.call("get_metrics", {}), 15)
+        series = []
+        for w in reply["workers"].values():
+            series += [s for s in w.get("metrics", [])
+                       if s.get("name") == "rt_task_hop_seconds"]
+        bd = hops.breakdown(series)
+        wanted = {"submit_encode", "ring_wait", "frame_build", "wire_rtt",
+                  "exec_dequeue", "user_fn", "completion"}
+        if wanted.issubset(bd) and all(bd[h]["count"] > 0 for h in wanted):
+            break
+        time.sleep(0.5)
+    for hop in ("submit_encode", "ring_wait", "frame_build", "wire_rtt",
+                "exec_dequeue", "user_fn", "completion"):
+        assert hop in bd and bd[hop]["count"] > 0, (hop, bd)
+    assert hops.dominant_hop(bd) != "", bd
+    # grant hop appears once a fresh lease was fetched
+    assert bd.get("grant", {}).get("count", 0) >= 1, bd
+
+
+def test_traced_sync_call_splits_into_hop_spans(obs_cluster, traced):
+    """One EXPLICITLY-traced sync call renders as hop sub-spans in the
+    timeline — the 'one sync call visibly splits into its hops'
+    acceptance shape."""
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote(), timeout=60)  # warm
+    with tracing.span("traced-sync-root") as root:
+        trace_id = root["trace_id"]
+        ray_tpu.get(nop.remote(), timeout=60)
+
+    deadline = time.time() + 30
+    names = set()
+    while time.time() < deadline:
+        spans = [s for s in tracing.list_spans(limit=4000)
+                 if s["trace_id"] == trace_id]
+        names = {s["name"] for s in spans}
+        if {"hop:submit", "hop:queue", "hop:flight", "hop:exec_wait",
+                "hop:reply", "traced-sync-root"} <= names:
+            break
+        time.sleep(0.5)
+    assert {"hop:submit", "hop:queue", "hop:flight", "hop:exec_wait",
+            "hop:reply", "traced-sync-root"} <= names, names
+    # and the timeline renders them as chrome-trace span rows
+    from ray_tpu.util.state import timeline
+
+    rows = [t for t in timeline()
+            if t.get("args", {}).get("trace_id") == trace_id]
+    assert any(r["name"] == "hop:flight" for r in rows)
+    assert all(r["ph"] == "X" for r in rows)
+
+
+def test_flight_recorder_cluster_dump(obs_cluster, traced, tmp_path):
+    """dump_flight_recorder pulls rings from every involved process: the
+    driver, the control store, the node daemon, and its workers — the
+    same call the chaos harness runs on scenario failure (see
+    tests/conftest.py pytest_runtest_makereport)."""
+    @ray_tpu.remote
+    def touch():
+        from ray_tpu._private import flight_recorder as fr
+
+        fr.record("test", "worker_event", pid=os.getpid())
+        return os.getpid()
+
+    pids = set(ray_tpu.get([touch.remote() for _ in range(4)], timeout=60))
+    assert pids
+
+    from ray_tpu.util.state import dump_flight_recorder
+
+    dest = str(tmp_path / "rings")
+    dump = dump_flight_recorder(dest)
+    assert "driver" in dump and "control_store" in dump
+    node_keys = [k for k in dump
+                 if k.startswith("node_") and "worker" not in k]
+    assert node_keys, dump.keys()
+    daemon_ring = dump[node_keys[0]]
+    assert "events" in daemon_ring, daemon_ring
+    cats = {(e["category"], e["event"]) for e in daemon_ring["events"]}
+    assert ("lease", "grant") in cats, cats
+    # the control store recorded the node's registration
+    cs_cats = {(e["category"], e["event"])
+               for e in dump["control_store"]["events"]}
+    assert ("node", "register") in cs_cats, cs_cats
+    # worker rings were collected through the daemon and carry the
+    # task-recorded event
+    worker_keys = [k for k in dump if "_worker_" in k]
+    assert worker_keys
+    worker_events = [e for k in worker_keys
+                     for e in dump[k].get("events", [])]
+    assert any(e["category"] == "test" for e in worker_events)
+    # every ring landed on disk as JSONL
+    for k, ring in dump.items():
+        if isinstance(ring, dict) and "events" in ring:
+            assert os.path.exists(ring["path"]), k
+
+
+def test_worker_metrics_flow_through_daemon_preaggregation(obs_cluster, traced):
+    """Workers ship deltas to the daemon; the control store sees one
+    reporter per NODE (the node id), not one per worker."""
+    @ray_tpu.remote
+    def bump(i):
+        from ray_tpu.util.metrics import Counter
+
+        Counter("obs_preagg_total").inc(1)
+        time.sleep(1.5)  # let the worker's telemetry loop flush
+        return i
+
+    assert sorted(ray_tpu.get([bump.remote(i) for i in range(3)],
+                              timeout=120)) == [0, 1, 2]
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    deadline = time.time() + 20
+    total = 0.0
+    while time.time() < deadline:
+        reply = cw.run_sync(cw.control.call("get_metrics", {}), 15)
+        total = sum(
+            s["value"]
+            for w in reply["workers"].values()
+            for s in w.get("metrics", [])
+            if s.get("name") == "obs_preagg_total")
+        if total >= 3:
+            break
+        time.sleep(0.5)
+    assert total >= 3, total
+    # the series arrived under the NODE's reporter id, pre-aggregated
+    reporters = [
+        wid for wid, w in reply["workers"].items()
+        if any(s.get("name") == "obs_preagg_total"
+               for s in w.get("metrics", []))
+    ]
+    node_ids = {n["node_id"] for n in cw.run_sync(
+        cw.control.call("get_all_nodes", {}), 15)["nodes"]}
+    assert reporters and all(r in node_ids for r in reporters), reporters
+
+
+# ---------------------------------------------------------------------------
+# serve trace stitching: ingress -> replica -> batch -> stream in ONE trace
+# ---------------------------------------------------------------------------
+
+
+def test_serve_request_stitches_one_trace(obs_cluster, traced):
+    """timeline() over one serve request shows ingress, replica admission,
+    @serve.batch flush, and stream spans sharing a single trace id."""
+    import httpx
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Tokens:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        async def embed(self, items):
+            return [len(str(x)) for x in items]
+
+        async def __call__(self, payload):
+            n = await self.embed(payload)
+            for i in range(3):
+                yield {"tok": i, "n": n}
+
+    serve.run(Tokens.bind())
+    base = serve.start(http_port=18476)
+    try:
+        chunks = []
+        with httpx.stream("POST", f"{base}/Tokens?stream=1",
+                          json={"q": "hi"}, timeout=60) as r:
+            assert r.status_code == 200
+            for line in r.iter_lines():
+                if line.startswith("data: ") and "[DONE]" not in line:
+                    chunks.append(line)
+        assert len(chunks) == 3, chunks
+
+        wanted_prefixes = ("ingress:Tokens", "handle:pick:Tokens",
+                           "replica:admit:Tokens", "serve:batch:embed",
+                           "replica:stream:Tokens")
+        deadline = time.time() + 30
+        by_trace = {}
+        while time.time() < deadline:
+            spans = tracing.list_spans(limit=4000)
+            by_trace = {}
+            for s in spans:
+                by_trace.setdefault(s["trace_id"], set()).add(s["name"])
+            done = [t for t, names in by_trace.items()
+                    if all(any(n.startswith(p) for n in names)
+                           for p in wanted_prefixes)]
+            if done:
+                break
+            time.sleep(0.5)
+        assert done, {t: sorted(n) for t, n in by_trace.items()
+                      if len(n) > 2}
+        # the stream span carries the chunk count
+        names = by_trace[done[0]]
+        assert any(n.startswith("replica:stream:Tokens") and "chunks=3" in n
+                   for n in names), sorted(names)
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
