@@ -1,0 +1,321 @@
+"""Control-plane scale benchmarks: 500-1000 simulated nodes vs one store.
+
+The harness (ROADMAP item 5): a SimNodePlane — protocol-faithful node-daemon
+speakers with no worker pools (_private/simnode.py) — stands up N "nodes"
+against a single control store and measures where the control plane melts,
+A/B'ing the scale fixes OFF vs ON:
+
+  OFF: full get_all_nodes reconciles, O(nodes) view+nodes payload in every
+       heartbeat reply, one pubsub frame per event per subscriber, zero
+       heartbeat jitter.
+  ON:  versioned node-table delta sync (cursor reconciles, availability-
+       delta heartbeat replies, lean registration), coalesced pubsub fanout
+       (one frame per subscriber per flush window, bounded backlog), and
+       jittered heartbeats.
+
+Phases per mode:
+  register_storm    N nodes brought up concurrently; wall time to all-
+                    registered and to all membership views converged.
+  steady_state      T seconds of pure heartbeats: control-store CPU
+                    fraction (/proc), client-side inbound bytes/s.
+  pubsub_fanout     drain wave of N/10 nodes: push frames vs messages vs
+                    bytes across all subscribers, sheds, convergence time.
+  reconcile         every node reconciles a simulated notice gap:
+                    get_all_nodes (off) vs get_nodes_delta cursor (on) —
+                    wall time + bytes for the whole fleet.
+  lease_spillback   M scripted lease requests entering at random nodes,
+                    following real spillback replies until granted: time
+                    to convergence + average hops.
+  wal_growth        persisted store size after the churn (WAL + snapshot).
+
+Emits one JSON record per (phase, mode) on stdout; --out writes the
+collected artifact (BENCH_SCALE_rNN.json).
+
+Run: python bench_scale.py [--quick] [--nodes N] [--out BENCH_SCALE_r14.json]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+FIXES = {
+    "off": {
+        "node_table_delta_sync": False,
+        "pubsub_flush_window_ms": 0.0,
+        "heartbeat_jitter": 0.0,
+        "control_store_persist": True,
+    },
+    "on": {
+        "node_table_delta_sync": True,
+        "pubsub_flush_window_ms": 25.0,
+        "heartbeat_jitter": 0.2,
+        "control_store_persist": True,
+    },
+}
+
+
+def _proc_cpu_s(pid: int) -> float:
+    with open(f"/proc/{pid}/stat") as f:
+        parts = f.read().split()
+    hz = os.sysconf("SC_CLK_TCK")
+    return (int(parts[13]) + int(parts[14])) / hz
+
+
+def _proc_rss(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total
+
+
+class _ClientPool:
+    """One RpcClient per simnode address for the spillback driver."""
+
+    def __init__(self):
+        self._clients = {}
+
+    async def get(self, address: str):
+        from ray_tpu.runtime.rpc import RpcClient
+
+        client = self._clients.get(address)
+        if client is None:
+            client = RpcClient(address, name="bench->sim")
+            await client.connect()
+            self._clients[address] = client
+        return client
+
+    async def close(self):
+        for c in self._clients.values():
+            await c.close()
+
+
+async def _lease_follow(pool: _ClientPool, address: str, res_wire: dict,
+                        max_hops: int, sem: asyncio.Semaphore, out: list):
+    """The client half of the lease protocol: request, follow spillback
+    replies (the real reply shape) until granted or out of hops. Bounded
+    concurrency: hundreds of simultaneous fresh TCP connects against
+    servers sharing one saturated event loop overflow accept backlogs —
+    a real client fleet is spread across processes; one bench loop isn't.
+    Results append to `out` so a phase-timeout still reads partial grants."""
+    async with sem:
+        hops = 0
+        try:
+            while True:
+                client = await pool.get(address)
+                r = await client.call("request_lease", {
+                    "resources": res_wire, "job_id": b"", "hops": hops,
+                }, timeout=30)
+                if r.get("granted"):
+                    out.append(hops)
+                    return
+                nxt = r.get("spillback")
+                if nxt and hops < max_hops:
+                    address = nxt
+                    hops += 1
+                    continue
+                out.append(None)
+                return
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — recorded as a failed request
+            out.append("error")
+
+
+async def run_mode(mode: str, args) -> list:
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.simnode import SimNodePlane
+
+    GLOBAL_CONFIG.reset()
+    GLOBAL_CONFIG.apply_system_config(dict(FIXES[mode]))
+    count = args.nodes
+    session_dir = node_mod.new_session_dir()
+    cs_proc, addr = node_mod.start_control_store(session_dir)
+    persist_dir = os.path.join(session_dir, "control_store")
+    results = []
+
+    def rec(phase: str, **fields):
+        row = {"bench": phase, "mode": mode, "nodes": count, **fields}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    async def converge(plane, timeout=240.0):
+        """(seconds, stragglers): a mode that cannot fully converge is a
+        RESULT to record, not a crash."""
+        try:
+            return round(await plane.await_converged(timeout=timeout), 3), 0
+        except TimeoutError:
+            expect = len(plane.alive())
+            bad = sum(1 for n in plane.alive()
+                      if n.alive_members != expect)
+            return None, bad
+
+    plane = SimNodePlane(addr, count, seed=args.seed)
+    try:
+        # -- register storm ------------------------------------------------
+        storm_s = await plane.start()
+        converge_s, stragglers = await converge(plane)
+        stats0 = plane.stats()
+        rec("register_storm", storm_s=round(storm_s, 3),
+            converge_s=converge_s, unconverged_views=stragglers,
+            bytes_received=stats0["bytes_received"],
+            protocol_errors=len(stats0["protocol_errors"]))
+
+        # -- steady-state heartbeat load ----------------------------------
+        window = args.steady_s
+        cpu0 = _proc_cpu_s(cs_proc.pid)
+        b0 = plane.stats()
+        t0 = time.monotonic()
+        await asyncio.sleep(window)
+        dt = time.monotonic() - t0
+        cpu1 = _proc_cpu_s(cs_proc.pid)
+        b1 = plane.stats()
+        rec("steady_state", window_s=round(dt, 2),
+            beats_per_s=round((b1["beats"] - b0["beats"]) / dt, 1),
+            store_cpu_frac=round((cpu1 - cpu0) / dt, 4),
+            client_bytes_per_s=round(
+                (b1["bytes_received"] - b0["bytes_received"]) / dt),
+            store_rss_bytes=_proc_rss(cs_proc.pid))
+
+        # -- pubsub fanout under a churn wave ------------------------------
+        wave = max(2, count // 10)
+        b0 = plane.stats()
+        t0 = time.monotonic()
+        await plane.drain_wave(wave, deadline_s=0.5)
+        wave_converge_s, wave_stragglers = await converge(plane, 120.0)
+        b1 = plane.stats()
+        pool0 = _ClientPool()
+        store = await pool0.get(addr)
+        ps = await store.call("pubsub_stats", {})
+        await pool0.close()
+        rec("pubsub_fanout", wave=wave,
+            wave_s=round(time.monotonic() - t0, 3),
+            converge_s=wave_converge_s, unconverged_views=wave_stragglers,
+            push_frames=b1["push_frames"] - b0["push_frames"],
+            push_messages=b1["push_messages"] - b0["push_messages"],
+            fanout_bytes=b1["bytes_received"] - b0["bytes_received"],
+            dropped=sum((ps.get("dropped") or {}).values()),
+            gaps_reconciled=b1["gaps_reconciled"])
+
+        # -- reconcile cost: full snapshot vs delta cursor -----------------
+        live = plane.alive()
+        b0 = plane.stats()
+        for n in live:
+            # simulate a missed-notice gap the size of the churn wave
+            n._node_table_version = max(-1, n._node_table_version - wave)
+        t0 = time.monotonic()
+        await asyncio.gather(*(n._reconcile() for n in live))
+        reconcile_s = time.monotonic() - t0
+        b1 = plane.stats()
+        rec("reconcile", fleet=len(live),
+            reconcile_all_s=round(reconcile_s, 3),
+            bytes=b1["bytes_received"] - b0["bytes_received"],
+            per_node_ms=round(1000.0 * reconcile_s / max(1, len(live)), 2))
+
+        # -- lease spillback convergence -----------------------------------
+        from ray_tpu._private.protocol import ResourceSet
+
+        pool = _ClientPool()
+        # one grant saturates one simnode (they script CPU=4.0 each)
+        res_wire = ResourceSet({"CPU": 4.0}).to_wire()
+        m = max(4, len(live) // 2)
+        # every request enters at ONE node (the hot-entry pattern): the
+        # first grant saturates it and the rest must spill — convergence
+        # then measures how good each node's membership view really is
+        entries = [live[0].address] * m
+        from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+        max_hops = _cfg.get("lease_spillback_max_hops")
+        sem = asyncio.Semaphore(32)
+        hops: list = []
+        t0 = time.monotonic()
+        # wall-capped: a melted-down mode (off at 1000 nodes grinds through
+        # reconnect storms and 30s-timeout retries) records partial grants
+        # as its RESULT instead of holding the sweep hostage
+        tasks = [asyncio.ensure_future(
+            _lease_follow(pool, a, res_wire, max_hops, sem, hops))
+            for a in entries]
+        _done, pending = await asyncio.wait(
+            tasks, timeout=args.lease_timeout_s)
+        for t in pending:
+            t.cancel()
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+        lease_s = time.monotonic() - t0
+        await pool.close()
+        granted = [h for h in hops if isinstance(h, int)]
+        rec("lease_spillback", requests=m, granted=len(granted),
+            errors=sum(1 for h in hops if h == "error"),
+            timed_out=bool(pending),
+            converge_s=round(lease_s, 3),
+            avg_hops=round(sum(granted) / max(1, len(granted)), 2),
+            grants_per_s=round(len(granted) / max(lease_s, 1e-9), 1))
+
+        # -- WAL/snapshot growth -------------------------------------------
+        await asyncio.sleep(0.5)  # let compaction settle
+        stats = plane.stats()
+        rec("wal_growth", persisted_bytes=_dir_bytes(persist_dir),
+            protocol_errors=len(stats["protocol_errors"]),
+            errors_sample=stats["protocol_errors"][:3])
+    finally:
+        await plane.stop()
+        node_mod.kill_process(cs_proc, force=True)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=0,
+                    help="simulated node count (default: 1000, or 100 with "
+                         "--quick)")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--mode", choices=["off", "on", "both"], default="both")
+    ap.add_argument("--seed", type=int, default=101)
+    ap.add_argument("--steady-s", type=float, default=0.0,
+                    help="steady-state window (default 10, or 4 with --quick)")
+    ap.add_argument("--lease-timeout-s", type=float, default=300.0,
+                    help="wall cap on the lease-spillback phase; partial "
+                         "grants are recorded with timed_out=true")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    if not args.nodes:
+        args.nodes = 100 if args.quick else 1000
+    if not args.steady_s:
+        args.steady_s = 4.0 if args.quick else 10.0
+
+    modes = ["off", "on"] if args.mode == "both" else [args.mode]
+    all_results = []
+    for mode in modes:
+        all_results.extend(asyncio.run(run_mode(mode, args)))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({
+                "bench": "bench_scale",
+                "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "nodes": args.nodes,
+                "seed": args.seed,
+                "fixes": FIXES,
+                "results": all_results,
+            }, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

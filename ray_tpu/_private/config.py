@@ -188,6 +188,18 @@ _flag("device_object_transport", True, "Keep jax.Arrays HBM-resident through the
 _flag("native_fastpath", True, "Use the C++ submission/completion engine (native/fastpath.cc: templated spec encoding, lock-free submission ring, batched frame build + reply splitting) on the control-plane hot path (reference: the _raylet.pyx submit_task seam). Falls back to the pure-Python path when the build fails or no compiler exists — set 0 to force the fallback.")
 _flag("fastpath_ring_slots", 65536, "Capacity of each lock-free submission ring (one ring per scheduling key); a full ring overflows gracefully onto the Python queue.")
 
+# --- control-plane scale (simnode harness + 1000-node fixes; see
+# _private/simnode.py and bench_scale.py) ---
+_flag("heartbeat_period_s", 0.0, "Node-daemon heartbeat period; 0 = follow health_check_period_s. Decoupled so a 1000-node cluster can beat slower than the liveness probe granularity of a 4-node one.")
+_flag("heartbeat_jitter", 0.1, "Fractional jitter applied to every heartbeat sleep (period * (1 +/- jitter * U)): de-phases a register storm's worth of daemons so 1000 beats don't land on the same control-store event-loop tick.")
+_flag("pubsub_flush_window_ms", 0.0, "Control-store pubsub coalescing window: >0 buffers notices per subscriber and ships ONE batched push frame per subscriber per window (a churn wave costs frames proportional to windows, not events). 0 = legacy immediate per-event frames. Subscribers detect any coalescing-drop gaps via per-channel _seq and reconcile from the node-table delta cursor.")
+_flag("pubsub_max_backlog", 1000, "Bound on the per-subscriber pubsub backlog: buffered notices beyond this (coalescing mode) are dropped OLDEST-first, and a subscriber whose transport write buffer exceeds ~1KiB * this cap (immediate mode) has notices dropped instead of growing the buffer without bound. Drops count in rt_pubsub_dropped_total{channel=} and surface to the subscriber as a _seq gap -> cursor reconcile.")
+_flag("node_delta_retention", 1024, "Node-table delta-log retention (entries): subscribers reconcile from a version cursor via get_nodes_delta instead of full get_all_nodes snapshots; a cursor older than the retained window falls back to one full snapshot.")
+_flag("node_dead_retention", 512, "DEAD node records kept in the node table (oldest evicted with a persisted tombstone): bounds get_all_nodes payloads, the WAL/snapshot, and death-record memory under node churn. Live nodes are never evicted.")
+_flag("node_table_delta_sync", True, "Use the versioned node-table delta protocol: daemons/workers reconcile pubsub gaps from their version cursor (get_nodes_delta) and heartbeat replies carry only availability CHANGES since the daemon's cursor instead of the full O(nodes) view. Off = legacy full-snapshot reads everywhere (the bench_scale A/B lever).")
+_flag("simnode_count", 100, "Default simulated-node count for the scale harness (_private/simnode.py): protocol-faithful node-daemon speakers with no worker pools, hundreds per process, for control-plane scale testing.")
+_flag("simnode_seed", 0, "Seed for the simnode plane's deterministic node ids and jitter draws; 0 = fresh entropy.")
+
 # --- retry policy (shared by RPC calls, object fetch, lease requests) ---
 _flag("retry_base_s", 0.2, "Unified retry policy: first backoff delay (reference: retryable_grpc_client backoff base).")
 _flag("retry_max_s", 5.0, "Unified retry policy: backoff cap (decorrelated jitter draws in [base, prev*3] clipped here).")

@@ -45,6 +45,15 @@ class PubSub:
     Replaces the reference's long-poll publisher (src/ray/pubsub/publisher.h:357):
     the asyncio transport supports unsolicited server->client frames, so
     subscriptions are plain push registrations, no polling.
+
+    Scale plane: with `pubsub_flush_window_ms` > 0, notices buffer per
+    subscriber and ship as ONE batched frame per subscriber per window
+    (a 1000-node churn wave costs frames proportional to windows, not
+    events). The per-subscriber backlog is BOUNDED (`pubsub_max_backlog`):
+    a stalled subscriber sheds oldest-first with drops counted in
+    `rt_pubsub_dropped_total{channel=}`, and the shed shows up client-side
+    as a `_seq` gap that triggers a cursor reconcile — loss is loud and
+    recoverable, never an unbounded queue.
     """
 
     def __init__(self, server: RpcServer):
@@ -52,10 +61,16 @@ class PubSub:
         self._subs: Dict[str, Set[int]] = {}
         # per-channel monotonic publish sequence (gap detection): every
         # notice is stamped with `_seq`; subscribers track the last seq they
-        # saw and a reconnect whose subscribe-reply seq doesn't match runs a
-        # full table reconcile — a death published during a control-store
-        # failover window must not be silently lost.
+        # saw — a reconnect whose subscribe-reply seq doesn't match, or an
+        # in-stream seq jump (backlog shed), runs a table reconcile. A death
+        # published during a control-store failover window must not be
+        # silently lost.
         self.seq: Dict[str, int] = {}
+        # coalescing plane: conn_id -> pending (channel, message) deque
+        self._pending: Dict[int, collections.deque] = {}
+        self._flusher: Optional[asyncio.Task] = None
+        self.dropped: Dict[str, int] = {}
+        self._drop_counter = None
 
     def subscribe(self, conn_id: int, channel: str) -> None:
         self._subs.setdefault(channel, set()).add(conn_id)
@@ -66,14 +81,79 @@ class PubSub:
     def unsubscribe_conn(self, conn_id: int) -> None:
         for subs in self._subs.values():
             subs.discard(conn_id)
+        self._pending.pop(conn_id, None)
+
+    def _drop(self, channel: str, n: int = 1) -> None:
+        self.dropped[channel] = self.dropped.get(channel, 0) + n
+        if self._drop_counter is None:
+            from ray_tpu.util.metrics import get_or_create_counter
+
+            self._drop_counter = get_or_create_counter(
+                "rt_pubsub_dropped_total",
+                "Pubsub notices shed because a subscriber's bounded backlog "
+                "(pubsub_max_backlog) was full; the subscriber reconciles "
+                "from its cursor on the resulting _seq gap.",
+                tag_keys=("channel",))
+        self._drop_counter.inc(n, tags={"channel": channel})
 
     def publish(self, channel: str, message: Any) -> None:
-        self.seq[channel] = self.seq.get(channel, 0) + 1
+        self.seq[channel] = seq = self.seq.get(channel, 0) + 1
         if isinstance(message, dict):
-            message = {**message, "_seq": self.seq[channel]}
-        for conn_id in list(self._subs.get(channel, ())):
+            message = {**message, "_seq": seq}
+        subs = self._subs.get(channel)
+        if not subs:
+            return
+        backlog = GLOBAL_CONFIG.get("pubsub_max_backlog")
+        if GLOBAL_CONFIG.get("pubsub_flush_window_ms") > 0:
+            for conn_id in list(subs):
+                q = self._pending.setdefault(conn_id, collections.deque())
+                if len(q) >= backlog:
+                    # shed OLDEST: later node-table notices supersede
+                    # earlier ones, and the subscriber detects the hole by
+                    # _seq and reconciles from its delta cursor
+                    old_channel, _ = q.popleft()
+                    self._drop(old_channel)
+                q.append((channel, message))
+            self._ensure_flusher()
+            return
+        # immediate mode (legacy): one frame per event, but a stalled
+        # subscriber's transport buffer must not grow without bound — past
+        # ~1KiB * backlog of unsent bytes, shed instead of buffering
+        cap_bytes = backlog * 1024
+        for conn_id in list(subs):
+            if self._server.conn_buffer_size(conn_id) > cap_bytes:
+                self._drop(channel)
+                continue
             if not self._server.push(conn_id, channel, message):
-                self._subs[channel].discard(conn_id)
+                subs.discard(conn_id)
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None or self._flusher.done():
+            self._flusher = spawn(self._flush_loop())
+
+    async def _flush_loop(self):
+        window_s = GLOBAL_CONFIG.get("pubsub_flush_window_ms") / 1000.0
+        while self._pending:
+            await asyncio.sleep(max(window_s, 1e-4))
+            self.flush()
+
+    def flush(self) -> None:
+        """Ship every subscriber's pending batch as one frame. Subscribers
+        whose transport is still backed up keep their (bounded) backlog for
+        the next window instead of stacking bytes on a dead socket."""
+        cap_bytes = GLOBAL_CONFIG.get("pubsub_max_backlog") * 1024
+        for conn_id in list(self._pending):
+            q = self._pending.get(conn_id)
+            if not q:
+                self._pending.pop(conn_id, None)
+                continue
+            if self._server.conn_buffer_size(conn_id) > cap_bytes:
+                continue
+            items = list(q)
+            q.clear()
+            self._pending.pop(conn_id, None)
+            if not self._server.push_batch(conn_id, items):
+                self.unsubscribe_conn(conn_id)
 
 
 class ActorRecord:
@@ -249,6 +329,25 @@ class ControlStore:
         self.node_load: Dict[bytes, dict] = {}
         # per-node physical stats from heartbeats (dashboard reporter)
         self.node_stats: Dict[bytes, dict] = {}
+        # versioned node-table delta plane (the 1000-node fix): every node
+        # mutation bumps `_node_version` and appends the published wire to a
+        # bounded delta log, so subscribers reconcile from a cursor
+        # (get_nodes_delta) instead of re-reading the full table — O(missed
+        # changes), not O(nodes)
+        self._node_version = 0
+        self._node_deltas: collections.deque = collections.deque()
+        # availability-change log for heartbeat view deltas: the reply to a
+        # cursor-carrying heartbeat lists only nodes whose availability (or
+        # pending load) CHANGED since the daemon's cursor — the O(nodes)
+        # view+nodes payload per beat was the dominant steady-state cost
+        # at 1000 nodes (O(nodes^2) bytes per period cluster-wide)
+        self._avail_version = 0
+        self._avail_changes: collections.deque = collections.deque()
+        self._avail_floor = 0  # oldest version the change log still covers
+        # DEAD node records in death order: bounded by node_dead_retention
+        # (evictions persist a tombstone) so node churn cannot grow the
+        # table / WAL / snapshot / get_all_nodes payloads forever
+        self._dead_order: collections.deque = collections.deque()
         self._health_task: Optional[asyncio.Task] = None
         self._stopped = False
         self._wal = None
@@ -346,6 +445,10 @@ class ControlStore:
             prec = self.placement_groups.get(d["pg_id"])
             if prec is not None:
                 prec.apply_update(d)
+        elif op == "node_del":
+            # dead-node retention tombstone: the record was pruned while
+            # this WAL segment was live — don't resurrect it
+            self.nodes.pop(d["node_id"], None)
 
     def _recover(self):
         snap, wal_records = self._wal.recover()
@@ -365,6 +468,15 @@ class ControlStore:
                 # the "unknown" reply) or the health loop declares it dead
                 self.node_last_beat[nid] = now
                 self.node_available[nid] = info.resources
+                self._bump_avail(nid)
+        # rebuild the dead-node retention order (death-ts order) so churn
+        # pruning keeps working across a restart
+        self._dead_order.extend(sorted(
+            (nid for nid, info in self.nodes.items()
+             if info.state == pb.NODE_DEAD),
+            key=lambda nid: (self.nodes[nid].death.ts
+                             if self.nodes[nid].death else 0.0),
+        ))
         for aid, rec in self.actors.items():
             if rec.name:
                 self.named_actors[(rec.spec.runtime_env.get("namespace", ""), rec.name)] = aid
@@ -405,6 +517,91 @@ class ControlStore:
     def _on_disconnect(self, conn_id: int) -> None:
         self.pubsub.unsubscribe_conn(conn_id)
 
+    # ------------------------------------------------------------------
+    # versioned node-table deltas (scale plane)
+    # ------------------------------------------------------------------
+
+    def _record_node_delta(self, info: NodeInfo) -> dict:
+        """Stamp a node mutation into the bounded delta log; returns the
+        wire dict (carrying `_v`) that both the pubsub notice and any
+        cursor reconcile will see — one ordered history, two transports."""
+        self._node_version += 1
+        wire = info.to_wire()
+        wire["_v"] = self._node_version
+        self._node_deltas.append((self._node_version, wire))
+        retention = GLOBAL_CONFIG.get("node_delta_retention")
+        while len(self._node_deltas) > retention:
+            self._node_deltas.popleft()
+        return wire
+
+    def _bump_avail(self, node_id: bytes) -> None:
+        self._avail_version += 1
+        self._avail_changes.append((self._avail_version, node_id))
+        retention = GLOBAL_CONFIG.get("node_delta_retention")
+        while len(self._avail_changes) > retention:
+            ver, _ = self._avail_changes.popleft()
+            self._avail_floor = ver
+
+    def _view_reply(self, cursor: int) -> dict:
+        """Availability view since `cursor` (the daemon's last-seen
+        `view_version`): changed entries + removals, or one full snapshot
+        when the cursor predates the change log."""
+        reply: dict = {
+            "view_version": self._avail_version,
+            "nodes_version": self._node_version,
+        }
+        changed = self._changed_nodes_since(cursor)
+        if changed is None:
+            reply["view_full"] = {
+                self.nodes[n].node_id.hex(): a.to_wire()
+                for n, a in self.node_available.items()
+                if n in self.nodes and self.nodes[n].state == pb.NODE_ALIVE
+            }
+            return reply
+        delta: Dict[str, dict] = {}
+        removed: List[str] = []
+        for nid in changed:
+            info = self.nodes.get(nid)
+            avail = self.node_available.get(nid)
+            if info is None or info.state != pb.NODE_ALIVE or avail is None:
+                removed.append(nid.hex())
+            else:
+                delta[info.node_id.hex()] = avail.to_wire()
+        if delta:
+            reply["view_delta"] = delta
+        if removed:
+            reply["view_removed"] = removed
+        return reply
+
+    def _changed_nodes_since(self, cursor: int) -> Optional[Set[bytes]]:
+        """Node ids whose availability/load changed since `cursor`, scanned
+        newest-first so the cost is O(changes since cursor), not O(log).
+        None = the cursor predates the change log — or postdates our
+        counter (restarted store) — so the caller must send full."""
+        if (cursor < self._avail_floor or cursor < 0
+                or cursor > self._avail_version):
+            return None
+        changed: Set[bytes] = set()
+        for ver, nid in reversed(self._avail_changes):
+            if ver <= cursor:
+                break
+            changed.add(nid)
+        return changed
+
+    def _prune_dead_nodes(self) -> None:
+        retention = GLOBAL_CONFIG.get("node_dead_retention")
+        while len(self._dead_order) > retention:
+            old = self._dead_order.popleft()
+            info = self.nodes.get(old)
+            if info is None or info.state != pb.NODE_DEAD:
+                continue
+            self.nodes.pop(old, None)
+            self.node_last_beat.pop(old, None)
+            self.drained_replicas.pop(old, None)
+            # tombstone so a recovered store doesn't resurrect the record
+            # from an earlier WAL "node" entry
+            self._persist("node_del", {"node_id": old})
+
     async def _daemon(self, node_id: bytes) -> RpcClient:
         client = self._daemon_clients.get(node_id)
         if client is None:
@@ -421,10 +618,20 @@ class ControlStore:
     async def _health_loop(self):
         period = GLOBAL_CONFIG.get("health_check_period_s")
         timeout = GLOBAL_CONFIG.get("health_check_timeout_s")
+        shard = 0
         while not self._stopped:
-            await asyncio.sleep(period)
+            # sharded scan: large clusters split the liveness sweep across
+            # the period (one shard per tick) so expiry processing — death
+            # marking, pubsub fanout, actor failover — never lands as one
+            # 1000-node burst on a single event-loop tick. Each node is
+            # still visited about once per period.
+            nshards = max(1, min(8, (len(self.node_last_beat) + 127) // 128))
+            await asyncio.sleep(period / nshards)
+            shard = (shard + 1) % nshards
             now = time.monotonic()
             for node_id, last in list(self.node_last_beat.items()):
+                if nshards > 1 and node_id and node_id[0] % nshards != shard:
+                    continue
                 info = self.nodes.get(node_id)
                 if info is None or info.state == pb.NODE_DEAD:
                     continue
@@ -463,13 +670,17 @@ class ControlStore:
         self._event("node", "DEAD", reason, node_id=info.node_id.hex(),
                     expected=expected)
         self._persist("node", info.to_wire())
-        notice = info.to_wire()
+        self._bump_avail(node_id)  # cursor readers see the removal
+        notice = self._record_node_delta(info)
         replicas = self.drained_replicas.get(node_id)
         if expected and replicas:
             # expected death with pre-replicated primaries: the notice tells
             # owners exactly where each copy went, so readers fail over with
-            # zero reconstructions
+            # zero reconstructions (the delta-log entry carries them too —
+            # a cursor reconcile must see the same story as the stream)
             notice["replicas"] = replicas
+        self._dead_order.append(node_id)
+        self._prune_dead_nodes()
         self.pubsub.publish("nodes", notice)
         # Fail over actors that lived on the node. An EXPECTED death should
         # find none (drain migrated them) — any straggler restarts without
@@ -517,11 +728,19 @@ class ControlStore:
         self._event("node", "REGISTERED", info.address,
                     node_id=info.node_id.hex(),
                     resources=info.resources.to_dict())
-        self.pubsub.publish("nodes", info.to_wire())
+        self._bump_avail(info.node_id.binary())
+        self.pubsub.publish("nodes", self._record_node_delta(info))
+        if payload.get("lean"):
+            # scale mode: the joiner pulls the membership snapshot once via
+            # get_nodes_delta(cursor=-1) instead of every register reply
+            # shipping the full table — a 1000-node register storm would
+            # otherwise serialize O(nodes^2) wires here
+            return {"ok": True, "version": self._node_version}
         # seed the joiner with the existing membership (it only receives
         # pushes for changes after its subscription)
         return {
             "ok": True,
+            "version": self._node_version,
             "nodes": [
                 n.to_wire() for n in self.nodes.values()
                 if n.state == pb.NODE_ALIVE
@@ -537,7 +756,11 @@ class ControlStore:
             return {"unknown": True}
         self.node_last_beat[node_id] = time.monotonic()
         if "available" in payload:
-            self.node_available[node_id] = ResourceSet.from_wire(payload["available"])
+            new_avail = ResourceSet.from_wire(payload["available"])
+            old_avail = self.node_available.get(node_id)
+            if old_avail is None or old_avail.to_wire() != new_avail.to_wire():
+                self._bump_avail(node_id)
+            self.node_available[node_id] = new_avail
         if "stats" in payload:
             # per-node psutil/store snapshot for the dashboard (reference:
             # the reporter agent publishing node physical stats)
@@ -546,11 +769,24 @@ class ControlStore:
             }
         # demand signal for the autoscaler (reference: raylets report load in
         # resource-view sync; GcsAutoscalerStateManager aggregates it)
+        old_load = self.node_load.get(node_id)
+        new_pending = payload.get("pending", 0)
+        if old_load is None or old_load.get("pending") != new_pending:
+            # pending-load changes version the node for cursor readers too
+            # (the autoscaler's idle/demand rows key off pending + avail)
+            self._bump_avail(node_id)
         self.node_load[node_id] = {
-            "pending": payload.get("pending", 0),
+            "pending": new_pending,
             "pending_resources": payload.get("pending_resources", []),
             "ts": time.monotonic(),
         }
+        cursor = payload.get("view_cursor")
+        if cursor is not None and GLOBAL_CONFIG.get("node_table_delta_sync"):
+            # scale mode: the reply carries only availability CHANGES since
+            # the daemon's cursor (plus the node-table version so the daemon
+            # knows when to pull membership deltas) — the full O(nodes)
+            # view+nodes payload per beat is what melts at 1000 nodes
+            return self._view_reply(int(cursor))
         # Reply carries the cluster resource view — the gossip function of
         # ray_syncer (src/ray/ray_syncer/ray_syncer.h:91) piggybacked on the
         # health-check beat.
@@ -574,6 +810,23 @@ class ControlStore:
         """Aggregate demand + per-node idleness for the autoscaler
         (reference: AutoscalerStateService GetClusterResourceState,
         autoscaler.proto:413)."""
+        # cursor readers (the autoscaler's poll) get rows only for nodes
+        # whose availability/load changed since their last poll + a removed
+        # list, instead of the full O(nodes) row set every tick; aggregate
+        # demand (small) is always fresh
+        cursor = (payload or {}).get("cursor") if isinstance(payload, dict) \
+            else None
+        changed: Optional[Set[bytes]] = None
+        removed: List[str] = []
+        if cursor is not None and GLOBAL_CONFIG.get("node_table_delta_sync"):
+            changed = self._changed_nodes_since(int(cursor))
+            if changed is not None:
+                removed = [
+                    nid.hex() for nid in changed
+                    if (self.nodes.get(nid) is None
+                        or self.nodes[nid].state not in (pb.NODE_ALIVE,
+                                                         pb.NODE_DRAINING))
+                ]
         nodes = []
         pending_total = 0
         pending_resources: List[dict] = []
@@ -584,6 +837,8 @@ class ControlStore:
             avail = self.node_available.get(nid)
             pending_total += load.get("pending", 0)
             pending_resources.extend(load.get("pending_resources", []))
+            if changed is not None and nid not in changed:
+                continue
             nodes.append({
                 "node_id": info.node_id.hex(),
                 "state": info.state,
@@ -608,12 +863,17 @@ class ControlStore:
                     "strategy": rec.strategy,
                     "labels": dict(rec.label_selector or {}),
                 })
-        return {
+        reply = {
             "pending_total": pending_total,
             "pending_resources": pending_resources,
             "pending_pg_bundles": pending_pg_bundles,
             "nodes": nodes,
+            "version": self._avail_version,
         }
+        if changed is not None:
+            reply["delta"] = True
+            reply["removed"] = removed
+        return reply
 
     async def rpc_get_resource_view(self, conn_id: int, payload) -> dict:
         return {
@@ -624,7 +884,7 @@ class ControlStore:
             }
         }
 
-    async def rpc_get_all_nodes(self, conn_id: int, payload) -> dict:
+    def _node_wires(self) -> List[dict]:
         # expectedly-dead drained nodes carry their replica map so a gap
         # reconcile (missed death notice during failover) still fails
         # readers over instead of reconstructing
@@ -635,7 +895,44 @@ class ControlStore:
             if reps and n.state == pb.NODE_DEAD and n.death and n.death.expected:
                 wire["replicas"] = reps
             out.append(wire)
-        return {"nodes": out}
+        return out
+
+    async def rpc_get_all_nodes(self, conn_id: int, payload) -> dict:
+        out = self._node_wires()
+        reply: dict = {"version": self._node_version, "total": len(out)}
+        limit = (payload or {}).get("limit")
+        if limit is not None:
+            # paginated read (dashboard at 1000 nodes): one page per call
+            # instead of the whole table serialized per poll
+            offset = max(0, int((payload or {}).get("offset", 0)))
+            out = out[offset:offset + max(0, int(limit))]
+            reply["offset"] = offset
+        reply["nodes"] = out
+        return reply
+
+    async def rpc_get_nodes_delta(self, conn_id: int, payload) -> dict:
+        """Cursor reconcile for node-table subscribers: every mutation since
+        `cursor` in publish order, or one full snapshot when the cursor
+        predates the bounded delta log (retention: node_delta_retention).
+        The wires are the SAME dicts the "nodes" pubsub published (incl.
+        `_v` and expected-death replica maps) — a subscriber that missed
+        notices replays exactly what it missed."""
+        cursor = int((payload or {}).get("cursor", -1))
+        if cursor == self._node_version:
+            return {"version": self._node_version, "updates": []}
+        if (cursor < 0 or cursor > self._node_version
+                or not self._node_deltas
+                or cursor < self._node_deltas[0][0] - 1):
+            # cursor predates the retained log — or POSTDATES our counter
+            # (this store restarted and reset its versions; the client's
+            # cursor is from a previous incarnation): full snapshot either
+            # way, and the client RESETS its cursor to our version
+            return {"version": self._node_version, "full": True,
+                    "nodes": self._node_wires()}
+        return {
+            "version": self._node_version,
+            "updates": [w for ver, w in self._node_deltas if ver > cursor],
+        }
 
     async def rpc_get_node_stats(self, conn_id: int, payload) -> dict:
         """Per-node physical stats from heartbeats (reference: the reporter
@@ -667,7 +964,8 @@ class ControlStore:
                     node_id=info.node_id.hex(), reason=reason,
                     deadline_s=deadline_s)
         self._persist("node", info.to_wire())
-        self.pubsub.publish("nodes", info.to_wire())
+        self._bump_avail(node_id)  # draining nodes leave the scheduling view
+        self.pubsub.publish("nodes", self._record_node_delta(info))
         if deadline_s:
             # terminal drain (preemption/manual removal): migrate resident
             # actors NOW so they restart warm elsewhere instead of crash-
@@ -744,7 +1042,8 @@ class ControlStore:
         info.drain_deadline = 0.0
         self.drained_replicas.pop(node_id, None)
         self._persist("node", info.to_wire())
-        self.pubsub.publish("nodes", info.to_wire())
+        self._bump_avail(node_id)
+        self.pubsub.publish("nodes", self._record_node_delta(info))
         return {"ok": True}
 
     async def rpc_unregister_node(self, conn_id: int, payload: dict) -> dict:
@@ -944,8 +1243,24 @@ class ControlStore:
         self.pubsub.subscribe(conn_id, channel)
         # reply carries the channel's current publish seq: a resubscribing
         # client whose last-seen seq doesn't match knows it missed notices
-        # (or that the store restarted with fresh counters) and reconciles
-        return {"ok": True, "seq": self.pubsub.channel_seq(channel)}
+        # (or that the store restarted with fresh counters) and reconciles.
+        # For the node table the reply also carries the version cursor so
+        # the reconcile can be a delta pull, not a full snapshot.
+        reply = {"ok": True, "seq": self.pubsub.channel_seq(channel)}
+        if channel == "nodes":
+            reply["version"] = self._node_version
+        return reply
+
+    async def rpc_pubsub_stats(self, conn_id: int, payload) -> dict:
+        """Observability for the fanout plane (bench_scale + tests): per-
+        channel publish seq and shed counts."""
+        return {
+            "seq": dict(self.pubsub.seq),
+            "dropped": dict(self.pubsub.dropped),
+            "subscribers": {
+                ch: len(subs) for ch, subs in self.pubsub._subs.items()
+            },
+        }
 
     async def rpc_publish(self, conn_id: int, payload: dict) -> dict:
         self.pubsub.publish(payload["channel"], payload["message"])
@@ -1182,6 +1497,8 @@ class ControlStore:
         for nid, info in self.nodes.items():
             if info.state != pb.NODE_ALIVE or nid in exclude:
                 continue
+            if pb.is_sim_node(info.labels):
+                continue  # scale-harness nodes never take real actors
             if strategy.label_selector:
                 if not pb.labels_match(info.labels, strategy.label_selector):
                     continue
@@ -1318,6 +1635,7 @@ class ControlStore:
             nid: ResourceSet.from_wire(a.to_wire())
             for nid, a in self.node_available.items()
             if nid in self.nodes and self.nodes[nid].state == pb.NODE_ALIVE
+            and not pb.is_sim_node(self.nodes[nid].labels)
             and pb.labels_match(self.nodes[nid].labels, rec.label_selector)
         }
         placements: Dict[int, bytes] = {}
@@ -1549,20 +1867,32 @@ class ControlStore:
                 "metrics": series,
             }
         # prune reporters that stopped (died/reaped) — without this the
-        # table grows per reporter ever seen and exports stale gauges
-        stale = time.time() - 60.0
-        for w in [w for w, s in self.metrics_by_worker.items()
-                  if s["ts"] < stale]:
-            del self.metrics_by_worker[w]
+        # table grows per reporter ever seen and exports stale gauges.
+        # Throttled: at 1000 nodes a per-report scan of every reporter
+        # would make ingestion O(reporters^2) per flush period.
+        now = time.time()
+        if now - getattr(self, "_metrics_prune_ts", 0.0) > 5.0:
+            self._metrics_prune_ts = now
+            stale = now - 60.0
+            for w in [w for w, s in self.metrics_by_worker.items()
+                      if s["ts"] < stale]:
+                del self.metrics_by_worker[w]
         return {"ok": True}
 
     async def rpc_get_metrics(self, conn_id: int, payload) -> dict:
-        return {"workers": {
+        from ray_tpu.util.metrics import snapshot_all
+
+        out = {
             w: {"ts": s["ts"],
                 "metrics": (list(s["acc"].values()) if "acc" in s
                             else s.get("metrics", []))}
             for w, s in self.metrics_by_worker.items()
-        }}
+        }
+        # the store's OWN series (pubsub shed counters etc.) join the scrape
+        # under a reserved reporter key — no reporter loop ships them
+        out["__control_store__"] = {"ts": time.time(),
+                                    "metrics": snapshot_all()}
+        return {"workers": out}
 
     async def rpc_dump_flight_recorder(self, conn_id: int, payload) -> dict:
         return flight_recorder.dump()

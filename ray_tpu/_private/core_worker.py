@@ -568,6 +568,15 @@ class CoreWorker:
         self._channel_seq: Dict[str, Optional[int]] = {
             "nodes": None, "workers": None,
         }
+        # node-table version cursor (scale plane): reconciles after a seq
+        # gap — including IN-STREAM jumps from the store's bounded-backlog
+        # shedding — pull get_nodes_delta(cursor) instead of the full table
+        self._node_table_version = -1
+        self._gap_reconcile_task = None
+        # pre-gap cursor pinned at gap-detection time (the reconcile task
+        # runs deferred; by then the cursor has advanced past the shed
+        # window); also re-armed by gaps landing while a reconcile flies
+        self._nodes_reconcile_from: Optional[int] = None
         # granted-but-idle worker leases by scheduling key, reused by the
         # next same-shaped task (reference: normal_task_submitter lease
         # pools). Each entry: {"idle": [lease...], "waiters": deque[Future]}.
@@ -680,7 +689,29 @@ class CoreWorker:
         seq = message.get("_seq")
         if seq is not None:
             last = self._channel_seq.get(channel)
+            if last is not None and seq > last + 1:
+                # in-stream publish gap: the store shed notices to us
+                # (bounded per-subscriber backlog) — death records may be
+                # among the missing, so reconcile now, not at reconnect
+                logger.info("%s-channel in-stream gap (%d -> %d); "
+                            "reconciling death records", channel, last, seq)
+                if channel == "nodes":
+                    # pin the reconcile cursor to the PRE-gap version NOW:
+                    # the reconcile task runs deferred, and by then the
+                    # gap-revealing notice's _v (past the shed window) has
+                    # already advanced _node_table_version — a pull from
+                    # there would replay nothing
+                    if (self._nodes_reconcile_from is None
+                            or self._node_table_version
+                            < self._nodes_reconcile_from):
+                        self._nodes_reconcile_from = self._node_table_version
+                self._spawn_gap_reconcile()
             self._channel_seq[channel] = seq if last is None else max(last, seq)
+
+    def _spawn_gap_reconcile(self) -> None:
+        if (self._gap_reconcile_task is None
+                or self._gap_reconcile_task.done()):
+            self._gap_reconcile_task = spawn(self._reconcile_death_records())
 
     async def _subscribe_notices(self, resync: bool = False):
         """Subscribe to the node/worker death channels with gap detection:
@@ -713,24 +744,50 @@ class CoreWorker:
     async def _reconcile_death_records(self) -> bool:
         """Replay the authoritative node/worker death tables through the
         same notice handlers the pubsub stream feeds (both are idempotent):
-        nothing recorded during a subscription gap stays unseen."""
-        try:
-            nodes = (await self.control.call(
-                "get_all_nodes", {})).get("nodes", [])
-            for nw in nodes:
-                self._on_node_notice(nw)
-            dead = (await self.control.call(
-                "list_dead_workers", {})).get("workers", [])
-            for rec in dead:
-                self._on_worker_notice(rec)
-            logger.info(
-                "reconciled death records after pubsub gap: %d node(s), "
-                "%d dead worker record(s)", len(nodes), len(dead))
-            return True
-        except Exception:  # noqa: BLE001 — control store mid-failover; the
-            # next reconnect retries the reconcile
-            logger.warning("death-record reconcile failed", exc_info=True)
-            return False
+        nothing recorded during a subscription gap stays unseen. Loops
+        while fresh gap signals land mid-flight — a reply generated before
+        a second shed cannot contain it, and dropping that signal on the
+        single-flight guard would lose the window permanently."""
+        while True:
+            floor = self._nodes_reconcile_from
+            self._nodes_reconcile_from = None
+            try:
+                if GLOBAL_CONFIG.get("node_table_delta_sync"):
+                    # cursor pull: exactly the node mutations published
+                    # since the pre-gap cursor (same wires the stream
+                    # carries, expected-death replica maps included) —
+                    # O(missed), not O(nodes)
+                    reply = await self.control.call(
+                        "get_nodes_delta",
+                        {"cursor": floor if floor is not None
+                         else self._node_table_version})
+                    nodes = reply.get("updates") or reply.get("nodes") or []
+                    version = reply.get("version")
+                else:
+                    nodes = (await self.control.call(
+                        "get_all_nodes", {})).get("nodes", [])
+                    version = None
+                for nw in nodes:
+                    self._apply_node_notice(nw)
+                if version is not None:
+                    # authoritative assignment AFTER the apply: brings the
+                    # cursor back DOWN after a store restart's counter
+                    # reset (the stream path's monotonic guard never would)
+                    self._node_table_version = version
+                dead = (await self.control.call(
+                    "list_dead_workers", {})).get("workers", [])
+                for rec in dead:
+                    self._on_worker_notice(rec)
+                logger.info(
+                    "reconciled death records after pubsub gap: %d node(s), "
+                    "%d dead worker record(s)", len(nodes), len(dead))
+            except Exception:  # noqa: BLE001 — control store mid-failover;
+                # the next reconnect retries the reconcile
+                logger.warning("death-record reconcile failed",
+                               exc_info=True)
+                return False
+            if self._nodes_reconcile_from is None:
+                return True
 
     def _on_node_notice(self, message: dict):
         """Control-store "nodes" pubsub: a DEAD notice is the authoritative
@@ -740,6 +797,18 @@ class CoreWorker:
         notice reroutes future submissions away immediately so no task
         retry is burned against a node that will refuse the lease."""
         self._note_channel_seq("nodes", message)
+        ver = message.get("_v")
+        if ver is not None:
+            if ver <= self._node_table_version:
+                # stale replay: the store's coalescing window can deliver
+                # a notice AFTER the reconcile reply that already covered
+                # it. A restarted store's lower counter is reset by the
+                # reconcile's authoritative post-apply assignment.
+                return
+            self._node_table_version = ver
+        self._apply_node_notice(message)
+
+    def _apply_node_notice(self, message: dict):
         self._fan_out_node_notice(message)
         state = message.get("state")
         daemon_addr = message.get("address", "")

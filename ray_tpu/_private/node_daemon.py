@@ -217,6 +217,18 @@ class NodeDaemon:
         # subscriber-side pubsub gap detection: last publish seq seen on the
         # "nodes" channel (control_store stamps every notice with _seq)
         self._nodes_seq: Optional[int] = None
+        # node-table version cursor (scale plane): the max `_v` applied from
+        # notices/deltas — reconciles pull get_nodes_delta(cursor) instead
+        # of the full table, and an IN-STREAM seq jump (bounded-backlog shed
+        # at the store) triggers the same cheap reconcile
+        self._node_table_version = -1
+        self._view_cursor = -1  # availability-view version (heartbeat delta)
+        self._nodes_reconcile_task: Optional[asyncio.Task] = None
+        # pre-gap cursor pinned at gap-detection time (the reconcile task
+        # runs deferred; by then the gap-revealing notice's _v has advanced
+        # the cursor past the shed window and a pull would replay nothing);
+        # also re-armed by gaps landing while a reconcile is in flight
+        self._nodes_reconcile_from: Optional[int] = None
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
         # per-node metric pre-aggregation (reference: the per-node metrics
         # agent): workers ship DELTAS here; this daemon merges them into one
@@ -281,6 +293,10 @@ class NodeDaemon:
             lambda: self._subscribe_nodes(resync=True)
         )
         reg = await self.control.call("register_node", {"node": info.to_wire()})
+        if reg.get("version") is not None:
+            # the seed reply reflects the table at this version: start the
+            # delta cursor here so the first reconcile is incremental
+            self._node_table_version = reg["version"]
         for nw in reg.get("nodes", []):
             self._on_node_update(nw)
         self._tasks.append(spawn(self._heartbeat_loop()))
@@ -379,16 +395,10 @@ class NodeDaemon:
         if gap:
             logger.info("nodes-channel gap detected (last seen %s, server "
                         "at %s); reconciling node table", last_seen, server_seq)
-            try:
-                nodes = (await self.control.call(
-                    "get_all_nodes", {})).get("nodes", [])
-            except Exception:  # noqa: BLE001 — store still mid-failover:
+            if not await self._reconcile_nodes():
                 # keep the old last-seen seq so the next reconnect
                 # re-detects this gap instead of marking it seen
-                logger.warning("node-table reconcile failed", exc_info=True)
                 return
-            for nw in nodes:
-                self._on_node_update(nw)
         if server_seq is not None:
             # RESET the baseline to the server's seq (don't max): a store
             # restart resets its counters, and a sticky high-water mark
@@ -396,10 +406,95 @@ class NodeDaemon:
             # reconcile — on every reconnect until the new counter caught up
             self._nodes_seq = server_seq
 
+    def _spawn_nodes_reconcile(self) -> None:
+        """One reconcile in flight at a time — a burst of gap signals
+        (every shed notice of a churn wave) coalesces into one pull."""
+        if (self._nodes_reconcile_task is None
+                or self._nodes_reconcile_task.done()):
+            self._nodes_reconcile_task = spawn(self._reconcile_nodes())
+
+    async def _reconcile_nodes(self) -> bool:
+        """Replay node-table mutations missed on the pubsub stream. With
+        delta sync on this pulls get_nodes_delta(cursor) — O(missed
+        changes); the wires are the exact notices the stream would have
+        delivered (same `_v`/replica payloads), applied through the same
+        handler. Falls back to the full table otherwise. Loops while
+        fresh gap signals land mid-flight — a reply generated before a
+        second shed cannot contain it."""
+        while True:
+            floor = self._nodes_reconcile_from
+            self._nodes_reconcile_from = None
+            try:
+                full = True
+                if GLOBAL_CONFIG.get("node_table_delta_sync"):
+                    reply = await self.control.call(
+                        "get_nodes_delta",
+                        {"cursor": floor if floor is not None
+                         else self._node_table_version})
+                    full = bool(reply.get("full"))
+                    nodes = reply.get("updates") or reply.get("nodes") or []
+                    version = reply.get("version")
+                else:
+                    version = None
+                    nodes = (await self.control.call(
+                        "get_all_nodes", {})).get("nodes", [])
+                for nw in nodes:
+                    self._apply_node_update(nw)
+                if full:
+                    # a full snapshot is authoritative membership: peers
+                    # absent from it (dead + already pruned from the
+                    # store's retention window) must not linger in the
+                    # scheduling view
+                    present = {NodeInfo.from_wire(nw).node_id.hex()
+                               for nw in nodes}
+                    for hexid in list(self.peer_nodes):
+                        if hexid not in present:
+                            self.peer_nodes.pop(hexid, None)
+                            self.cluster_view.pop(hexid, None)
+                            self._view_seq.pop(hexid, None)
+                if version is not None:
+                    # authoritative assignment AFTER the apply: brings the
+                    # cursor back DOWN after a store restart's counter
+                    # reset (the stream path's monotonic guard never would)
+                    self._node_table_version = version
+            except Exception:  # noqa: BLE001 — store still mid-failover:
+                # the next gap signal / reconnect retries
+                logger.warning("node-table reconcile failed", exc_info=True)
+                return False
+            if self._nodes_reconcile_from is None:
+                return True
+
     def _on_node_update(self, message: dict):
         seq = message.get("_seq")
         if seq is not None:
+            if self._nodes_seq is not None and seq > self._nodes_seq + 1:
+                # in-stream publish gap: the store shed notices to us (its
+                # bounded per-subscriber backlog) — reconcile from the
+                # PRE-gap cursor, pinned NOW: this very message's _v will
+                # advance the cursor past the shed window before the
+                # deferred reconcile task runs
+                logger.info("nodes-channel in-stream gap (%d -> %d); "
+                            "reconciling", self._nodes_seq, seq)
+                if (self._nodes_reconcile_from is None
+                        or self._node_table_version
+                        < self._nodes_reconcile_from):
+                    self._nodes_reconcile_from = self._node_table_version
+                self._spawn_nodes_reconcile()
             self._nodes_seq = max(self._nodes_seq or 0, seq)
+        ver = message.get("_v")
+        if ver is not None:
+            if ver <= self._node_table_version:
+                # stale replay: the store's coalescing window can deliver
+                # a notice AFTER the reconcile reply that already covered
+                # it — applying would resurrect superseded state (e.g. a
+                # DEAD peer back to DRAINING). A restarted store's lower
+                # counter is reset by _reconcile_nodes' authoritative
+                # post-apply assignment, so skipping here can't wedge.
+                return
+            self._node_table_version = ver
+        self._apply_node_update(message)
+
+    def _apply_node_update(self, message: dict):
         info = NodeInfo.from_wire(message)
         hexid = info.node_id.hex()
         if hexid == self.node_id.hex():
@@ -514,7 +609,12 @@ class NodeDaemon:
                     continue
 
     async def _heartbeat_loop(self):
-        period = GLOBAL_CONFIG.get("health_check_period_s")
+        import random as _random
+
+        period = (GLOBAL_CONFIG.get("heartbeat_period_s")
+                  or GLOBAL_CONFIG.get("health_check_period_s"))
+        jitter = GLOBAL_CONFIG.get("heartbeat_jitter")
+        delta_sync = GLOBAL_CONFIG.get("node_table_delta_sync")
         while not self._stopped:
             try:
                 pending_leases = [
@@ -526,25 +626,29 @@ class NodeDaemon:
                     if now - t < 5.0
                 }
                 beat_started = time.monotonic()
+                payload = {
+                    "node_id": self.node_id.binary(),
+                    "available": self.available.to_wire(),
+                    # per-node physical stats for the dashboard/state API
+                    # (reference: the per-node dashboard agent's psutil
+                    # reporter, dashboard/modules/reporter/)
+                    "stats": self._node_stats(),
+                    # scheduling load → autoscaler demand (reference:
+                    # raylet resource-view sync carries load). Infeasible
+                    # shapes count too: no live node can host them, but
+                    # the autoscaler may be able to provision one.
+                    "pending": len(pending_leases) + len(self._infeasible_seen),
+                    "pending_resources": [
+                        p.spec_resources.to_wire()
+                        for p in pending_leases[:32]
+                    ] + [dict(k) for k in list(self._infeasible_seen)[:8]],
+                }
+                if delta_sync:
+                    # scale mode: present the availability cursor — the
+                    # reply carries only CHANGES, not the O(nodes) view
+                    payload["view_cursor"] = self._view_cursor
                 reply = await self.control.call(
-                    "heartbeat",
-                    {
-                        "node_id": self.node_id.binary(),
-                        "available": self.available.to_wire(),
-                        # per-node physical stats for the dashboard/state API
-                        # (reference: the per-node dashboard agent's psutil
-                        # reporter, dashboard/modules/reporter/)
-                        "stats": self._node_stats(),
-                        # scheduling load → autoscaler demand (reference:
-                        # raylet resource-view sync carries load). Infeasible
-                        # shapes count too: no live node can host them, but
-                        # the autoscaler may be able to provision one.
-                        "pending": len(pending_leases) + len(self._infeasible_seen),
-                        "pending_resources": [
-                            p.spec_resources.to_wire()
-                            for p in pending_leases[:32]
-                        ] + [dict(k) for k in list(self._infeasible_seen)[:8]],
-                    },
+                    "heartbeat", payload,
                     # short deadline: a dropped beat must not silence this
                     # node long enough to trip health_check_timeout_s
                     timeout=period * 2,
@@ -556,10 +660,13 @@ class NodeDaemon:
                         "register_node", {"node": self._node_info.to_wire()}
                     )
                     continue
-                self.cluster_view = {
-                    nid: ResourceSet.from_wire(w)
-                    for nid, w in reply.get("view", {}).items()
-                }
+                if "view_version" in reply:
+                    self._apply_view_reply(reply)
+                else:
+                    self.cluster_view = {
+                        nid: ResourceSet.from_wire(w)
+                        for nid, w in reply.get("view", {}).items()
+                    }
                 for nw in reply.get("nodes", []):
                     info = NodeInfo.from_wire(nw)
                     self.peer_nodes[info.node_id.hex()] = info
@@ -571,7 +678,34 @@ class NodeDaemon:
                 self._try_schedule()
             except Exception as e:  # noqa: BLE001
                 logger.warning("heartbeat failed: %s", e)
-            await asyncio.sleep(period)
+            # jittered sleep: a register storm phase-aligns every daemon's
+            # beat; de-phasing keeps 1000 heartbeats from landing on the
+            # same control-store event-loop tick
+            await asyncio.sleep(
+                period * (1.0 + jitter * _random.uniform(-1.0, 1.0)))
+
+    def _apply_view_reply(self, reply: dict) -> None:
+        """Fold a cursor heartbeat reply into the scheduling view: changed
+        availabilities replace, removed nodes drop, a full snapshot (cursor
+        behind the store's change log) rebuilds."""
+        full = reply.get("view_full")
+        if full is not None:
+            self.cluster_view = {
+                nid: ResourceSet.from_wire(w) for nid, w in full.items()
+            }
+        else:
+            for nid, w in (reply.get("view_delta") or {}).items():
+                self.cluster_view[nid] = ResourceSet.from_wire(w)
+            for nid in reply.get("view_removed") or ():
+                self.cluster_view.pop(nid, None)
+        self._view_cursor = reply["view_version"]
+        nodes_version = reply.get("nodes_version")
+        if (nodes_version is not None
+                and nodes_version != self._node_table_version):
+            # membership moved while our pubsub stream was quiet (or shed,
+            # or the store restarted and reset its counter): pull the
+            # missed mutations from the cursor
+            self._spawn_nodes_reconcile()
 
     async def _reap_loop(self):
         """Poll worker processes for death; reap idle surplus."""
@@ -1004,6 +1138,8 @@ class NodeDaemon:
             if exclude_self and nid == my_hex:
                 continue
             info = self.peer_nodes.get(nid)
+            if info is not None and pb.is_sim_node(info.labels):
+                continue  # scale-harness nodes never take real work
             if strategy.label_selector:
                 # reference: node_label_scheduling_policy.h:25 — plain
                 # tasks select nodes by label. SELF is checked against
@@ -1046,6 +1182,7 @@ class NodeDaemon:
             return True
         for nid, info in self.peer_nodes.items():
             if (info.state == pb.NODE_ALIVE
+                    and not pb.is_sim_node(info.labels)
                     and self._labels_match(info.labels, selector)
                     and res.is_subset_of(info.resources)):
                 return True
@@ -1084,6 +1221,8 @@ class NodeDaemon:
                     info = self.peer_nodes.get(nid)
                     if info is None or info.state != pb.NODE_ALIVE:
                         continue
+                    if pb.is_sim_node(info.labels):
+                        continue  # scripted grants must not take real work
                     if not self._labels_match(
                             info.labels, p.strategy.label_selector):
                         continue

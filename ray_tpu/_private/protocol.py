@@ -128,6 +128,18 @@ def labels_match(labels: Optional[Dict[str, str]],
     return True
 
 
+SIM_NODE_LABEL = "simnode"
+
+
+def is_sim_node(labels: Optional[Dict[str, str]]) -> bool:
+    """Simulated nodes (the scale harness, _private/simnode.py) are
+    control-plane-only: they register/heartbeat/drain like real daemons
+    but script their lease grants — REAL work must never land on one, so
+    every placement decision (daemon choose/spill/feasibility, store actor
+    scheduling, PG bin-pack) excludes them by this label."""
+    return bool(labels) and labels.get(SIM_NODE_LABEL) == "true"
+
+
 @dataclass
 class SchedulingStrategy:
     kind: str = STRATEGY_DEFAULT

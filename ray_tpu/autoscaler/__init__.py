@@ -141,6 +141,11 @@ class Autoscaler:
         self.slices: List[dict] = []   # provider handles for launched slices
         self._idle_since: Dict[str, float] = {}
         self._draining: Dict[str, float] = {}
+        # delta-maintained node rows (scale plane): each poll asks the
+        # control store only for rows whose availability/load CHANGED since
+        # the cursor — at 1000 nodes the full row set per poll is the cost
+        self._load_rows: Dict[str, dict] = {}
+        self._load_cursor = -1
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -283,7 +288,18 @@ class Autoscaler:
         from ray_tpu._private.core_worker import get_core_worker
 
         cw = get_core_worker()
-        load = cw.run_sync(cw.control.call("get_cluster_load", {}), 30)
+        load = cw.run_sync(cw.control.call(
+            "get_cluster_load", {"cursor": self._load_cursor}), 30)
+        if load.get("delta"):
+            for n in load["nodes"]:
+                self._load_rows[n["node_id"]] = n
+            for hexid in load.get("removed", ()):
+                self._load_rows.pop(hexid, None)
+        else:
+            self._load_rows = {n["node_id"]: n for n in load["nodes"]}
+        self._load_cursor = load.get("version", -1)
+        # downstream logic sees the merged full row set either way
+        load = {**load, "nodes": list(self._load_rows.values())}
         launched = terminated = 0
 
         # prune workers/slices whose daemons died out-of-band — a dead

@@ -33,9 +33,10 @@ _PAGE = """<!doctype html>
 <div id="content">loading…</div>
 <script>
 async function refresh() {
-  const [nodes, actors, jobs, tasks] = await Promise.all(
-    ["nodes", "actors", "jobs", "task_summary"].map(
+  let [nodes, actors, jobs, tasks] = await Promise.all(
+    ["nodes?limit=1000", "actors", "jobs", "task_summary"].map(
       p => fetch("/api/" + p).then(r => r.json())));
+  nodes = nodes.nodes || nodes;
   const esc = (s) => String(s).replace(/[&<>"']/g,
     ch => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
   const table = (rows) => {
@@ -67,6 +68,32 @@ class DashboardActor:
         self.port = port
         self._started = None
         self._runner = None
+        # aggregated node table maintained from the control store's delta
+        # cursor: each poll transfers only the mutations since the last one
+        # instead of serializing the full 1000-node table per request
+        self._nodes_cache: dict = {}
+        self._nodes_cursor = -1
+
+    async def _node_table(self) -> list:
+        """The aggregated node table, refreshed via get_nodes_delta. Falls
+        back to a full read when delta sync is off or the cursor expired."""
+        reply = await self._control(
+            "get_nodes_delta", {"cursor": self._nodes_cursor})
+        if reply.get("full") or "updates" not in reply:
+            self._nodes_cache = {
+                n["node_id"]: n for n in reply.get("nodes", [])
+            }
+        else:
+            for n in reply["updates"]:
+                self._nodes_cache[n["node_id"]] = n
+            if len(self._nodes_cache) > 4096:
+                # deltas only ever ADD rows; under heavy churn re-anchor on
+                # a full read so the store's dead-node retention (which
+                # prunes) bounds this cache too
+                self._nodes_cursor = -1
+                return await self._node_table()
+        self._nodes_cursor = reply.get("version", self._nodes_cursor)
+        return list(self._nodes_cache.values())
 
     async def _control(self, method: str, payload: dict = None):
         from ray_tpu._private.core_worker import get_core_worker
@@ -126,9 +153,9 @@ class DashboardActor:
 
     async def _resolve_node(self, node_hex: str) -> dict:
         """Find a LIVE node by full id or unique prefix (>= 8 chars)."""
-        reply = await self._control("get_all_nodes")
+        nodes = await self._node_table()
         matches = [
-            n for n in reply["nodes"]
+            n for n in nodes
             if n["node_id"].hex() == node_hex
             or (len(node_hex) >= 8 and n["node_id"].hex().startswith(node_hex))
         ]
@@ -232,22 +259,43 @@ class DashboardActor:
             {"node": n["node_id"].hex(), "logdir": out_dir, "files": files})
 
     async def _nodes(self, request):
+        """Paginated node listing served from the delta-maintained
+        aggregate (`?offset=&limit=`, default limit 100): a poll against a
+        1000-node cluster transfers one page + the table's recent deltas,
+        never the full table per request."""
         from aiohttp import web
 
         from ray_tpu._private.protocol import NodeInfo
 
-        reply = await self._control("get_all_nodes")
-        return web.json_response([
-            {
-                # FULL hex: these ids feed /api/workers, /api/profile and
-                # /api/jax_profile, which resolve nodes by exact id
-                "node_id": NodeInfo.from_wire(n).node_id.hex(),
-                "state": n["state"],
-                "address": n["address"],
-                "resources": NodeInfo.from_wire(n).resources.to_dict(),
-            }
-            for n in reply["nodes"]
-        ])
+        try:
+            offset = max(0, int(request.query.get("offset", 0)))
+            limit = max(1, min(1000, int(request.query.get("limit", 100))))
+        except ValueError:
+            return web.json_response({"error": "bad offset/limit"},
+                                     status=400)
+        nodes = await self._node_table()
+        # live first, then draining, then dead — stable within groups so
+        # pages don't shuffle between polls
+        order = {"ALIVE": 0, "DRAINING": 1}
+        nodes.sort(key=lambda n: (order.get(n["state"], 2),
+                                  n["node_id"]))
+        page = nodes[offset:offset + limit]
+        return web.json_response({
+            "total": len(nodes),
+            "offset": offset,
+            "limit": limit,
+            "nodes": [
+                {
+                    # FULL hex: these ids feed /api/workers, /api/profile
+                    # and /api/jax_profile, which resolve nodes by exact id
+                    "node_id": NodeInfo.from_wire(n).node_id.hex(),
+                    "state": n["state"],
+                    "address": n["address"],
+                    "resources": NodeInfo.from_wire(n).resources.to_dict(),
+                }
+                for n in page
+            ],
+        })
 
     async def _actors(self, request):
         from aiohttp import web

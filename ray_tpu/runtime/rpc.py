@@ -41,18 +41,26 @@ MAX_FRAME = 512 * 1024 * 1024
 # frame kinds
 _REQ, _RESP, _ERR, _PUSH = 0, 1, 2, 3
 
+# reserved push "channel" carrying a coalesced batch of (channel, message)
+# pairs — one frame per subscriber per flush window instead of one per event
+# (the control store's PubSub emits these; _dispatch_frame unwraps them so
+# per-channel callbacks never see the envelope)
+BATCH_CHANNEL = "_batch"
+
 
 def _pack(obj) -> bytes:
     payload = msgpack.packb(obj, use_bin_type=True)
     return _FRAME.pack(len(payload)) + payload
 
 
-async def _read_frame(reader: asyncio.StreamReader):
+async def _read_frame(reader: asyncio.StreamReader, counter=None):
     header = await reader.readexactly(_FRAME.size)
     (length,) = _FRAME.unpack(header)
     if length > MAX_FRAME:
         raise RpcError(f"Frame too large: {length}")
     payload = await reader.readexactly(length)
+    if counter is not None:
+        counter[0] += _FRAME.size + length
     return msgpack.unpackb(payload, raw=False)
 
 
@@ -135,6 +143,31 @@ class RpcServer:
             return True
         except (ConnectionError, RuntimeError):
             return False
+
+    def push_batch(self, conn_id: int, items: list) -> bool:
+        """Push a coalesced batch of (channel, message) pairs as ONE frame
+        (the fanout amortization: a churn wave's worth of notices costs one
+        write + one client wakeup per subscriber per flush window)."""
+        w = self._conns.get(conn_id)
+        if w is None or w.is_closing():
+            return False
+        try:
+            w.write(_pack([_PUSH, 0, BATCH_CHANNEL, items]))
+            return True
+        except (ConnectionError, RuntimeError):
+            return False
+
+    def conn_buffer_size(self, conn_id: int) -> int:
+        """Bytes buffered in a subscriber's transport (a stalled consumer
+        grows this without bound unless the publisher sheds — see PubSub's
+        backlog cap)."""
+        w = self._conns.get(conn_id)
+        if w is None or w.is_closing():
+            return 0
+        try:
+            return w.transport.get_write_buffer_size()
+        except (AttributeError, RuntimeError):
+            return 0
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         conn_id = next(self._conn_counter)
@@ -243,6 +276,12 @@ class RpcClient:
         # process, half-open TCP) should eventually get a fresh transport.
         self._consecutive_timeouts = 0
         self.timeouts_before_reconnect = 3
+        # transfer accounting for the scale bench: push FRAMES vs MESSAGES
+        # quantifies pubsub coalescing (one batched frame carries many
+        # notices); bytes_received is raw transport inbound
+        self.push_frames = 0
+        self.push_messages = 0
+        self.bytes_received = 0
 
     def on_reconnect(self, cb: Callable[[], Awaitable[None]]):
         """Register an async callback fired after every re-established
@@ -280,6 +319,7 @@ class RpcClient:
                     data = await self._reader.read(1 << 18)
                     if not data:
                         raise asyncio.IncompleteReadError(b"", None)
+                    self.bytes_received += len(data)
                     splitter.feed(data)
                     frames = _drain_splitter(splitter)
                     if frames:
@@ -288,8 +328,10 @@ class RpcClient:
                     for kind, req_id, method, payload in frames:
                         self._dispatch_frame(kind, req_id, method, payload)
             else:
+                nbytes = [self.bytes_received]
                 while True:
-                    frame = await _read_frame(self._reader)
+                    frame = await _read_frame(self._reader, counter=nbytes)
+                    self.bytes_received = nbytes[0]
                     # any inbound frame proves the peer is alive — short
                     # per-call timeouts on slow methods must not count toward
                     # a reconnect while other replies are flowing
@@ -314,13 +356,19 @@ class RpcClient:
 
     def _dispatch_frame(self, kind, req_id, method, payload):
         if kind == _PUSH:
-            cb = self._subs.get(method)
-            if cb is not None:
-                try:
-                    cb(payload)
-                except Exception:
-                    logger.exception(
-                        "%s: push callback for %s failed", self.name, method)
+            # Wire-order fidelity: a reply resolves its future, which only
+            # SCHEDULES the awaiting coroutine on the loop's ready queue —
+            # so a push callback invoked synchronously here would overtake
+            # any reply that arrived BEFORE it in the same read burst.
+            # Concretely: a get_nodes_delta full-snapshot reconcile would
+            # clear-and-rebuild AFTER a later registration notice had been
+            # applied, wiping that node from the view forever (its notice
+            # never repeats and the cursor has moved past it). Scheduling
+            # pushes through the same call_soon FIFO keeps callback
+            # execution in exact wire order relative to reply resumptions.
+            self.push_frames += 1
+            asyncio.get_running_loop().call_soon(
+                self._dispatch_push, method, payload)
             return
         fut = self._pending.pop(req_id, None)
         if fut is None or fut.done():
@@ -329,6 +377,32 @@ class RpcClient:
             fut.set_exception(RpcError(payload))
         else:
             fut.set_result(payload)
+
+    def _dispatch_push(self, method, payload):
+        if method == BATCH_CHANNEL:
+            # coalesced fanout envelope: one frame, many notices —
+            # unwrap here so per-channel callbacks are batching-agnostic
+            for item in payload:
+                channel, message = item[0], item[1]
+                self.push_messages += 1
+                cb = self._subs.get(channel)
+                if cb is None:
+                    continue
+                try:
+                    cb(message)
+                except Exception:
+                    logger.exception(
+                        "%s: push callback for %s failed",
+                        self.name, channel)
+            return
+        self.push_messages += 1
+        cb = self._subs.get(method)
+        if cb is not None:
+            try:
+                cb(payload)
+            except Exception:
+                logger.exception(
+                    "%s: push callback for %s failed", self.name, method)
 
     def subscribe_channel(self, channel: str, callback: Callable[[Any], None]):
         self._subs[channel] = callback

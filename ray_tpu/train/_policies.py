@@ -12,6 +12,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Union
 
+from ray_tpu._private import protocol as pb
+
 
 @dataclass
 class ScalingDecision:
@@ -38,6 +40,8 @@ def usable_cluster_resources(
     for n in nodes:
         if n.get("state") != "ALIVE":
             continue  # DEAD and DRAINING nodes host nothing new
+        if pb.is_sim_node(n.get("labels")):
+            continue  # scale-harness nodes can't host real workers
         if n.get("drain_reason"):
             continue  # notice landed, state transition racing
         death = n.get("death")

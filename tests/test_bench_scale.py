@@ -1,0 +1,65 @@
+"""Tier-1 smoke of the control-plane scale harness (bench_scale.py): ~100
+simnodes register against one control store, converge, ride out a drain
+wave, and finish with ZERO protocol errors. The committed full-size A/B
+(BENCH_SCALE_r14.json, 1000 nodes, fixes off vs on) asserts the actual
+wins; the slow-marked test below re-runs it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench_scale.py"), *args],
+        text=True, capture_output=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(line) for line in proc.stdout.splitlines()
+            if line.startswith("{")]
+    return {(r["bench"], r["mode"]): r for r in rows}
+
+
+def test_bench_scale_quick_smoke():
+    """100 simulated nodes, fixes on: register storm completes, every
+    membership view converges, the drain wave converges, leases spill to
+    grants, and no node records a protocol error."""
+    by = _run(["--quick", "--mode", "on", "--steady-s", "1"], timeout=420)
+    storm = by[("register_storm", "on")]
+    assert storm["nodes"] == 100
+    assert storm["protocol_errors"] == 0
+    assert storm["storm_s"] < 60 and storm["converge_s"] < 60
+    fanout = by[("pubsub_fanout", "on")]
+    # coalescing: a 10-node churn wave costs far fewer frames than
+    # messages (one frame per subscriber per flush window)
+    assert fanout["push_messages"] > 2 * fanout["push_frames"]
+    lease = by[("lease_spillback", "on")]
+    assert lease["granted"] == lease["requests"]
+    wal = by[("wal_growth", "on")]
+    assert wal["protocol_errors"] == 0
+    assert wal["persisted_bytes"] > 0
+
+
+@pytest.mark.slow
+def test_bench_scale_1000_node_ab():
+    """The full sweep: at 1000 nodes the delta sync and the coalesced
+    fanout must each win measurably over the legacy full-snapshot /
+    frame-per-event plane."""
+    by = _run(["--nodes", "1000", "--steady-s", "8"], timeout=3600)
+    # the ON plane must be protocol-clean; OFF at 1000 nodes is ALLOWED to
+    # record errors — the meltdown (reconcile timeouts under reconnect
+    # storms, heartbeats starved past their deadline) is the finding
+    assert by[("wal_growth", "on")]["protocol_errors"] == 0
+    # steady-state heartbeat payloads: delta replies vs O(nodes) views
+    off, on = by[("steady_state", "off")], by[("steady_state", "on")]
+    assert on["client_bytes_per_s"] < off["client_bytes_per_s"] / 5
+    # churn-wave fanout: one frame per subscriber per window vs per event
+    off, on = by[("pubsub_fanout", "off")], by[("pubsub_fanout", "on")]
+    assert on["push_frames"] < off["push_frames"] / 5
+    # gap reconcile: cursor delta vs full table snapshot, fleet-wide
+    off, on = by[("reconcile", "off")], by[("reconcile", "on")]
+    assert on["bytes"] < off["bytes"] / 5

@@ -41,8 +41,14 @@ def test_dashboard_endpoints(ray_init):
     page = httpx.get(f"{url}/", timeout=30)
     assert page.status_code == 200 and "ray_tpu dashboard" in page.text
 
-    nodes = httpx.get(f"{url}/api/nodes", timeout=30).json()
+    # /api/nodes is paginated and served from the delta-maintained cache
+    page1 = httpx.get(f"{url}/api/nodes", timeout=30).json()
+    assert page1["total"] == 1 and page1["offset"] == 0
+    nodes = page1["nodes"]
     assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+    empty = httpx.get(f"{url}/api/nodes?offset=5&limit=2", timeout=30).json()
+    assert empty["total"] == 1 and empty["nodes"] == []
+    assert httpx.get(f"{url}/api/nodes?offset=x", timeout=30).status_code == 400
 
     actors = httpx.get(f"{url}/api/actors", timeout=30).json()
     assert any(x["name"] == "dash-actor" for x in actors)

@@ -1,0 +1,578 @@
+"""Scale plane: simulated-node harness + 1000-node control-plane fixes.
+
+Covers (ROADMAP item 5):
+  * versioned node-table delta sync: get_nodes_delta cursor reads, the
+    retention fallback to a full snapshot, and _v stamping on notices;
+  * heartbeat availability-delta replies (view_cursor protocol);
+  * coalesced pubsub fanout (one frame per subscriber per flush window)
+    with the bounded per-subscriber backlog + rt_pubsub_dropped_total;
+  * subscriber-side in-stream seq-gap detection -> cursor reconcile
+    (simnode and the core-worker/daemon share the pattern);
+  * DEAD-node retention pruning (bounded table / WAL / snapshot);
+  * WAL/snapshot compaction under 500-simnode churn + exact live-set
+    recovery on restart (the satellite's persistence bound);
+  * the SimNode plane itself: register storm, membership convergence,
+    scripted drain, lease grant/spillback, cluster_utils integration;
+  * scale-knob promotion to _private/config.py.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from ray_tpu._private import protocol as pb
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.protocol import NodeInfo, ResourceSet
+
+
+def _node_wire(node_id=None, address="127.0.0.1:1"):
+    return NodeInfo(
+        node_id=node_id or NodeID.from_random(),
+        address=address,
+        object_store_name="none",
+        resources=ResourceSet({"CPU": 2}),
+    ).to_wire()
+
+
+# ---------------------------------------------------------------------------
+# knob promotion (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_scale_knobs_promoted_to_config():
+    flags = GLOBAL_CONFIG.all_flags()
+    for name in (
+        "heartbeat_period_s", "heartbeat_jitter",
+        "pubsub_flush_window_ms", "pubsub_max_backlog",
+        "node_delta_retention", "node_dead_retention",
+        "node_table_delta_sync", "simnode_count", "simnode_seed",
+    ):
+        assert name in flags, name
+        assert flags[name].doc, f"{name} needs a help string"
+
+
+# ---------------------------------------------------------------------------
+# versioned node-table delta sync
+# ---------------------------------------------------------------------------
+
+
+def test_get_nodes_delta_cursor_reads():
+    """A cursor reconcile returns exactly the mutations published after
+    the cursor — same wires the pubsub stream carried (`_v` stamped) —
+    and a stale cursor falls back to one full snapshot."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        wires = [_node_wire() for _ in range(5)]
+        for w in wires:
+            await cs.rpc_register_node(0, {"node": w})
+        base = (await cs.rpc_get_nodes_delta(0, {"cursor": -1}))
+        assert base["full"] and len(base["nodes"]) == 5
+        cursor = base["version"]
+
+        # nothing changed: empty update set
+        r = await cs.rpc_get_nodes_delta(0, {"cursor": cursor})
+        assert r.get("updates") == [] and not r.get("full")
+
+        # two mutations after the cursor: a drain and a death
+        await cs.rpc_drain_node(0, {"node_id": wires[0]["node_id"],
+                                    "reason": "manual", "deadline_s": 0})
+        await cs.rpc_unregister_node(0, {"node_id": wires[1]["node_id"],
+                                         "expected": True,
+                                         "reason": "drained"})
+        r = await cs.rpc_get_nodes_delta(0, {"cursor": cursor})
+        ups = r["updates"]
+        assert [u["state"] for u in ups] == [pb.NODE_DRAINING, pb.NODE_DEAD]
+        assert all(u["_v"] > cursor for u in ups)
+        assert r["version"] == cursor + 2
+
+        # a cursor behind the bounded retention window -> full snapshot
+        GLOBAL_CONFIG.apply_system_config({"node_delta_retention": 2})
+        for _ in range(4):
+            await cs.rpc_register_node(0, {"node": _node_wire()})
+        r = await cs.rpc_get_nodes_delta(0, {"cursor": cursor})
+        assert r.get("full") and r["version"] == cursor + 6
+
+    asyncio.run(run())
+
+
+def test_register_lean_reply_skips_seed_list():
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        await cs.rpc_register_node(0, {"node": _node_wire()})
+        full = await cs.rpc_register_node(0, {"node": _node_wire()})
+        assert "nodes" in full and full["version"] == 2
+        lean = await cs.rpc_register_node(
+            0, {"node": _node_wire(), "lean": True})
+        assert "nodes" not in lean and lean["version"] == 3
+
+    asyncio.run(run())
+
+
+def test_heartbeat_view_delta_protocol():
+    """Cursor heartbeats get only availability CHANGES (+ removals), not
+    the O(nodes) view; cursor-less heartbeats keep the legacy full reply."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        a, b = _node_wire(), _node_wire()
+        await cs.rpc_register_node(0, {"node": a})
+        await cs.rpc_register_node(0, {"node": b})
+
+        # legacy shape (no cursor): full view + nodes
+        r = await cs.rpc_heartbeat(0, {"node_id": a["node_id"]})
+        assert "view" in r and "nodes" in r
+
+        # first cursor beat: full view + version
+        r = await cs.rpc_heartbeat(
+            0, {"node_id": a["node_id"], "view_cursor": -1})
+        assert len(r["view_full"]) == 2
+        cursor = r["view_version"]
+
+        # steady state, nothing changed: no delta at all
+        r = await cs.rpc_heartbeat(
+            0, {"node_id": a["node_id"], "view_cursor": cursor})
+        assert "view_full" not in r and "view_delta" not in r
+        cursor = r["view_version"]
+
+        # b's availability changes -> exactly one delta entry
+        r = await cs.rpc_heartbeat(0, {
+            "node_id": b["node_id"],
+            "available": ResourceSet({"CPU": 1}).to_wire(),
+        })
+        r = await cs.rpc_heartbeat(
+            0, {"node_id": a["node_id"], "view_cursor": cursor})
+        delta = r["view_delta"]
+        assert list(delta) == [NodeID(b["node_id"]).hex()]
+        assert ResourceSet.from_wire(delta[NodeID(b["node_id"]).hex()]) \
+            .to_dict() == {"CPU": 1.0}
+        cursor = r["view_version"]
+
+        # b dies -> removal, not a delta entry
+        await cs.rpc_unregister_node(
+            0, {"node_id": b["node_id"], "expected": False,
+                "reason": "gone"})
+        r = await cs.rpc_heartbeat(
+            0, {"node_id": a["node_id"], "view_cursor": cursor})
+        assert r["view_removed"] == [NodeID(b["node_id"]).hex()]
+
+    asyncio.run(run())
+
+
+def test_dead_node_retention_prunes_table():
+    """Node churn cannot grow the table forever: DEAD records beyond
+    node_dead_retention are pruned (with persisted tombstones) while live
+    nodes are untouched."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        GLOBAL_CONFIG.apply_system_config({"node_dead_retention": 4})
+        cs = ControlStore()
+        keep = [_node_wire() for _ in range(3)]
+        for w in keep:
+            await cs.rpc_register_node(0, {"node": w})
+        for i in range(20):
+            w = _node_wire()
+            await cs.rpc_register_node(0, {"node": w})
+            await cs.rpc_unregister_node(
+                0, {"node_id": w["node_id"], "expected": bool(i % 2),
+                    "reason": "churn"})
+        dead = [n for n in cs.nodes.values() if n.state == pb.NODE_DEAD]
+        assert len(dead) <= 4
+        alive = {n.node_id.hex() for n in cs.nodes.values()
+                 if n.state == pb.NODE_ALIVE}
+        assert alive == {NodeID(w["node_id"]).hex() for w in keep}
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# coalesced + bounded pubsub fanout
+# ---------------------------------------------------------------------------
+
+
+class _StubServer:
+    """Records pushes; lets the test dial a fake transport backlog."""
+
+    def __init__(self):
+        self.pushes = []
+        self.batches = []
+        self.buffered = 0
+
+    def push(self, conn_id, channel, message):
+        self.pushes.append((conn_id, channel, message))
+        return True
+
+    def push_batch(self, conn_id, items):
+        self.batches.append((conn_id, list(items)))
+        return True
+
+    def conn_buffer_size(self, conn_id):
+        return self.buffered
+
+
+def test_pubsub_coalescing_one_frame_per_flush():
+    """With a flush window, a burst of notices ships as ONE batched frame
+    per subscriber, seqs intact and ordered."""
+    from ray_tpu._private.control_store import PubSub
+
+    async def run():
+        GLOBAL_CONFIG.apply_system_config({"pubsub_flush_window_ms": 10.0})
+        ps = PubSub(_StubServer())
+        ps.subscribe(1, "nodes")
+        ps.subscribe(2, "nodes")
+        for i in range(50):
+            ps.publish("nodes", {"i": i})
+        ps.flush()
+        server = ps._server
+        assert not server.pushes  # nothing shipped per event
+        assert len(server.batches) == 2  # one frame per subscriber
+        for _conn, items in server.batches:
+            assert len(items) == 50
+            seqs = [m["_seq"] for _ch, m in items]
+            assert seqs == list(range(1, 51))
+
+    asyncio.run(run())
+
+
+def test_pubsub_bounded_backlog_sheds_oldest_and_counts():
+    """A stalled subscriber's backlog is BOUNDED: overflow drops oldest,
+    counts into rt_pubsub_dropped_total{channel=}, and the survivor batch
+    shows the seq gap the subscriber will reconcile from."""
+    from ray_tpu._private.control_store import PubSub
+
+    async def run():
+        GLOBAL_CONFIG.apply_system_config({
+            "pubsub_flush_window_ms": 10.0,
+            "pubsub_max_backlog": 5,
+        })
+        ps = PubSub(_StubServer())
+        ps.subscribe(1, "nodes")
+        for i in range(12):
+            ps.publish("nodes", {"i": i})
+        assert ps.dropped["nodes"] == 7
+        ps.flush()
+        (_conn, items), = ps._server.batches
+        seqs = [m["_seq"] for _ch, m in items]
+        assert seqs == list(range(8, 13))  # oldest shed, order kept
+        from ray_tpu.util.metrics import snapshot_all
+
+        series = [s for s in snapshot_all()
+                  if s["name"] == "rt_pubsub_dropped_total"]
+        assert series and series[0]["tags"] == {"channel": "nodes"}
+        assert series[0]["value"] == 7
+
+    asyncio.run(run())
+
+
+def test_pubsub_immediate_mode_sheds_on_stalled_transport():
+    """Legacy immediate mode also bounds a stalled subscriber: past the
+    byte cap, notices shed (counted) instead of growing the buffer."""
+    from ray_tpu._private.control_store import PubSub
+
+    async def run():
+        GLOBAL_CONFIG.apply_system_config({"pubsub_max_backlog": 2})
+        ps = PubSub(_StubServer())
+        ps.subscribe(1, "nodes")
+        ps.publish("nodes", {"i": 0})
+        assert len(ps._server.pushes) == 1
+        ps._server.buffered = 3 * 1024  # > pubsub_max_backlog KiB
+        ps.publish("nodes", {"i": 1})
+        assert len(ps._server.pushes) == 1  # shed, not buffered
+        assert ps.dropped["nodes"] == 1
+
+    asyncio.run(run())
+
+
+def test_simnode_in_stream_gap_triggers_cursor_reconcile():
+    """A seq jump INSIDE the stream (the shed-backlog signature) triggers
+    a reconcile from the PRE-gap cursor that replays exactly the missed
+    mutations. The critical shape: the subscriber SAW a node register,
+    then missed its DEATH in the shed window — the gap-revealing notice's
+    `_v` advances the cursor past the window before the (deferred)
+    reconcile task runs, so a reconcile reading the live cursor would
+    replay nothing and the dead node would stay a member forever."""
+    from ray_tpu._private.control_store import ControlStore
+    from ray_tpu._private.simnode import SimNode
+
+    async def run():
+        cs = ControlStore()
+        addr = await cs.start(port=0)
+        try:
+            sim = SimNode(addr, index=0, seed=7, serve=False,
+                          heartbeat=False)
+            await sim.start()
+            # the subscriber SEES `gone` register through the stream
+            gone = _node_wire()
+            await cs.rpc_register_node(0, {"node": gone})
+            gone_hex = NodeID(gone["node_id"]).hex()
+            for _ in range(40):
+                if gone_hex in sim.membership:
+                    break
+                await asyncio.sleep(0.05)
+            assert gone_hex in sim.membership
+
+            # ... then its DEATH is shed: mutate without this subscriber
+            cs.pubsub.unsubscribe_conn(
+                next(iter(cs.pubsub._subs.get("nodes", {1}))))
+            await cs.rpc_unregister_node(
+                0, {"node_id": gone["node_id"], "expected": False,
+                    "reason": "x"})
+            # hand the subscriber the NEXT notice with the jumped seq and
+            # the store's CURRENT version (what a real successor carries)
+            seq = cs.pubsub.channel_seq("nodes") + 1
+            cs.pubsub.seq["nodes"] = seq
+            sim._on_nodes_message({**_node_wire(), "_seq": seq,
+                                   "_v": cs._node_version})
+            for _ in range(40):
+                if (sim.gaps_reconciled
+                        and (sim._reconcile_task is None
+                             or sim._reconcile_task.done())):
+                    break
+                await asyncio.sleep(0.05)
+            assert sim.gaps_reconciled == 1
+            # the reconcile replayed the missed death from the pre-gap
+            # cursor: `gone` is no longer a member
+            assert gone_hex not in sim.membership
+            await sim.stop()
+        finally:
+            await cs.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# WAL/snapshot compaction under churn (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_wal_bounded_under_500_simnode_churn_and_restart(tmp_path):
+    """500 simnodes register/drain/die in a loop against a persisted
+    store: the persisted size stays bounded (compaction + dead-node
+    retention, not monotone growth) and a restarted store recovers the
+    EXACT live-node set."""
+    from ray_tpu._private.control_store import ControlStore
+    from ray_tpu._private.simnode import SimNode
+
+    persist = str(tmp_path / "cs")
+
+    def dir_bytes():
+        total = 0
+        for root, _d, files in os.walk(persist):
+            for f in files:
+                total += os.path.getsize(os.path.join(root, f))
+        return total
+
+    async def churn():
+        GLOBAL_CONFIG.apply_system_config({
+            "control_store_persist": True,
+            "control_store_wal_compact_every": 64,
+            "node_dead_retention": 16,
+        })
+        cs = ControlStore(persist_dir=persist)
+        addr = await cs.start(port=0)
+        sizes = []
+        try:
+            stayers = [SimNode(addr, index=i, seed=11, serve=False,
+                               heartbeat=False) for i in range(10)]
+            for n in stayers:
+                await n.start()
+            # 490 transients in waves: half drain (graceful), half die
+            idx = 100
+            for _wave in range(7):
+                batch = [SimNode(addr, index=idx + j, seed=11, serve=False,
+                                 heartbeat=False) for j in range(70)]
+                idx += 70
+                await asyncio.gather(*(n.start() for n in batch))
+                await asyncio.gather(*(
+                    n.drain(deadline_s=0.1) if j % 2 == 0
+                    else n._call("unregister_node", {
+                        "node_id": n.node_id.binary(), "expected": False,
+                        "reason": "died"})
+                    for j, n in enumerate(batch)))
+                for n in batch:
+                    if n.state != "DEAD":
+                        await n.stop()
+                sizes.append(dir_bytes())
+            # wait out any in-flight threaded compaction
+            for _ in range(50):
+                if not cs._compacting:
+                    break
+                await asyncio.sleep(0.1)
+            sizes.append(dir_bytes())
+            live = {n.node_id.hex() for n in cs.nodes.values()
+                    if n.state == pb.NODE_ALIVE}
+            assert live == {n.node_id.hex() for n in stayers}
+            # dead records bounded by retention
+            dead = [n for n in cs.nodes.values()
+                    if n.state == pb.NODE_DEAD]
+            assert len(dead) <= 16
+            for n in stayers:
+                await n.stop()
+        finally:
+            await cs.stop()
+        # bounded, not monotone: the steady-state size must not scale with
+        # total churn (500 nodes' worth of WAL would be many x this bound)
+        assert max(sizes) < 512 * 1024, sizes
+        assert sizes[-1] <= max(sizes)
+        return {n.node_id.hex() for n in stayers}
+
+    expected_live = asyncio.run(churn())
+
+    async def recover():
+        cs2 = ControlStore(persist_dir=persist)
+        cs2._recover()
+        live = {n.node_id.hex() for n in cs2.nodes.values()
+                if n.state == pb.NODE_ALIVE}
+        assert live == expected_live
+        dead = [n for n in cs2.nodes.values() if n.state == pb.NODE_DEAD]
+        assert len(dead) <= 16
+
+    GLOBAL_CONFIG.apply_system_config({"control_store_persist": True})
+    asyncio.run(recover())
+
+
+# ---------------------------------------------------------------------------
+# simnodes are control-plane-only: real placement must exclude them
+# ---------------------------------------------------------------------------
+
+
+def test_real_placement_excludes_simnodes():
+    """Actor scheduling and PG bin-pack skip nodes labeled simnode=true
+    even when the simnode has MORE free capacity — scripted lease grants
+    must never receive real work (found by an E2E drive: a real task
+    lease spilled to a simnode and got a fake worker address)."""
+    from ray_tpu._private.control_store import ControlStore
+    from ray_tpu._private.ids import JobID, TaskID
+    from ray_tpu._private.protocol import Bundle, TaskSpec
+
+    async def run():
+        cs = ControlStore()
+        real = NodeInfo(
+            node_id=NodeID.from_random(), address="127.0.0.1:1",
+            object_store_name="none", resources=ResourceSet({"CPU": 2}),
+        )
+        sim = NodeInfo(
+            node_id=NodeID.from_random(), address="simnode-x:0",
+            object_store_name="none", resources=ResourceSet({"CPU": 64}),
+            labels={"simnode": "true"},
+        )
+        for info in (real, sim):
+            await cs.rpc_register_node(0, {"node": info.to_wire()})
+
+        spec = TaskSpec(task_id=TaskID.from_random(),
+                        job_id=JobID.from_random(),
+                        resources=ResourceSet({"CPU": 1}))
+        for _ in range(8):  # pack would prefer the fatter simnode
+            assert cs._pick_node_for(spec, set()) == real.node_id.binary()
+
+        from ray_tpu._private.control_store import PlacementGroupRecord
+
+        from ray_tpu._private.ids import PlacementGroupID
+
+        rec = PlacementGroupRecord(
+            pg_id=PlacementGroupID.from_random(),
+            bundles=[Bundle(index=0, resources=ResourceSet({"CPU": 1}))],
+            strategy=pb.PG_PACK, name="",
+        )
+        placements = cs._place_bundles(rec)
+        assert placements == {0: real.node_id.binary()}
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# the simnode plane end to end (+ cluster_utils integration)
+# ---------------------------------------------------------------------------
+
+
+def test_simnode_plane_converges_drains_and_leases():
+    """A small plane against an in-process store: register storm, full
+    membership convergence, scripted lease grant + spillback replies, a
+    drain wave, zero protocol errors."""
+    from ray_tpu._private.control_store import ControlStore
+    from ray_tpu._private.simnode import SimNodePlane
+    from ray_tpu.runtime.rpc import RpcClient
+
+    async def run():
+        GLOBAL_CONFIG.apply_system_config({
+            "pubsub_flush_window_ms": 5.0,
+            "node_table_delta_sync": True,
+        })
+        cs = ControlStore()
+        addr = await cs.start(port=0)
+        try:
+            plane = SimNodePlane(addr, 20, seed=5)
+            await plane.start()
+            await plane.await_converged(timeout=30)
+
+            # scripted lease protocol: hot entry grants once, then spills
+            # with the real reply shape
+            first = plane.nodes[0]
+            client = RpcClient(first.address, name="test->sim")
+            await client.connect()
+            res = ResourceSet({"CPU": 4.0}).to_wire()
+            r1 = await client.call("request_lease", {
+                "resources": res, "job_id": b"", "hops": 0})
+            assert r1["granted"] and r1["node_id"] == first.node_id.hex()
+            r2 = await client.call("request_lease", {
+                "resources": res, "job_id": b"", "hops": 0})
+            assert "spillback" in r2 and r2["spillback"] != first.address
+            await client.call("return_lease", {"lease_id": r1["lease_id"]})
+            assert first.available.to_dict() == {"CPU": 4.0}
+            await client.close()
+
+            await plane.drain_wave(5, deadline_s=0.2)
+            await plane.await_converged(timeout=30)
+            stats = plane.stats()
+            assert stats["alive"] == 15
+            assert stats["protocol_errors"] == []
+            # membership views agree everywhere
+            views = {frozenset(n.membership) for n in plane.alive()}
+            assert len(views) == 1
+            await plane.stop()
+        finally:
+            await cs.stop()
+
+    asyncio.run(run())
+
+
+def test_cluster_utils_add_sim_nodes():
+    """Cluster.add_sim_nodes attaches a subprocess simnode plane next to
+    the real head daemon; the control store sees all of them."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.runtime.rpc import RpcClient
+
+    cluster = Cluster(initialize_head=True)
+    try:
+        handle = cluster.add_sim_nodes(8, seed=3)
+        assert handle.count == 8 and len(handle.node_ids) == 8
+
+        async def check():
+            client = RpcClient(cluster.address, name="test->cs")
+            await client.connect()
+            deadline = time.monotonic() + 30
+            while True:
+                reply = await client.call("get_all_nodes", {})
+                alive = [n for n in reply["nodes"]
+                         if n["state"] == pb.NODE_ALIVE]
+                if len(alive) == 9:  # 1 real head + 8 simulated
+                    break
+                assert time.monotonic() < deadline, len(alive)
+                await asyncio.sleep(0.2)
+            # pagination on the store read too
+            page = await client.call("get_all_nodes",
+                                     {"offset": 0, "limit": 4})
+            assert page["total"] == 9 and len(page["nodes"]) == 4
+            await client.close()
+
+        asyncio.run(check())
+    finally:
+        cluster.shutdown()
