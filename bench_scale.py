@@ -28,6 +28,14 @@ Phases per mode:
                     to convergence + average hops.
   wal_growth        persisted store size after the churn (WAL + snapshot).
 
+Plus the HA column (run once, fixes ON, per backend):
+  failover          N watching simnodes + a steady worker-death stream; the
+                    primary store is SIGKILLed mid-stream and the warm
+                    standby takes over at the same address. Reports
+                    detection/takeover/convergence wall times and the
+                    zero-loss counters (notices_lost MUST be 0,
+                    notices_dup MUST be 0).
+
 Emits one JSON record per (phase, mode) on stdout; --out writes the
 collected artifact (BENCH_SCALE_rNN.json).
 
@@ -280,6 +288,111 @@ async def run_mode(mode: str, args) -> list:
     return results
 
 
+async def run_failover(args, backend: str) -> list:
+    """The HA column: kill the primary under a live death-notice stream
+    and measure detection -> takeover -> convergence, with the zero-loss
+    counters as the correctness gate."""
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.simnode import SimNodePlane
+    from ray_tpu.runtime.rpc import RpcClient
+
+    GLOBAL_CONFIG.reset()
+    GLOBAL_CONFIG.apply_system_config({
+        **FIXES["on"],
+        "control_store_backend": backend,
+        "store_standby_enabled": True,
+        "store_failover_timeout_s": 10.0,
+        "store_fence_epoch_renew_s": 0.25,
+    })
+    count = args.nodes
+    deaths_each_side = max(10, count // 10)
+    session_dir = node_mod.new_session_dir()
+    cs_proc, addr = node_mod.start_control_store(session_dir)
+    standby = node_mod.start_standby_store(session_dir, addr)
+    results = []
+
+    def rec(phase: str, **fields):
+        row = {"bench": phase, "mode": "on", "backend": backend,
+               "nodes": count, **fields}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    async def publish(start, n):
+        client = RpcClient(addr, name="bench-deaths", retries=2)
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                await client.connect()
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.1)
+        out = set()
+        for i in range(start, start + n):
+            address = f"10.8.8.{i}:{i}"
+            while True:
+                try:
+                    await client.call("report_worker_death", {
+                        "address": address, "reason": "bench",
+                        "exit_code": 137}, timeout=3)
+                    out.add(address)
+                    break
+                except Exception:  # noqa: BLE001 — store mid-failover
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(0.1)
+            await asyncio.sleep(0.02)
+        await client.close()
+        return out
+
+    plane = SimNodePlane(addr, count, seed=args.seed, watch_workers=True)
+    try:
+        await plane.start()
+        await plane.await_converged(timeout=240)
+        published = await publish(0, deaths_each_side)
+        # churn wave in flight while the store dies
+        churn = asyncio.ensure_future(
+            plane.drain_wave(max(2, count // 20), deadline_s=0.5))
+        kill_ts = time.time()
+        node_mod.kill_process(cs_proc, force=True)
+        pub_task = asyncio.ensure_future(
+            publish(deaths_each_side, deaths_each_side))
+        info = await asyncio.to_thread(
+            node_mod._wait_ready, standby.standby_ready_file, standby, 120.0)
+        published |= await pub_task
+        await churn
+        try:
+            converge_s = await plane.await_converged(timeout=240)
+        except TimeoutError:
+            converge_s = None  # recorded as the finding, not a crash
+        try:
+            deaths_s = round(
+                await plane.await_worker_deaths(published, timeout=240), 3)
+        except TimeoutError:
+            deaths_s = None  # notices_lost below carries the real count
+        stats = plane.stats()
+        watchers = [n for n in plane.alive() if n._watch_workers]
+        lost = sum(len(published - set(n.worker_deaths)) for n in watchers)
+        rec("failover",
+            detection_s=round(info["won_ts"] - kill_ts, 3),
+            takeover_s=round(info["serving_ts"] - info["won_ts"], 3),
+            converge_membership_s=converge_s,
+            converge_deaths_s=deaths_s,
+            epoch=info["epoch"],
+            deaths_published=len(published),
+            notices_lost=lost,
+            notices_dup=stats["worker_dup_applied"],
+            subscriber_failovers=stats["store_failovers"],
+            protocol_errors=len(stats["protocol_errors"]))
+    finally:
+        await plane.stop()
+        node_mod.kill_process(cs_proc, force=True)
+        node_mod.kill_process(standby, force=True)
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=0,
@@ -294,6 +407,14 @@ def main():
                     help="wall cap on the lease-spillback phase; partial "
                          "grants are recorded with timed_out=true")
     ap.add_argument("--out", default="")
+    ap.add_argument("--failover", choices=["off", "file", "sqlite", "both"],
+                    default="off",
+                    help="run the HA failover column after the mode sweep "
+                         "(kill+takeover under a death-notice stream) with "
+                         "the given persistence backend(s)")
+    ap.add_argument("--failover-only", action="store_true",
+                    help="skip the off/on mode sweep; run only the "
+                         "failover column")
     args = ap.parse_args()
     if not args.nodes:
         args.nodes = 100 if args.quick else 1000
@@ -302,8 +423,18 @@ def main():
 
     modes = ["off", "on"] if args.mode == "both" else [args.mode]
     all_results = []
-    for mode in modes:
-        all_results.extend(asyncio.run(run_mode(mode, args)))
+    if not args.failover_only:
+        for mode in modes:
+            all_results.extend(asyncio.run(run_mode(mode, args)))
+    if args.failover != "off":
+        backends = (["file", "sqlite"] if args.failover == "both"
+                    else [args.failover])
+        # the failover column runs at a bounded plane size: the claim is
+        # zero-loss under churn, which 500 nodes already proves
+        fo_args = argparse.Namespace(**vars(args))
+        fo_args.nodes = min(args.nodes, 500)
+        for backend in backends:
+            all_results.extend(asyncio.run(run_failover(fo_args, backend)))
     if args.out:
         with open(args.out, "w") as f:
             json.dump({

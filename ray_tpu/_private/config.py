@@ -175,8 +175,15 @@ _flag("usage_stats_enabled", True, "Record cluster metadata + library-usage tags
 _flag("resource_gossip_period_s", 0.5, "Peer-to-peer resource-view gossip period (reference: ray_syncer.h:91 bidi resource-view streams between raylets); 0 disables — the control-store heartbeat piggyback remains the baseline sync.")
 _flag("resource_gossip_fanout", 2, "Random peers contacted per gossip round.")
 _flag("object_store_destructive_eviction", False, "Let a full store DESTROY LRU unpinned objects on create (cache semantics). Default off: full stores backpressure creators and rely on spilling — destroying a sole copy of an owned object is silent data loss (reference: plasma never evicts primary copies).")
-_flag("control_store_persist", False, "Persist control-store state (nodes/actors/PGs/KV/jobs) to a WAL+snapshot in the session dir; a restarted control store recovers it (reference: gcs redis/rocksdb store clients).")
+_flag("control_store_persist", False, "Persist control-store state (nodes/actors/PGs/KV/jobs/worker-death records) to a WAL+snapshot in the session dir; a restarted control store recovers it (reference: gcs redis/rocksdb store clients).")
 _flag("control_store_wal_compact_every", 512, "WAL records between snapshot compactions.")
+
+# --- control-store HA (pluggable persistence, warm-standby failover,
+# epoch fencing — _private/persistence.py, store_ha.py) ---
+_flag("control_store_backend", "file", "Persistence backend behind the control store's WAL/snapshot: 'file' (msgpack snapshot + append-only WAL files, the default) or 'sqlite' (one embedded store.sqlite3 with seq-keyed WAL rows and transactional epoch fencing — the rocksdb-style shape of the reference's gcs store clients). Both support warm-standby tailing and fencing.")
+_flag("store_standby_enabled", False, "Spawn a warm-standby control store next to the primary (implies control_store_persist): the standby tails the shared WAL into live tables and takes over at the primary's address on its death (flock release, instant) or wedge (lease stale past store_failover_timeout_s), bumping the fencing epoch so the old primary cannot apply a late mutation. Subscribers ride their cursor reconcile to resubscribe with zero lost notices (reference: GCS HA via store-backed state + leader election).")
+_flag("store_failover_timeout_s", 10.0, "Standby takeover threshold for a WEDGED primary: the leadership lease going unrenewed this long declares the leader dead even though its process (and flock) lives. Outright process death frees the flock and fails over without waiting this out. Keep well above store_fence_epoch_renew_s.")
+_flag("store_fence_epoch_renew_s", 1.0, "Cadence of the active leader's lease renewal AND the standby's staleness/tail poll. A leader whose renewal discovers a newer fencing epoch exits immediately (it has been superseded); the persistence backends independently refuse its late WAL mutations.")
 _flag("lineage_cache_max_tasks", 4096, "Completed task specs kept per owner for lineage reconstruction of lost shm objects (reference: task_manager lineage pinning).")
 _flag("max_lineage_reconstructions", 3, "Times one lost object may be recomputed from lineage before get() raises ObjectLostError (reference: object_recovery_manager.h retry cap).")
 _flag("max_pending_lease_requests", 16, "In-flight lease requests per scheduling key (reference: normal_task_submitter.h:57 LeaseRequestRateLimiter) — recycled leases serve queued submissions; fetchers only prime the pump.")

@@ -32,6 +32,7 @@ from ray_tpu._private import flight_recorder
 from ray_tpu._private import protocol as pb
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.errors import RpcError
+from ray_tpu._private.persistence import FencedError
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.protocol import NodeInfo, ResourceSet, TaskSpec
 from ray_tpu.runtime.rpc import RpcClient, RpcServer
@@ -277,7 +278,7 @@ class ControlStore:
     PGs, jobs, and KV intact (reference: gcs store_client persistence +
     GcsActorManager/GcsNodeManager restart recovery)."""
 
-    def __init__(self, persist_dir: Optional[str] = None):
+    def __init__(self, persist_dir: Optional[str] = None, epoch: int = 0):
         self.server = RpcServer(name="control_store")
         self.pubsub = PubSub(self.server)
         # structured cluster events (reference: the export-event pipeline —
@@ -336,6 +337,15 @@ class ControlStore:
         # changes), not O(nodes)
         self._node_version = 0
         self._node_deltas: collections.deque = collections.deque()
+        # versioned worker-death delta plane (mirrors the node table): every
+        # "workers"-channel notice is stamped with `_wv` and appended to a
+        # bounded delta log, so subscribers that missed notices reconcile
+        # from their cursor (get_workers_delta) — O(missed deaths), not a
+        # full list_dead_workers snapshot per gap. Versions are PERSISTED
+        # with each death record, so client cursors stay valid across a
+        # store failover and the delta pull replays exactly what was missed.
+        self._worker_version = 0
+        self._worker_deltas: collections.deque = collections.deque()
         # availability-change log for heartbeat view deltas: the reply to a
         # cursor-carrying heartbeat lists only nodes whose availability (or
         # pending load) CHANGED since the daemon's cursor — the O(nodes)
@@ -352,22 +362,40 @@ class ControlStore:
         self._stopped = False
         self._wal = None
         self._compacting = False
+        self._recovered = False  # warm standby loads tables before start()
+        self.epoch = epoch
         if persist_dir and GLOBAL_CONFIG.get("control_store_persist"):
             from ray_tpu._private.persistence import WalStore
 
             self._wal = WalStore(
                 persist_dir,
                 compact_every=GLOBAL_CONFIG.get("control_store_wal_compact_every"),
+                epoch=epoch,
             )
 
     # ------------------------------------------------------------------
     # persistence (reference: gcs/store_client/)
     # ------------------------------------------------------------------
 
+    def _fenced(self, where: str):
+        """A newer leader owns the persist dir: this process must stop
+        serving NOW — acking one more mutation would split-brain the
+        cluster's view of durable state."""
+        flight_recorder.record("store", "fenced", where=where,
+                               epoch=self.epoch)
+        logger.critical(
+            "control store FENCED (%s): epoch %d superseded by a newer "
+            "leader; exiting", where, self.epoch)
+        flight_recorder.crash_dump("store_fenced")
+        os._exit(3)
+
     def _persist(self, op: str, data: dict):
         if self._wal is None:
             return
-        due = self._wal.append({"op": op, "d": data})
+        try:
+            due = self._wal.append({"op": op, "d": data})
+        except FencedError:
+            self._fenced(f"wal append {op}")
         if due and not self._compacting:
             # copy state + rotate synchronously (cheap, consistent with all
             # appends so far), then pack+fsync on a worker thread so the
@@ -379,6 +407,8 @@ class ControlStore:
             async def compact():
                 try:
                     await asyncio.to_thread(self._wal.write_snapshot, state)
+                except FencedError:
+                    self._fenced("snapshot compaction")
                 except Exception:  # noqa: BLE001 — wal.old survives; rotate() merges it
                     logger.exception("snapshot compaction failed; WAL retained")
                 finally:
@@ -396,17 +426,42 @@ class ControlStore:
         # the live tables.
         return {
             "nodes": [n.to_wire() for n in self.nodes.values()],
+            "node_version": self._node_version,
             "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
             "jobs": [dict(j) for j in self.jobs.values()],
             "next_job": self._next_job,
             "actors": [r.to_persist() for r in self.actors.values()],
             "pgs": [r.to_persist() for r in self.placement_groups.values()],
+            # worker-death records + their delta-plane version: a failed-over
+            # store resumes the same version counter, so subscriber cursors
+            # stay valid and a post-failover reconcile replays exactly the
+            # missed deaths instead of a full table
+            "dead_workers": [
+                {"address": addr, **rec}
+                for addr, rec in self.dead_worker_addresses.items()
+            ],
+            "worker_version": self._worker_version,
         }
+
+    def _reset_tables(self):
+        """Drop every persisted-state table (warm-standby re-seed from a
+        fresh snapshot after the tail detected a compaction gap)."""
+        self.nodes.clear()
+        self.kv = {}
+        self.jobs.clear()
+        self.actors.clear()
+        self.named_actors.clear()
+        self.placement_groups.clear()
+        self.dead_worker_addresses.clear()
+        self._node_deltas.clear()
+        self._worker_deltas.clear()
 
     def _apply_snapshot(self, snap: dict):
         for nw in snap.get("nodes", []):
             info = NodeInfo.from_wire(nw)
             self.nodes[info.node_id.binary()] = info
+        self._node_version = max(self._node_version,
+                                 int(snap.get("node_version", 0) or 0))
         self.kv = {ns: dict(kvs) for ns, kvs in snap.get("kv", {}).items()}
         for job in snap.get("jobs", []):
             self.jobs[job["job_id"]] = job
@@ -417,12 +472,29 @@ class ControlStore:
         for pw in snap.get("pgs", []):
             rec = PlacementGroupRecord.from_persist(pw)
             self.placement_groups[rec.pg_id.binary()] = rec
+        for dw in snap.get("dead_workers", []):
+            dw = dict(dw)
+            addr = dw.pop("address", "")
+            if addr:
+                self.dead_worker_addresses[addr] = dw
+        self._worker_version = max(self._worker_version,
+                                   int(snap.get("worker_version", 0) or 0))
 
     def _apply_wal_record(self, rec: dict):
         op, d = rec["op"], rec["d"]
         if op == "node":
             info = NodeInfo.from_wire(d)
             self.nodes[info.node_id.binary()] = info
+            ver = d.get("_v")
+            if ver is not None and ver > self._node_version:
+                # resume the delta-plane version counter AND rebuild the
+                # recent-mutation log, so subscriber cursors from the old
+                # incarnation stay valid after a failover
+                self._node_version = ver
+                self._node_deltas.append((ver, dict(d)))
+                retention = GLOBAL_CONFIG.get("node_delta_retention")
+                while len(self._node_deltas) > retention:
+                    self._node_deltas.popleft()
         elif op == "kv_put":
             self.kv.setdefault(d["ns"], {})[d["key"]] = d["value"]
         elif op == "kv_del":
@@ -449,6 +521,39 @@ class ControlStore:
             # dead-node retention tombstone: the record was pruned while
             # this WAL segment was live — don't resurrect it
             self.nodes.pop(d["node_id"], None)
+        elif op == "worker_dead":
+            d = dict(d)
+            addr = d.pop("address", "")
+            if addr:
+                self.dead_worker_addresses[addr] = d
+                self.dead_worker_addresses.move_to_end(addr)
+                wv = d.get("_wv")
+                if wv is not None and wv > self._worker_version:
+                    self._worker_version = wv
+                    self._worker_deltas.append((wv, {
+                        "address": addr, "dead": True,
+                        "reason": d.get("reason", ""),
+                        "exit_code": d.get("exit_code"), "_wv": wv,
+                    }))
+                    retention = GLOBAL_CONFIG.get("node_delta_retention")
+                    while len(self._worker_deltas) > retention:
+                        self._worker_deltas.popleft()
+        elif op == "worker_live":
+            # a recycled address re-registered: its death record is stale —
+            # drop it from the table AND the rebuilt delta log (a cursor
+            # replay must not reap the live process's borrows), and resume
+            # the version line the live delta advanced
+            addr = d.get("address", "")
+            self.dead_worker_addresses.pop(addr, None)
+            if any(w.get("address") == addr for _, w in self._worker_deltas):
+                self._worker_deltas = collections.deque(
+                    (v, w) for v, w in self._worker_deltas
+                    if w.get("address") != addr)
+            wv = d.get("_wv")
+            if wv is not None and wv > self._worker_version:
+                self._worker_version = wv
+                self._worker_deltas.append(
+                    (wv, {"address": addr, "dead": False, "_wv": wv}))
 
     def _recover(self):
         snap, wal_records = self._wal.recover()
@@ -461,6 +566,14 @@ class ControlStore:
                 logger.exception("skipping bad WAL record")
         if not snap and not wal_records:
             return
+        self._activate_recovered()
+
+    def _activate_recovered(self):
+        """Post-recovery activation (leader side only, after the tables are
+        loaded — from recover() or a warm-standby tail): heartbeat grace,
+        retention-order/name-index rebuilds, and re-spawning the async work
+        (actor creations, PG scheduling) that was in flight when the
+        previous incumbent died."""
         now = time.monotonic()
         for nid, info in self.nodes.items():
             if info.state == pb.NODE_ALIVE:
@@ -497,8 +610,9 @@ class ControlStore:
     # ------------------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
-        if self._wal is not None:
+        if self._wal is not None and not self._recovered:
             self._recover()
+        self._recovered = True
         self.server.register_service(self)
         self.server.on_disconnect(self._on_disconnect)
         addr = await self.server.start(host, port)
@@ -669,9 +783,11 @@ class ControlStore:
                 self._mark_worker_dead(addr, reason=f"node died: {reason}")
         self._event("node", "DEAD", reason, node_id=info.node_id.hex(),
                     expected=expected)
-        self._persist("node", info.to_wire())
         self._bump_avail(node_id)  # cursor readers see the removal
         notice = self._record_node_delta(info)
+        # persist the _v-stamped wire: a failed-over store resumes the same
+        # delta-plane version counter, keeping subscriber cursors valid
+        self._persist("node", notice)
         replicas = self.drained_replicas.get(node_id)
         if expected and replicas:
             # expected death with pre-replicated primaries: the notice tells
@@ -720,7 +836,6 @@ class ControlStore:
         self.node_available[info.node_id.binary()] = info.resources
         self.node_last_beat[info.node_id.binary()] = time.monotonic()
         self.node_conns[info.node_id.binary()] = conn_id
-        self._persist("node", info.to_wire())
         logger.info(
             "node %s registered at %s resources=%s",
             info.node_id.hex()[:8], info.address, info.resources.to_dict(),
@@ -729,7 +844,9 @@ class ControlStore:
                     node_id=info.node_id.hex(),
                     resources=info.resources.to_dict())
         self._bump_avail(info.node_id.binary())
-        self.pubsub.publish("nodes", self._record_node_delta(info))
+        wire = self._record_node_delta(info)
+        self._persist("node", wire)
+        self.pubsub.publish("nodes", wire)
         if payload.get("lean"):
             # scale mode: the joiner pulls the membership snapshot once via
             # get_nodes_delta(cursor=-1) instead of every register reply
@@ -963,9 +1080,10 @@ class ControlStore:
         self._event("node", "DRAINING", f"drain requested ({reason})",
                     node_id=info.node_id.hex(), reason=reason,
                     deadline_s=deadline_s)
-        self._persist("node", info.to_wire())
         self._bump_avail(node_id)  # draining nodes leave the scheduling view
-        self.pubsub.publish("nodes", self._record_node_delta(info))
+        wire = self._record_node_delta(info)
+        self._persist("node", wire)
+        self.pubsub.publish("nodes", wire)
         if deadline_s:
             # terminal drain (preemption/manual removal): migrate resident
             # actors NOW so they restart warm elsewhere instead of crash-
@@ -1041,9 +1159,10 @@ class ControlStore:
         info.drain_reason = ""
         info.drain_deadline = 0.0
         self.drained_replicas.pop(node_id, None)
-        self._persist("node", info.to_wire())
         self._bump_avail(node_id)
-        self.pubsub.publish("nodes", self._record_node_delta(info))
+        wire = self._record_node_delta(info)
+        self._persist("node", wire)
+        self.pubsub.publish("nodes", wire)
         return {"ok": True}
 
     async def rpc_unregister_node(self, conn_id: int, payload: dict) -> dict:
@@ -1063,25 +1182,55 @@ class ControlStore:
     # authoritative notices, never off ping timeouts)
     # ------------------------------------------------------------------
 
+    def _record_worker_delta(self, notice: dict) -> dict:
+        """Stamp a workers-channel mutation into the bounded delta log;
+        returns the wire dict (carrying `_wv`) that both the pubsub notice
+        and any cursor reconcile will see — one ordered history, two
+        transports (the node table's `_record_node_delta`, mirrored)."""
+        self._worker_version += 1
+        wire = {**notice, "_wv": self._worker_version}
+        self._worker_deltas.append((self._worker_version, wire))
+        retention = GLOBAL_CONFIG.get("node_delta_retention")
+        while len(self._worker_deltas) > retention:
+            self._worker_deltas.popleft()
+        return wire
+
     def _mark_worker_dead(self, address: str, reason: str = "",
                           exit_code: Optional[int] = None):
+        if address in self.dead_worker_addresses:
+            # idempotent: a retried report (lost reply, failover replay)
+            # must not mint a SECOND death with a fresh _wv — subscribers
+            # would apply it twice, breaking the zero-dup guarantee. A
+            # legitimate re-death is preceded by a re-registration, which
+            # durably clears the record (worker_live).
+            return
         flight_recorder.record("worker", "dead", address=address,
                                reason=reason, exit_code=exit_code)
+        notice = self._record_worker_delta({
+            "address": address, "dead": True,
+            "reason": reason, "exit_code": exit_code,
+        })
         self.dead_worker_addresses[address] = {
             "ts": time.time(), "reason": reason, "exit_code": exit_code,
+            "_wv": notice["_wv"],
         }
         self.dead_worker_addresses.move_to_end(address)
         while len(self.dead_worker_addresses) > 65536:
             self.dead_worker_addresses.popitem(last=False)
+        # the death record must survive a failover: a standby that never
+        # heard this notice still has to answer the cursor reconciles that
+        # replay it (zero-loss resubscribe is only as strong as the
+        # durability of what is being resubscribed to)
+        self._persist("worker_dead", {
+            "address": address, "ts": time.time(), "reason": reason,
+            "exit_code": exit_code, "_wv": notice["_wv"],
+        })
         # authoritative worker-failure notice (reference: the GCS
         # WORKER_DELTA pubsub channel): owners subscribe so borrow
         # reconciliation and recovery react to the recorded death instead
         # of waiting out probe timeouts. The structured {reason, exit_code}
         # lets error messages say WHY (preempted vs OOM vs crash vs drained).
-        self.pubsub.publish("workers", {
-            "address": address, "dead": True,
-            "reason": reason, "exit_code": exit_code,
-        })
+        self.pubsub.publish("workers", notice)
         # drop the id index entries too (node-death and job-finish paths
         # bypass rpc_report_worker_death's by-id pop): the control store
         # must not grow a stale entry per worker/driver forever
@@ -1097,8 +1246,23 @@ class ControlStore:
         if addr:
             self.worker_addresses[addr] = payload.get("node_id", "")
             # a recycled address re-registering proves the process slot is
-            # live again; clear any stale death record
-            self.dead_worker_addresses.pop(addr, None)
+            # live again; clear any stale death record (durably: a failover
+            # must not resurrect the death and reap the live process's
+            # borrows)
+            if self.dead_worker_addresses.pop(addr, None) is not None:
+                # the superseded death must ALSO leave the delta log — a
+                # cursor reconcile spanning it would otherwise replay the
+                # death of a now-live process and reap its borrows. The
+                # "live" delta takes its place so cursor readers see the
+                # clear (full pulls already exclude cleared records).
+                self._worker_deltas = collections.deque(
+                    (v, w) for v, w in self._worker_deltas
+                    if w.get("address") != addr)
+                live = self._record_worker_delta(
+                    {"address": addr, "dead": False})
+                self._persist("worker_live",
+                              {"address": addr, "_wv": live["_wv"]})
+                self.pubsub.publish("workers", live)
             wid = payload.get("worker_id")
             if wid:
                 self.worker_addr_by_id[wid] = addr
@@ -1121,15 +1285,38 @@ class ControlStore:
                                    exit_code=payload.get("exit_code"))
         return {"ok": True}
 
-    async def rpc_list_dead_workers(self, conn_id: int, payload: dict) -> dict:
-        """Recent authoritative worker-death records (gap reconcile: a
-        subscriber that missed "workers" notices during a failover window
-        replays these through its notice handler)."""
-        limit = int((payload or {}).get("limit", 1024))
-        items = list(self.dead_worker_addresses.items())[-limit:]
-        return {"workers": [
-            {"address": addr, "dead": True, **rec} for addr, rec in items
-        ]}
+    def _dead_worker_wires(self) -> List[dict]:
+        return [
+            {"address": addr, "dead": True, "reason": rec.get("reason", ""),
+             "exit_code": rec.get("exit_code"), "ts": rec.get("ts"),
+             "_wv": rec.get("_wv", 0)}
+            for addr, rec in self.dead_worker_addresses.items()
+        ]
+
+    async def rpc_get_workers_delta(self, conn_id: int, payload) -> dict:
+        """Cursor reconcile for "workers"-channel subscribers: every death
+        notice published since `cursor` in publish order, or one full
+        retained-record snapshot when the cursor predates the bounded delta
+        log. The wires are the SAME dicts the pubsub published (incl.
+        `_wv`) — a subscriber that missed notices replays exactly what it
+        missed, through the same handler (the node table's
+        get_nodes_delta, mirrored; this replaces the legacy
+        list_dead_workers snapshot path)."""
+        cursor = int((payload or {}).get("cursor", -1))
+        if cursor == self._worker_version:
+            return {"version": self._worker_version, "updates": []}
+        if (cursor < 0 or cursor > self._worker_version
+                or not self._worker_deltas
+                or cursor < self._worker_deltas[0][0] - 1):
+            # cursor predates the retained log — or POSTDATES our counter
+            # (a restarted, unpersisted store): full snapshot either way,
+            # and the client RESETS its cursor to our version
+            return {"version": self._worker_version, "full": True,
+                    "workers": self._dead_worker_wires()}
+        return {
+            "version": self._worker_version,
+            "updates": [w for ver, w in self._worker_deltas if ver > cursor],
+        }
 
     async def rpc_check_worker_liveness(self, conn_id: int, payload: dict) -> dict:
         """Authoritative death lookup for a worker/driver RPC address:
@@ -1249,6 +1436,8 @@ class ControlStore:
         reply = {"ok": True, "seq": self.pubsub.channel_seq(channel)}
         if channel == "nodes":
             reply["version"] = self._node_version
+        elif channel == "workers":
+            reply["version"] = self._worker_version
         return reply
 
     async def rpc_pubsub_stats(self, conn_id: int, payload) -> dict:
@@ -1982,36 +2171,183 @@ async def _wait_port_free(host: str, port: int, timeout_s: float = 60.0):
         await asyncio.sleep(0.5)
 
 
+def _standby_apply(store: ControlStore, items: list) -> int:
+    """Fold tailed WAL items into the standby's warm tables. A "snapshot"
+    item means the leader compacted past what we saw: reset and re-seed."""
+    applied = 0
+    for kind, payload in items:
+        try:
+            if kind == "snapshot":
+                store._reset_tables()
+                store._apply_snapshot(payload)
+            else:
+                store._apply_wal_record(payload)
+            applied += 1
+        except Exception:  # noqa: BLE001 — skip bad record, keep the rest
+            logger.exception("standby: skipping bad tailed record")
+    return applied
+
+
+async def _standby_wait(store: ControlStore, persist_dir: str, lease) -> str:
+    """Warm-standby wait loop: tail the WAL into live tables while watching
+    for leadership — the flock freeing (leader process died; zero-latency
+    kernel wakeup) or the lease going stale past `store_failover_timeout_s`
+    (leader alive but WEDGED; the flock never frees, the lease stops
+    renewing). Returns how leadership was won; the open tailer and any won
+    flock are stashed on the store for the takeover sequence."""
+    import fcntl
+    import threading
+
+    from ray_tpu._private import persistence
+
+    flight_recorder.record("store", "standby_waiting", dir=persist_dir)
+    tail = persistence.open_tailer(persist_dir)
+    loop = asyncio.get_running_loop()
+    won_flock = asyncio.Event()
+    holder: list = []
+
+    def park_on_flock():
+        f = _leader_lock_file(persist_dir)
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)  # parks until leader death
+        holder.append(f)
+        loop.call_soon_threadsafe(won_flock.set)
+
+    threading.Thread(target=park_on_flock, daemon=True).start()
+    period = min(0.25, GLOBAL_CONFIG.get("store_fence_epoch_renew_s"))
+    timeout = GLOBAL_CONFIG.get("store_failover_timeout_s")
+    tailed = 0
+    while True:
+        tailed += _standby_apply(store, tail.poll())
+        if won_flock.is_set():
+            mode = "leader_died"
+            break
+        # stale-lease takeover covers the wedged-zombie case — but only
+        # when a leader ever held the lease (an empty dir must wait for
+        # the flock, not preempt a primary that is still starting up)
+        if lease.read() and lease.staleness_s() > timeout:
+            mode = "lease_stale"
+            break
+        await asyncio.sleep(period)
+    logger.info("standby won leadership (%s) after tailing %d record(s)",
+                mode, tailed)
+    store._standby_tail = tail
+    # pin the won flock (if any) for the process lifetime — dropping the
+    # file object would release the kernel lock and let a second standby
+    # "win" while we serve
+    store._leader_flock = holder
+    return mode
+
+
+async def _lease_renew_loop(store: ControlStore, lease):
+    """The active leader's heartbeat on the lease file. A failed renewal
+    means a newer epoch took over: this process is FENCED and exits before
+    it can ack another mutation (its WAL handle is fenced independently —
+    this loop just makes the exit prompt instead of lazy)."""
+    period = GLOBAL_CONFIG.get("store_fence_epoch_renew_s")
+    while True:
+        await asyncio.sleep(period)
+        try:
+            ok = await asyncio.to_thread(lease.renew)
+        except OSError:
+            continue  # transient fs hiccup; the WAL fence still protects
+        if not ok:
+            store._fenced("lease renewal")
+
+
 async def run_control_store(host: str, port: int, ready_file: Optional[str] = None,
                             persist_dir: Optional[str] = None,
                             standby: bool = False):
-    """Serve the control store; with `standby=True`, block on the
-    leadership lock, wait for the leader's port to free, then recover from
-    the shared WAL ONCE and serve at the SAME address — clients'
-    auto-reconnect finds the new incumbent without re-configuration
-    (reference: GCS HA = leader election + Redis/RocksDB-backed state +
-    NotifyGCSRestart fan-out; here the restart notification is the daemons'
-    re-register-on-unknown heartbeat path)."""
+    """Serve the control store; with `standby=True`, tail the shared WAL
+    into warm in-memory tables while waiting for leadership (leader death
+    frees the flock instantly; a wedged leader's lease goes stale), then
+    bump the fencing epoch, fold the tail into a fresh snapshot — which
+    unlinks the old leader's WAL so a zombie cannot apply a late mutation —
+    and serve at the SAME address: clients' auto-reconnect finds the new
+    incumbent without re-configuration (reference: GCS HA = leader election
+    + Redis/RocksDB-backed state + NotifyGCSRestart fan-out; here the
+    restart notification is the daemons' re-register-on-unknown heartbeat
+    path plus the subscribers' seq-mismatch cursor reconcile)."""
+    from ray_tpu._private.store_ha import LeaderLease
+
     lock = None
+    lease = LeaderLease(persist_dir) if persist_dir else None
     if standby:
         if not persist_dir or port == 0:
             raise ValueError(
                 "standby mode needs --persist-dir (shared WAL) and a fixed "
                 "--port (takeover address)")
         GLOBAL_CONFIG.apply_system_config({"control_store_persist": True})
-        lock = await _acquire_leadership(persist_dir, blocking=True)
-        logger.info("standby won leadership")
-        if not any(
-            name != "LEADER" for name in os.listdir(persist_dir)
-        ):
+        store = ControlStore(persist_dir=None)  # warm tables, no WAL yet
+        mode = await _standby_wait(store, persist_dir, lease)
+        won_ts = time.time()
+        stale_pid = lease.read().get("pid")  # before acquire() overwrites it
+        epoch = lease.acquire()
+        flight_recorder.record("store", "takeover", epoch=epoch, mode=mode)
+        from ray_tpu._private.persistence import WalStore
+
+        # attach the WAL at the bumped epoch FIRST: the sqlite backend
+        # fences the old leader's appends at this instant, so the final
+        # tail drain below is guaranteed complete
+        wal = WalStore(
+            persist_dir,
+            compact_every=GLOBAL_CONFIG.get("control_store_wal_compact_every"),
+            epoch=epoch,
+        )
+        tail = store._standby_tail
+        # final drain: loop while the tail holds back records behind an
+        # uncovered seq gap (a snapshot read that raced the dead leader's
+        # last compaction) — with the leader gone/fenced, the covering
+        # snapshot is stable and a few retries must resolve it
+        for attempt in range(20):
+            items = tail.poll()
+            _standby_apply(store, items)
+            if not items and tail.drained:
+                break
+            if not tail.drained:
+                await asyncio.sleep(0.05)
+        else:
             logger.error(
-                "taking over %s but it holds no WAL/snapshot — the old "
-                "leader persisted nothing; serving EMPTY state", persist_dir)
-        # recovery must run exactly once: re-running it per bind retry
-        # would replay the WAL onto populated tables and double-spawn
-        # pending actor/PG scheduling
+                "takeover drain still holding records behind a seq gap "
+                "after retries; proceeding with the last covered state")
+        tail.close()
+        wal.adopt_seq(tail.last_seq)
+        store._wal = wal
+        store.epoch = epoch
+        store._recovered = True  # tables came from the tail, not recover()
+        if not store.nodes and not store.kv and not store.actors:
+            logger.warning(
+                "taking over %s with EMPTY state — the old leader "
+                "persisted nothing (control_store_persist off?)", persist_dir)
+        # fold everything into a fresh epoch-owned snapshot; for the file
+        # backend this unlinks the old leader's WAL inode (the fence)
+        wal.snapshot(store._snapshot_state())
+        store._activate_recovered()
+        if mode == "lease_stale" and stale_pid and stale_pid != os.getpid():
+            # a WEDGED leader never runs its renewal loop, so it will
+            # neither fence-exit nor release the takeover port — it is
+            # already fenced at the durable layer, so finish the job
+            # (same-host STONITH) before waiting on its socket
+            logger.warning(
+                "killing wedged old leader pid=%s (lease stale, fenced "
+                "at epoch %d)", stale_pid, epoch)
+            try:
+                os.kill(int(stale_pid), 9)
+            except (OSError, ValueError):
+                pass  # already gone
         await _wait_port_free(host, port)
-    elif persist_dir:
+        addr = await store.start(host, port)
+        serving_ts = time.time()
+        spawn(_lease_renew_loop(store, lease))
+        logger.info("standby takeover complete: serving at %s (epoch %d)",
+                    addr, epoch)
+        if ready_file:
+            with open(ready_file, "w") as f:
+                json.dump({"address": addr, "epoch": epoch, "mode": mode,
+                           "won_ts": won_ts, "serving_ts": serving_ts}, f)
+        await asyncio.Event().wait()  # run forever
+        return
+    epoch = 0
+    if persist_dir:
         # the active leader always marks leadership, persist flag or not —
         # otherwise a standby pointed here would instantly "win" while the
         # leader is alive
@@ -2019,11 +2355,14 @@ async def run_control_store(host: str, port: int, ready_file: Optional[str] = No
         if lock is None:
             raise RuntimeError(
                 f"another control store already leads {persist_dir}")
-    store = ControlStore(persist_dir=persist_dir)
+        epoch = lease.acquire()
+    store = ControlStore(persist_dir=persist_dir, epoch=epoch)
     addr = await store.start(host, port)
+    if lease is not None and lease.epoch:
+        spawn(_lease_renew_loop(store, lease))
     if ready_file:
         with open(ready_file, "w") as f:
-            json.dump({"address": addr}, f)
+            json.dump({"address": addr, "epoch": epoch}, f)
     _ = lock  # pinned for process lifetime
     await asyncio.Event().wait()  # run forever
 

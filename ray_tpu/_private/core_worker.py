@@ -577,6 +577,14 @@ class CoreWorker:
         # runs deferred; by then the cursor has advanced past the shed
         # window); also re-armed by gaps landing while a reconcile flies
         self._nodes_reconcile_from: Optional[int] = None
+        # workers-channel version cursor: worker-death notices carry `_wv`
+        # and reconcile via get_workers_delta(cursor) — the same versioned-
+        # delta plane the node table rides (the legacy list_dead_workers
+        # snapshot path is gone). Versions are persisted store-side, so the
+        # cursor survives a control-store failover and the post-failover
+        # reconcile replays exactly the missed deaths.
+        self._worker_table_version = -1
+        self._workers_reconcile_from: Optional[int] = None
         # granted-but-idle worker leases by scheduling key, reused by the
         # next same-shaped task (reference: normal_task_submitter lease
         # pools). Each entry: {"idle": [lease...], "waiters": deque[Future]}.
@@ -705,6 +713,13 @@ class CoreWorker:
                             or self._node_table_version
                             < self._nodes_reconcile_from):
                         self._nodes_reconcile_from = self._node_table_version
+                elif channel == "workers":
+                    # same pre-gap floor pinning for the workers cursor
+                    if (self._workers_reconcile_from is None
+                            or self._worker_table_version
+                            < self._workers_reconcile_from):
+                        self._workers_reconcile_from = \
+                            self._worker_table_version
                 self._spawn_gap_reconcile()
             self._channel_seq[channel] = seq if last is None else max(last, seq)
 
@@ -723,17 +738,53 @@ class CoreWorker:
         gap = False
         pending: Dict[str, int] = {}
         for channel in ("nodes", "workers"):
+            # capture the cursor BEFORE the subscribe lands: the instant
+            # the store-side subscription exists, stream notices can
+            # max-advance the cursor past the missed window, and both the
+            # version comparison and the reconcile's from-cursor pull
+            # would go blind to the gap
+            cursor = (self._node_table_version if channel == "nodes"
+                      else self._worker_table_version)
             reply = await self.control.call("subscribe", {"channel": channel})
             server_seq = reply.get("seq")
             if server_seq is None:
                 continue
             last = self._channel_seq.get(channel)
-            if resync and server_seq != last:
+            # the ephemeral publish seq alone is NOT a sufficient
+            # same-stream check: a failed-over store restarts its seq
+            # counters, and if it published exactly as many notices as we
+            # had seen, the counters COINCIDE while the content differs.
+            # The persisted version cursor (resumed across failovers)
+            # breaks the tie.
+            version_moved = (reply.get("version") is not None
+                             and reply["version"] != cursor)
+            if resync and (server_seq != last or version_moved):
                 gap = True
+                if channel == "nodes":
+                    if (self._nodes_reconcile_from is None
+                            or cursor < self._nodes_reconcile_from):
+                        self._nodes_reconcile_from = cursor
+                else:
+                    if (self._workers_reconcile_from is None
+                            or cursor < self._workers_reconcile_from):
+                        self._workers_reconcile_from = cursor
                 logger.info(
-                    "%s-channel gap detected (last seen %s, server at %s)",
-                    channel, last, server_seq)
+                    "%s-channel gap detected (last seen %s, server at %s; "
+                    "version %s vs cursor %s)",
+                    channel, last, server_seq, reply.get("version"), cursor)
             pending[channel] = server_seq
+        if resync:
+            # failover telemetry: outage as this subscriber saw it, and
+            # whether the reconnect landed on a NEW store incarnation (the
+            # seq mismatch) rather than a TCP blip to the same one
+            from ray_tpu._private import store_ha
+
+            outage = None
+            if self.control.last_disconnect_ts is not None:
+                outage = time.monotonic() - self.control.last_disconnect_ts
+            store_ha.record_store_reconnect(
+                "driver" if self.mode == MODE_DRIVER else "worker",
+                outage, new_incarnation=gap)
         if gap and not await self._reconcile_death_records():
             # reconcile failed (store still mid-failover): keep the OLD
             # last-seen seqs so the next reconnect re-detects this gap —
@@ -751,6 +802,8 @@ class CoreWorker:
         while True:
             floor = self._nodes_reconcile_from
             self._nodes_reconcile_from = None
+            wfloor = self._workers_reconcile_from
+            self._workers_reconcile_from = None
             try:
                 if GLOBAL_CONFIG.get("node_table_delta_sync"):
                     # cursor pull: exactly the node mutations published
@@ -774,19 +827,40 @@ class CoreWorker:
                     # cursor back DOWN after a store restart's counter
                     # reset (the stream path's monotonic guard never would)
                     self._node_table_version = version
-                dead = (await self.control.call(
-                    "list_dead_workers", {})).get("workers", [])
+                # workers-channel cursor pull: the deaths published since
+                # the pre-gap cursor, replayed through the stream handler
+                # (idempotent; the _wv guard drops anything already seen)
+                wreply = await self.control.call(
+                    "get_workers_delta",
+                    {"cursor": wfloor if wfloor is not None
+                     else self._worker_table_version})
+                dead = wreply.get("updates") or wreply.get("workers") or []
                 for rec in dead:
-                    self._on_worker_notice(rec)
+                    self._apply_worker_notice(rec)
+                wversion = wreply.get("version")
+                if wversion is not None:
+                    self._worker_table_version = wversion
                 logger.info(
                     "reconciled death records after pubsub gap: %d node(s), "
                     "%d dead worker record(s)", len(nodes), len(dead))
             except Exception:  # noqa: BLE001 — control store mid-failover;
-                # the next reconnect retries the reconcile
+                # re-arm the pre-gap floors (stream notices will advance
+                # the live cursors past the missed window, so a later
+                # from-cursor pull would replay nothing) and let the next
+                # reconnect/gap signal retry from them
+                if floor is not None and (
+                        self._nodes_reconcile_from is None
+                        or floor < self._nodes_reconcile_from):
+                    self._nodes_reconcile_from = floor
+                if wfloor is not None and (
+                        self._workers_reconcile_from is None
+                        or wfloor < self._workers_reconcile_from):
+                    self._workers_reconcile_from = wfloor
                 logger.warning("death-record reconcile failed",
                                exc_info=True)
                 return False
-            if self._nodes_reconcile_from is None:
+            if (self._nodes_reconcile_from is None
+                    and self._workers_reconcile_from is None):
                 return True
 
     def _on_node_notice(self, message: dict):
@@ -864,6 +938,22 @@ class CoreWorker:
         reconciles its borrows NOW (the probe-based reaper loop stays as
         the fallback for missed pushes)."""
         self._note_channel_seq("workers", message)
+        ver = message.get("_wv")
+        if ver is not None:
+            if ver <= self._worker_table_version:
+                # stale replay: the store's coalescing window can deliver a
+                # notice AFTER the reconcile reply that already covered it.
+                # A restarted-unpersisted store's lower counter is reset by
+                # the reconcile's authoritative post-apply assignment.
+                return
+            self._worker_table_version = ver
+        self._apply_worker_notice(message)
+
+    def _apply_worker_notice(self, message: dict):
+        ver = message.get("_wv")
+        if ver is not None:
+            self._worker_table_version = max(
+                self._worker_table_version, ver)
         if not message.get("dead"):
             return
         addr = message.get("address", "")

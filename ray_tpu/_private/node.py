@@ -56,6 +56,10 @@ def start_control_store(session_dir: str, port: int = 0) -> tuple:
     # (seed, role) chaos streams as it did inside a longer run
     global _daemon_role_counter
     _daemon_role_counter = 0
+    if GLOBAL_CONFIG.get("store_standby_enabled") \
+            and not GLOBAL_CONFIG.get("control_store_persist"):
+        # a standby can only take over state the primary actually persisted
+        GLOBAL_CONFIG.apply_system_config({"control_store_persist": True})
     ready = os.path.join(session_dir, f"cs_ready_{uuid.uuid4().hex[:6]}.json")
     log = open(os.path.join(session_dir, "logs", "control_store.log"), "ab")
     proc = subprocess.Popen(
@@ -71,6 +75,34 @@ def start_control_store(session_dir: str, port: int = 0) -> tuple:
     log.close()
     info = _wait_ready(ready, proc)
     return proc, info["address"]
+
+
+def start_standby_store(session_dir: str, address: str,
+                        ready_file: str = None) -> subprocess.Popen:
+    """Spawn a warm-standby control store for the primary serving at
+    `address` over the session's shared persist dir. Returns immediately:
+    the standby tails the WAL while waiting for leadership and writes its
+    ready file (address/epoch/takeover timestamps) only at takeover."""
+    host, port = address.rsplit(":", 1)
+    if ready_file is None:
+        ready_file = os.path.join(
+            session_dir, f"cs_standby_ready_{uuid.uuid4().hex[:6]}.json")
+    log = open(os.path.join(session_dir, "logs", "control_store_standby.log"),
+               "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu._private.control_store",
+            "--host", host, "--port", port, "--standby",
+            "--ready-file", ready_file,
+            "--config-json", GLOBAL_CONFIG.serialize_overrides(),
+            "--persist-dir", os.path.join(session_dir, "control_store"),
+        ],
+        stdout=log, stderr=subprocess.STDOUT, start_new_session=True,
+        env={**os.environ, "RT_CHAOS_ROLE": "control_standby"},
+    )
+    log.close()
+    proc.standby_ready_file = ready_file
+    return proc
 
 
 # spawn-ordered chaos-role index for daemons started by THIS process: the

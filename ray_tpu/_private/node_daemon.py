@@ -388,10 +388,35 @@ class NodeDaemon:
         drains were published while we were away (control-store failover
         window), so reconcile against the full node table instead of
         trusting the stream."""
+        # capture the cursor BEFORE the subscribe lands: once the new
+        # subscription exists, stream notices can max-advance the cursor
+        # past the missed window and blind both the version comparison
+        # and the reconcile's from-cursor pull
+        pre_cursor = self._node_table_version
         reply = await self.control.call("subscribe", {"channel": "nodes"})
         server_seq = reply.get("seq")
         last_seen = self._nodes_seq
-        gap = (resync and server_seq is not None and server_seq != last_seen)
+        # seq mismatch OR version-cursor mismatch: the ephemeral seq alone
+        # can COINCIDE across a failover (new incumbent published exactly
+        # as many notices as we had seen); the persisted version cursor
+        # breaks the tie
+        gap = resync and (
+            (server_seq is not None and server_seq != last_seen)
+            or (reply.get("version") is not None
+                and reply["version"] != pre_cursor))
+        if gap and (self._nodes_reconcile_from is None
+                    or pre_cursor < self._nodes_reconcile_from):
+            self._nodes_reconcile_from = pre_cursor
+        if resync:
+            # failover telemetry: outage as this daemon saw it + whether
+            # the reconnect landed on a new store incarnation
+            from ray_tpu._private import store_ha
+
+            outage = None
+            if self.control.last_disconnect_ts is not None:
+                outage = time.monotonic() - self.control.last_disconnect_ts
+            store_ha.record_store_reconnect("daemon", outage,
+                                            new_incarnation=gap)
         if gap:
             logger.info("nodes-channel gap detected (last seen %s, server "
                         "at %s); reconciling node table", last_seen, server_seq)
@@ -424,13 +449,13 @@ class NodeDaemon:
         while True:
             floor = self._nodes_reconcile_from
             self._nodes_reconcile_from = None
+            pre = self._node_table_version
             try:
                 full = True
                 if GLOBAL_CONFIG.get("node_table_delta_sync"):
                     reply = await self.control.call(
                         "get_nodes_delta",
-                        {"cursor": floor if floor is not None
-                         else self._node_table_version})
+                        {"cursor": floor if floor is not None else pre})
                     full = bool(reply.get("full"))
                     nodes = reply.get("updates") or reply.get("nodes") or []
                     version = reply.get("version")
@@ -458,7 +483,14 @@ class NodeDaemon:
                     # reset (the stream path's monotonic guard never would)
                     self._node_table_version = version
             except Exception:  # noqa: BLE001 — store still mid-failover:
-                # the next gap signal / reconnect retries
+                # re-arm the pre-gap floor (stream notices advance the
+                # live cursor past the missed window; a later from-cursor
+                # pull would replay nothing) for the next gap signal /
+                # reconnect / heartbeat-version retry
+                used = floor if floor is not None else pre
+                if (self._nodes_reconcile_from is None
+                        or used < self._nodes_reconcile_from):
+                    self._nodes_reconcile_from = used
                 logger.warning("node-table reconcile failed", exc_info=True)
                 return False
             if self._nodes_reconcile_from is None:
@@ -701,10 +733,14 @@ class NodeDaemon:
         self._view_cursor = reply["view_version"]
         nodes_version = reply.get("nodes_version")
         if (nodes_version is not None
-                and nodes_version != self._node_table_version):
+                and nodes_version != self._node_table_version) \
+                or self._nodes_reconcile_from is not None:
             # membership moved while our pubsub stream was quiet (or shed,
-            # or the store restarted and reset its counter): pull the
-            # missed mutations from the cursor
+            # or the store restarted and reset its counter), OR a pinned
+            # pre-gap floor is waiting for a retry (its reconcile failed
+            # mid-failover; the live cursor may have caught the server
+            # version since, so the version check alone would go blind):
+            # pull the missed mutations from the cursor/floor
             self._spawn_nodes_reconcile()
 
     async def _reap_loop(self):

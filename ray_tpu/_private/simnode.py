@@ -62,6 +62,7 @@ class SimNode:
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
                  serve: bool = True, heartbeat: bool = True,
+                 watch_workers: bool = False,
                  host: str = "127.0.0.1"):
         self.index = index
         self.node_id = _derived_node_id(seed, index)
@@ -107,6 +108,21 @@ class SimNode:
         self._drain_task: Optional[asyncio.Task] = None
         self._reconcile_task: Optional[asyncio.Task] = None
         self._leases: Dict[bytes, ResourceSet] = {}
+        # workers-channel subscriber half (the failover chaos harness):
+        # exactly the core worker's machinery — _wv guard, pre-gap floor
+        # pinning, get_workers_delta cursor reconciles — with counters for
+        # the zero-loss/zero-dup assertions
+        self._watch_workers = watch_workers
+        self.worker_deaths: Dict[str, dict] = {}  # address -> notice
+        self.worker_notices = 0          # raw stream deliveries
+        self.worker_dup_applied = 0      # deaths applied more than once
+        self._workers_seq: Optional[int] = None
+        self._worker_table_version = -1
+        self._workers_reconcile_from: Optional[int] = None
+        self._workers_reconcile_task: Optional[asyncio.Task] = None
+        # store-failover telemetry (also exported via store_ha metrics)
+        self.store_reconnects = 0
+        self.store_failovers = 0
 
     # -- lifecycle ------------------------------------------------------
 
@@ -127,6 +143,15 @@ class SimNode:
         sub = await self._call("subscribe", {"channel": "nodes"})
         if sub.get("seq") is not None:
             self._nodes_seq = sub["seq"]
+        if self._watch_workers:
+            self.control.subscribe_channel("workers",
+                                           self._on_workers_message)
+            wsub = await self._call("subscribe", {"channel": "workers"})
+            if wsub.get("seq") is not None:
+                self._workers_seq = wsub["seq"]
+            # seed with the retained death records: deaths published before
+            # our subscription never produced notices we saw
+            await self._reconcile_workers(initial=True)
         info = NodeInfo(
             node_id=self.node_id,
             address=self.address,
@@ -167,6 +192,9 @@ class SimNode:
             # an in-flight cursor reconcile racing shutdown would record a
             # bogus "client closed" protocol error
             self._reconcile_task.cancel()
+        if (self._workers_reconcile_task is not None
+                and not self._workers_reconcile_task.done()):
+            self._workers_reconcile_task.cancel()
         if self.control is not None:
             await self.control.close()
         if self.server is not None:
@@ -215,15 +243,62 @@ class SimNode:
         were off the wire — mirrors NodeDaemon._subscribe_nodes(resync)."""
         if self.state == "DEAD":
             return
+        # pin the PRE-reconnect cursor NOW: no notice from the new
+        # connection can have been processed yet (the store-side
+        # subscription doesn't exist until our subscribe lands), but the
+        # moment it does, stream notices max-advance the cursor past the
+        # missed window — and a reconcile pulling from the advanced cursor
+        # (or a heartbeat version check comparing against it) would never
+        # see the gap again
+        pre_nodes = self._node_table_version
         try:
             sub = await self._call("subscribe", {"channel": "nodes"})
         except Exception:  # noqa: BLE001 — next reconnect retries
             return
         server_seq = sub.get("seq")
-        if server_seq is not None and server_seq != self._nodes_seq:
+        # the ephemeral publish seq alone is NOT a sufficient same-stream
+        # check: a failed-over store restarts its seq counters, and if it
+        # published exactly as many notices as we had seen, the counters
+        # COINCIDE while the content differs. The persisted version cursor
+        # (resumed across failovers) breaks the tie.
+        gap = (server_seq is not None and server_seq != self._nodes_seq) \
+            or (sub.get("version") is not None
+                and sub["version"] != pre_nodes)
+        if gap:
+            if (self._reconcile_from is None
+                    or pre_nodes < self._reconcile_from):
+                self._reconcile_from = pre_nodes
             self._spawn_reconcile()
         if server_seq is not None:
             self._nodes_seq = server_seq
+        if self._watch_workers:
+            pre_workers = self._worker_table_version
+            try:
+                wsub = await self._call("subscribe", {"channel": "workers"})
+            except Exception:  # noqa: BLE001 — next reconnect retries
+                return
+            wseq = wsub.get("seq")
+            if (wseq is not None and wseq != self._workers_seq) \
+                    or (wsub.get("version") is not None
+                        and wsub["version"] != pre_workers):
+                gap = True
+                if (self._workers_reconcile_from is None
+                        or pre_workers < self._workers_reconcile_from):
+                    self._workers_reconcile_from = pre_workers
+                self._spawn_workers_reconcile()
+            if wseq is not None:
+                self._workers_seq = wseq
+        # failover telemetry: outage duration + new-incarnation detection
+        from ray_tpu._private import store_ha
+
+        outage = None
+        if self.control.last_disconnect_ts is not None:
+            outage = time.monotonic() - self.control.last_disconnect_ts
+        self.store_reconnects += 1
+        if gap:
+            self.store_failovers += 1
+        store_ha.record_store_reconnect("simnode", outage,
+                                        new_incarnation=gap)
 
     async def _heartbeat_loop(self):
         period = (GLOBAL_CONFIG.get("heartbeat_period_s")
@@ -356,37 +431,139 @@ class SimNode:
         while True:
             floor = self._reconcile_from
             self._reconcile_from = None
-            if GLOBAL_CONFIG.get("node_table_delta_sync"):
-                # the initial pull after a LEAN registration must be the
-                # full snapshot (cursor -1): nodes registered before our
-                # subscribe never produced notices we saw, and the
-                # post-register cursor would skip them. Gap reconciles pull
-                # from the PRE-gap floor, not the (already advanced) cursor.
-                cursor = -1 if initial else (
-                    floor if floor is not None else self._node_table_version)
-                reply = await self._call("get_nodes_delta",
-                                         {"cursor": cursor})
-                wires = reply.get("updates") or reply.get("nodes") or []
-                if reply.get("full"):
+            pre = self._node_table_version  # cursor before this pass
+            try:
+                if GLOBAL_CONFIG.get("node_table_delta_sync"):
+                    # the initial pull after a LEAN registration must be the
+                    # full snapshot (cursor -1): nodes registered before our
+                    # subscribe never produced notices we saw, and the
+                    # post-register cursor would skip them. Gap reconciles
+                    # pull from the PRE-gap floor, not the (already
+                    # advanced) cursor.
+                    cursor = -1 if initial else (
+                        floor if floor is not None
+                        else self._node_table_version)
+                    reply = await self._call("get_nodes_delta",
+                                             {"cursor": cursor})
+                    wires = reply.get("updates") or reply.get("nodes") or []
+                    if reply.get("full"):
+                        self.membership.clear()
+                        self.alive_members = 0
+                    for nw in wires:
+                        self._apply_node_wire(nw)
+                    if reply.get("version") is not None:
+                        # authoritative assignment AFTER the apply: this is
+                        # what brings the cursor back DOWN when a restarted
+                        # store's counter reset (max-only stream notices
+                        # never would)
+                        self._node_table_version = reply["version"]
+                else:
+                    reply = await self._call("get_all_nodes", {})
                     self.membership.clear()
                     self.alive_members = 0
-                for nw in wires:
-                    self._apply_node_wire(nw)
-                if reply.get("version") is not None:
-                    # authoritative assignment AFTER the apply: this is
-                    # what brings the cursor back DOWN when a restarted
-                    # store's counter reset (max-only stream notices never
-                    # would)
-                    self._node_table_version = reply["version"]
-            else:
-                reply = await self._call("get_all_nodes", {})
-                self.membership.clear()
-                self.alive_members = 0
-                for nw in reply.get("nodes", []):
-                    self._apply_node_wire(nw)
+                    for nw in reply.get("nodes", []):
+                        self._apply_node_wire(nw)
+            except Exception:  # noqa: BLE001 — store mid-failover: the
+                # floor must survive the failure (stream notices will
+                # advance the cursor past the missed window, making a
+                # later from-cursor pull replay nothing), and the pull
+                # must retry — nothing else re-arms it once the cursor
+                # catches the server version
+                if self.state == "DEAD":
+                    return
+                used = floor if floor is not None else pre
+                if (self._reconcile_from is None
+                        or used < self._reconcile_from):
+                    self._reconcile_from = used
+                await asyncio.sleep(0.5)
+                continue
             if self._reconcile_from is None:
                 return
             initial = False  # loop pass covers a mid-flight gap signal
+
+    # -- workers-channel subscriber half (failover harness) ------------
+
+    def _on_workers_message(self, message: dict):
+        self.worker_notices += 1
+        seq = message.get("_seq")
+        if seq is not None:
+            if self._workers_seq is not None and seq > self._workers_seq + 1:
+                # pin the PRE-gap cursor before this message's _wv advances
+                # it past the shed window (the reconcile runs deferred)
+                if (self._workers_reconcile_from is None
+                        or self._worker_table_version
+                        < self._workers_reconcile_from):
+                    self._workers_reconcile_from = self._worker_table_version
+                self._spawn_workers_reconcile()
+            self._workers_seq = max(self._workers_seq or 0, seq)
+        ver = message.get("_wv")
+        if ver is not None and ver <= self._worker_table_version:
+            return  # stale replay; the _wv guard is the no-dup proof
+        if ver is not None:
+            self._worker_table_version = ver
+        self._apply_worker_wire(message)
+
+    def _apply_worker_wire(self, wire: dict):
+        ver = wire.get("_wv")
+        if ver is not None:
+            self._worker_table_version = max(self._worker_table_version, ver)
+        if not wire.get("dead"):
+            # a "live" delta supersedes an earlier death (address recycled
+            # + re-registered): clear it so a LEGITIMATE later re-death is
+            # a fresh application, not a dup
+            self.worker_deaths.pop(wire.get("address", ""), None)
+            return
+        addr = wire.get("address", "")
+        if not addr:
+            self.protocol_errors.append("worker wire: no address")
+            return
+        prev = self.worker_deaths.get(addr)
+        if prev is not None:
+            if prev.get("_wv") == wire.get("_wv"):
+                return  # idempotent replay (full reconcile), not a dup
+            # same address died "again" under a different version: the
+            # store published one death twice — the bug class the failover
+            # chaos test asserts never happens
+            self.worker_dup_applied += 1
+        self.worker_deaths[addr] = wire
+
+    def _spawn_workers_reconcile(self) -> None:
+        if (self._workers_reconcile_task is None
+                or self._workers_reconcile_task.done()):
+            self._workers_reconcile_task = spawn(self._reconcile_workers())
+
+    async def _reconcile_workers(self, initial: bool = False) -> None:
+        """Cursor reconcile of missed worker-death notices via
+        get_workers_delta — the core worker's machinery, instrumented."""
+        while True:
+            floor = self._workers_reconcile_from
+            self._workers_reconcile_from = None
+            pre = self._worker_table_version
+            cursor = -1 if initial else (
+                floor if floor is not None else pre)
+            try:
+                reply = await self._call("get_workers_delta",
+                                         {"cursor": cursor})
+            except Exception:  # noqa: BLE001 — store mid-failover: re-arm
+                # the floor (stream notices advance the cursor past the
+                # missed window) and retry
+                if self.state == "DEAD":
+                    return  # shutdown race, not a protocol failure
+                used = floor if floor is not None else pre
+                if (self._workers_reconcile_from is None
+                        or used < self._workers_reconcile_from):
+                    self._workers_reconcile_from = used
+                await asyncio.sleep(0.5)
+                continue
+            wires = reply.get("updates") or reply.get("workers") or []
+            for w in wires:
+                self._apply_worker_wire(w)
+            if reply.get("version") is not None:
+                # authoritative assignment AFTER the apply (restart reset)
+                self._worker_table_version = reply["version"]
+            if self._workers_reconcile_from is None:
+                return
+            initial = False
 
     # -- scripted daemon half (lease protocol) -------------------------
 
@@ -451,6 +628,7 @@ class SimNodePlane:
                  *, seed: Optional[int] = None,
                  resources: Optional[Dict[str, float]] = None,
                  serve: bool = True, heartbeat: bool = True,
+                 watch_workers: bool = False,
                  spawn_concurrency: int = 64):
         self.count = count if count is not None \
             else GLOBAL_CONFIG.get("simnode_count")
@@ -458,7 +636,8 @@ class SimNodePlane:
             else GLOBAL_CONFIG.get("simnode_seed")
         self.nodes: List[SimNode] = [
             SimNode(control_address, index=i, seed=self.seed,
-                    resources=resources, serve=serve, heartbeat=heartbeat)
+                    resources=resources, serve=serve, heartbeat=heartbeat,
+                    watch_workers=watch_workers)
             for i in range(self.count)
         ]
         self._spawn_concurrency = spawn_concurrency
@@ -533,8 +712,41 @@ class SimNodePlane:
             "gaps_reconciled": sum(n.gaps_reconciled for n in live),
             "leases_granted": sum(n.leases_granted for n in live),
             "leases_spilled": sum(n.leases_spilled for n in live),
+            "worker_notices": sum(n.worker_notices for n in live),
+            "worker_dup_applied": sum(n.worker_dup_applied for n in live),
+            "store_reconnects": sum(n.store_reconnects for n in live),
+            "store_failovers": sum(n.store_failovers for n in live),
             "protocol_errors": [e for n in live for e in n.protocol_errors],
         }
+
+    async def await_worker_deaths(self, expected: set,
+                                  timeout: float = 60.0) -> float:
+        """Wait until EVERY live watching simnode's death set equals
+        `expected` (addresses) exactly — the zero-loss resubscribe claim.
+        Returns seconds taken; raises TimeoutError with the miss histogram."""
+        deadline = time.monotonic() + timeout
+        t0 = time.monotonic()
+        while True:
+            watchers = [n for n in self.alive() if n._watch_workers]
+            missing = {
+                n.index: len(expected - set(n.worker_deaths))
+                for n in watchers
+                if expected - set(n.worker_deaths)
+            }
+            extra = {
+                n.index: len(set(n.worker_deaths) - expected)
+                for n in watchers
+                if set(n.worker_deaths) - expected
+            }
+            if not missing and not extra:
+                return time.monotonic() - t0
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"worker-death views never converged: "
+                    f"{len(missing)} node(s) missing deaths "
+                    f"(sample {dict(list(missing.items())[:3])}), "
+                    f"{len(extra)} with extras")
+            await asyncio.sleep(0.2)
 
 
 async def _run_plane(args) -> None:

@@ -120,6 +120,11 @@ def init(
         session_dir = node_mod.new_session_dir()
         cs_proc, control_address = node_mod.start_control_store(session_dir)
         _context.owned_processes.append(cs_proc)
+        if GLOBAL_CONFIG.get("store_standby_enabled"):
+            # warm standby: tails the shared WAL and takes over at the
+            # primary's address on its death (control-store HA)
+            _context.owned_processes.append(
+                node_mod.start_standby_store(session_dir, control_address))
         res = dict(resources or {})
         if num_cpus is not None:
             res["CPU"] = float(num_cpus)

@@ -51,8 +51,33 @@ class Cluster:
         self.cs_proc, self.address = node_mod.start_control_store(self.session_dir)
         self.nodes: List[NodeHandle] = []
         self.sim_planes: List[SimPlaneHandle] = []
+        self.standby_proc: Optional[subprocess.Popen] = None
+        if GLOBAL_CONFIG.get("store_standby_enabled"):
+            self.standby_proc = node_mod.start_standby_store(
+                self.session_dir, self.address)
         if initialize_head:
             self.add_node(resources=head_resources, labels=head_labels)
+
+    def start_standby(self) -> subprocess.Popen:
+        """Attach a warm-standby control store (idempotent: one per
+        cluster). Kill the primary (`kill_primary_store`) and the standby
+        takes over at the same address."""
+        if self.standby_proc is None or self.standby_proc.poll() is not None:
+            self.standby_proc = node_mod.start_standby_store(
+                self.session_dir, self.address)
+        return self.standby_proc
+
+    def kill_primary_store(self):
+        """SIGKILL the primary control store (failover drills). The
+        standby — if one is attached — recovers at the same address;
+        `node._wait_ready(standby_proc.standby_ready_file, standby_proc)`
+        blocks until it serves. The handles swap: the standby IS the
+        primary now, so a later start_standby() attaches a fresh one and a
+        second kill_primary_store() kills the right process."""
+        node_mod.kill_process(self.cs_proc, force=True)
+        if self.standby_proc is not None:
+            self.cs_proc = self.standby_proc
+            self.standby_proc = None
 
     @property
     def head_node(self) -> NodeHandle:
@@ -121,3 +146,6 @@ class Cluster:
             node_mod.kill_process(sp.proc, force=True)
         self.sim_planes.clear()
         node_mod.kill_process(self.cs_proc, force=True)
+        if self.standby_proc is not None:
+            node_mod.kill_process(self.standby_proc, force=True)
+            self.standby_proc = None

@@ -144,7 +144,17 @@ def moe_ffn_ep(params: Dict[str, jnp.ndarray], x: jnp.ndarray, *,
             aux = jax.lax.pmean(aux, token_axes)
         return y.astype(x_local.dtype), aux
 
-    from jax import shard_map  # jax >= 0.8 surface (no check_rep kwarg)
+    try:
+        from jax import shard_map  # jax >= 0.8 surface (no check_rep kwarg)
+
+        # y/aux are replicated over the ep axis by construction (the reverse
+        # all_to_all returns every token's outputs to its home shard), which
+        # the varying-axis checker cannot infer through the exchange
+        smap_kwargs = {"check_vma": False}
+    except ImportError:  # pre-0.8: the experimental surface, check_rep era
+        from jax.experimental.shard_map import shard_map
+
+        smap_kwargs = {"check_rep": False}
 
     param_specs = {
         "router": P(),            # replicated
@@ -155,8 +165,5 @@ def moe_ffn_ep(params: Dict[str, jnp.ndarray], x: jnp.ndarray, *,
         local, mesh=mesh,
         in_specs=(param_specs, tokens_spec),
         out_specs=(tokens_spec, P()),
-        # y/aux are replicated over the ep axis by construction (the reverse
-        # all_to_all returns every token's outputs to its home shard), which
-        # the varying-axis checker cannot infer through the exchange
-        check_vma=False,
+        **smap_kwargs,
     )(params, x)

@@ -19,6 +19,7 @@ from ray_tpu._private.aio import spawn
 import itertools
 import logging
 import struct
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 import msgpack
@@ -282,6 +283,9 @@ class RpcClient:
         self.push_frames = 0
         self.push_messages = 0
         self.bytes_received = 0
+        # when the transport last died (monotonic), for outage-duration
+        # telemetry in reconnect callbacks (rt_store_reconnect_seconds)
+        self.last_disconnect_ts: Optional[float] = None
 
     def on_reconnect(self, cb: Callable[[], Awaitable[None]]):
         """Register an async callback fired after every re-established
@@ -344,6 +348,7 @@ class RpcClient:
         finally:
             # Mark the transport dead so call() reconnects instead of writing
             # into a half-open socket after a server-side EOF.
+            self.last_disconnect_ts = time.monotonic()
             if self._writer is not None:
                 self._writer.close()
                 self._writer = None

@@ -21,7 +21,8 @@ def _run(args, timeout):
     assert proc.returncode == 0, proc.stderr[-2000:]
     rows = [json.loads(line) for line in proc.stdout.splitlines()
             if line.startswith("{")]
-    return {(r["bench"], r["mode"]): r for r in rows}
+    # failover rows repeat per backend; key them apart
+    return {(r["bench"], r.get("backend", r["mode"])): r for r in rows}
 
 
 def test_bench_scale_quick_smoke():
@@ -42,6 +43,21 @@ def test_bench_scale_quick_smoke():
     wal = by[("wal_growth", "on")]
     assert wal["protocol_errors"] == 0
     assert wal["persisted_bytes"] > 0
+
+
+@pytest.mark.slow
+def test_bench_scale_failover_column():
+    """The HA column standalone (both backends, 500 nodes): kill+takeover
+    under a live death-notice stream with the zero-loss gate."""
+    by = _run(["--failover-only", "--failover", "both", "--nodes", "500"],
+              timeout=1200)
+    for backend in ("file", "sqlite"):
+        row = by[("failover", backend)]
+        assert row["notices_lost"] == 0, row
+        assert row["notices_dup"] == 0, row
+        assert row["epoch"] >= 2
+        assert row["detection_s"] + row["takeover_s"] < 10.0, row
+        assert row["protocol_errors"] == 0, row
 
 
 @pytest.mark.slow

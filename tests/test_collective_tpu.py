@@ -212,11 +212,23 @@ def test_reducescatter_output_never_replicated_and_permute(ray_init):
     class Member:
         def __init__(self, rank, world):
             os.environ["JAX_PLATFORMS"] = "cpu"
+            # TWO local CPU devices per process: the mesh must use both.
+            # Old jax only honors the XLA_FLAGS spelling, so rewrite it
+            # BEFORE the first jax import in this fresh worker process
+            # (dropping any inherited device-count flag, e.g. conftest's 8).
+            flags = [
+                f for f in os.environ.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f
+            ]
+            os.environ["XLA_FLAGS"] = " ".join(
+                flags + ["--xla_force_host_platform_device_count=2"])
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-            # TWO local CPU devices per process: the mesh must use both
-            jax.config.update("jax_num_cpu_devices", 2)
+            try:
+                jax.config.update("jax_num_cpu_devices", 2)
+            except AttributeError:  # pre-config-option jax: XLA_FLAGS rules
+                pass
             self.rank, self.world = rank, world
 
         def run(self):

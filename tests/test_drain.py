@@ -382,7 +382,7 @@ def test_structured_death_reason_reaches_actor_error():
     rec = None
     while time.monotonic() < deadline:
         dead = cw.run_sync(cw.control.call(
-            "list_dead_workers", {}), 10)["workers"]
+            "get_workers_delta", {"cursor": -1}), 10)["workers"]
         rec = next((w for w in dead
                     if "process_kill" in (w.get("reason") or "")), None)
         if rec:

@@ -49,9 +49,14 @@ def test_scale_knobs_promoted_to_config():
         "pubsub_flush_window_ms", "pubsub_max_backlog",
         "node_delta_retention", "node_dead_retention",
         "node_table_delta_sync", "simnode_count", "simnode_seed",
+        # control-store HA (pluggable persistence + warm-standby failover)
+        "control_store_backend", "store_standby_enabled",
+        "store_failover_timeout_s", "store_fence_epoch_renew_s",
     ):
         assert name in flags, name
         assert flags[name].doc, f"{name} needs a help string"
+    assert flags["control_store_backend"].default == "file"
+    assert flags["store_standby_enabled"].default is False
 
 
 # ---------------------------------------------------------------------------
@@ -98,6 +103,100 @@ def test_get_nodes_delta_cursor_reads():
         assert r.get("full") and r["version"] == cursor + 6
 
     asyncio.run(run())
+
+
+def test_get_workers_delta_cursor_reads():
+    """The "workers" channel rides the same versioned-delta plane as the
+    node table (this replaced the list_dead_workers snapshot path): cursor
+    reads return exactly the deaths published after the cursor, `_wv`
+    stamped; stale cursors fall back to one full retained-record pull."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        for i in range(3):
+            await cs.rpc_report_worker_death(0, {
+                "address": f"w{i}:1", "reason": "crash", "exit_code": 1})
+        base = await cs.rpc_get_workers_delta(0, {"cursor": -1})
+        assert base["full"] and len(base["workers"]) == 3
+        assert [w["_wv"] for w in base["workers"]] == [1, 2, 3]
+        cursor = base["version"]
+        assert cursor == 3
+
+        # nothing changed: empty update set
+        r = await cs.rpc_get_workers_delta(0, {"cursor": cursor})
+        assert r.get("updates") == [] and not r.get("full")
+
+        # two more deaths: exactly those replay from the cursor
+        for i in (7, 8):
+            await cs.rpc_report_worker_death(0, {
+                "address": f"w{i}:1", "reason": "oom", "exit_code": 137})
+        r = await cs.rpc_get_workers_delta(0, {"cursor": cursor})
+        assert [u["address"] for u in r["updates"]] == ["w7:1", "w8:1"]
+        assert all(u["dead"] and u["_wv"] > cursor for u in r["updates"])
+        assert r["version"] == cursor + 2
+
+        # a cursor behind the bounded retention window -> full pull
+        GLOBAL_CONFIG.apply_system_config({"node_delta_retention": 2})
+        for i in range(4):
+            await cs.rpc_report_worker_death(0, {
+                "address": f"x{i}:1", "reason": "", "exit_code": 0})
+        r = await cs.rpc_get_workers_delta(0, {"cursor": cursor})
+        assert r.get("full") and len(r["workers"]) == 9
+
+        # a re-registered (recycled) address clears its death record from
+        # the full pull AND supersedes it in the delta log: a cursor
+        # replay spanning the death must NOT reap the live process — it
+        # sees a dead:False wire instead. The legacy list_dead_workers
+        # RPC is GONE.
+        pre_reregister = cs._worker_version
+        await cs.rpc_register_worker(0, {"address": "w7:1", "node_id": ""})
+        r = await cs.rpc_get_workers_delta(0, {"cursor": -1})
+        assert all(w["address"] != "w7:1" for w in r["workers"])
+        r = await cs.rpc_get_workers_delta(0, {"cursor": pre_reregister})
+        w7 = [u for u in r["updates"] if u["address"] == "w7:1"]
+        assert w7 == [{"address": "w7:1", "dead": False,
+                       "_wv": pre_reregister + 1}]
+        assert all(u.get("dead") is False or u["address"] != "w7:1"
+                   for u in r["updates"])
+        assert not hasattr(cs, "rpc_list_dead_workers")
+
+    asyncio.run(run())
+
+
+def test_worker_death_records_survive_persisted_restart(tmp_path):
+    """Worker deaths + the `_wv` version counter persist: a restarted (or
+    failed-over) store answers cursor reconciles with version continuity,
+    which is what keeps client cursors valid through a failover."""
+    from ray_tpu._private.control_store import ControlStore
+
+    GLOBAL_CONFIG.apply_system_config({"control_store_persist": True})
+
+    async def phase1():
+        cs = ControlStore(persist_dir=str(tmp_path))
+        await cs.start()
+        for i in range(4):
+            await cs.rpc_report_worker_death(0, {
+                "address": f"d{i}:1", "reason": "chaos", "exit_code": 137})
+        await cs.server.stop()
+
+    async def phase2():
+        cs = ControlStore(persist_dir=str(tmp_path))
+        await cs.start()
+        assert cs._worker_version == 4
+        # a client cursor from the previous incarnation replays exactly
+        # the missed tail
+        r = await cs.rpc_get_workers_delta(0, {"cursor": 2})
+        assert [u["address"] for u in r["updates"]] == ["d2:1", "d3:1"]
+        assert r["version"] == 4
+        # and new deaths continue the version line, no reuse
+        await cs.rpc_report_worker_death(0, {
+            "address": "d9:1", "reason": "x", "exit_code": 1})
+        assert cs._worker_version == 5
+        await cs.server.stop()
+
+    asyncio.run(phase1())
+    asyncio.run(phase2())
 
 
 def test_register_lean_reply_skips_seed_list():

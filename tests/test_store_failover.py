@@ -1,0 +1,324 @@
+"""Control-store HA: warm-standby failover with zero-loss resubscribe.
+
+The headline chaos claim (ROADMAP item 6 / reference: GCS HA —
+test_gcs_fault_tolerance.py at reference scale): kill -9 the primary
+control store while subscribers churn and worker deaths are being
+published; the warm standby (which has been tailing the shared WAL) takes
+over at the SAME address within `store_failover_timeout_s`, every
+subscriber cursor-reconciles through the `_wv`/`_v` versioned-delta plane,
+and NOT ONE death notice is lost or applied twice — counter-asserted per
+subscriber. The fenced old primary cannot apply a late mutation
+(persistence-level fencing is proven byte-for-byte in
+test_persistence_backends.py).
+
+Tier-1 runs the quick smoke (a handful of simnodes, one kill+takeover).
+The full 500-simnode churn matrix and the alternate (sqlite) backend
+suite are slow-marked.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from ray_tpu._private import node as node_mod
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.runtime.rpc import RpcClient
+
+
+def _cfg(backend="file", **extra):
+    GLOBAL_CONFIG.apply_system_config({
+        "control_store_persist": True,
+        "control_store_backend": backend,
+        "store_standby_enabled": True,
+        "store_failover_timeout_s": 10.0,
+        "store_fence_epoch_renew_s": 0.25,
+        "node_table_delta_sync": True,
+        **extra,
+    })
+
+
+async def _publish_deaths(addr, start, count, period_s=0.02,
+                          deadline_s=60.0):
+    """Steady stream of worker-death reports (the mutation churn whose
+    delivery the failover must not lose). Retries each report through the
+    outage — the store acks it exactly once (persisted before the reply),
+    so a report only counts as published once it was acked."""
+    published = set()
+    client = RpcClient(addr, name="death-pub", retries=2)
+    deadline = time.monotonic() + deadline_s
+    while True:  # the store may be mid-failover when we start
+        try:
+            await client.connect()
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(0.1)
+    for i in range(start, start + count):
+        address = f"10.9.9.{i}:{i}"
+        while True:
+            try:
+                await client.call("report_worker_death", {
+                    "address": address, "reason": "chaos kill",
+                    "exit_code": 137,
+                }, timeout=3)
+                published.add(address)
+                break
+            except Exception:  # noqa: BLE001 — store mid-failover: retry
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.1)
+        await asyncio.sleep(period_s)
+    await client.close()
+    return published
+
+
+async def _run_failover(nodes: int, deaths_each_side: int, churn: int,
+                        session: str, addr: str, cs_proc, standby,
+                        seed: int = 101):
+    """Drive one kill+takeover under churn; returns the measurements."""
+    from ray_tpu._private.simnode import SimNodePlane
+
+    plane = SimNodePlane(addr, nodes, seed=seed, watch_workers=True)
+    await plane.start()
+    await plane.await_converged(timeout=60)
+    published = set()
+    try:
+        # deaths + membership churn BEFORE the kill
+        published |= await _publish_deaths(addr, 0, deaths_each_side)
+        if churn:
+            await plane.drain_wave(churn, deadline_s=0.3)
+
+        # kill -9 the primary mid-stream; keep publishing through the
+        # outage (the publisher retries until the new incumbent acks)
+        kill_ts = time.time()
+        node_mod.kill_process(cs_proc, force=True)
+        pub_task = asyncio.ensure_future(_publish_deaths(
+            addr, deaths_each_side, deaths_each_side))
+
+        info = await asyncio.to_thread(
+            node_mod._wait_ready, standby.standby_ready_file, standby, 60.0)
+        served_ts = time.time()
+        published |= await pub_task
+
+        # post-takeover churn: the new incumbent must run the full
+        # protocol (drains, deltas) — not just reads
+        if churn:
+            await plane.drain_wave(churn, deadline_s=0.3)
+        await plane.await_converged(timeout=90)
+        converge_deaths_s = await plane.await_worker_deaths(
+            published, timeout=90)
+        stats = plane.stats()
+        return {
+            "info": info,
+            "detection_s": info["won_ts"] - kill_ts,
+            "takeover_s": info["serving_ts"] - info["won_ts"],
+            "total_s": served_ts - kill_ts,
+            "converge_deaths_s": converge_deaths_s,
+            "published": len(published),
+            "stats": stats,
+            "addr": addr,
+        }
+    finally:
+        await plane.stop()
+
+
+def _assert_zero_loss(out, timeout_budget=10.0):
+    info, stats = out["info"], out["stats"]
+    assert info["epoch"] >= 2, "takeover must bump the fencing epoch"
+    # detection + takeover inside the configured failover budget
+    assert out["total_s"] <= timeout_budget, (
+        f"failover took {out['total_s']:.1f}s "
+        f"(detect {out['detection_s']:.1f}s + "
+        f"takeover {out['takeover_s']:.1f}s)")
+    # THE claim: zero lost (await_worker_deaths proved set equality on
+    # every subscriber) and zero duplicated applications
+    assert stats["worker_dup_applied"] == 0, stats
+    assert stats["protocol_errors"] == [], stats["protocol_errors"][:5]
+    # at least the takeover was observed as a store failover somewhere
+    assert stats["store_failovers"] >= 1, stats
+
+
+def _failover_session(backend="file", **extra):
+    _cfg(backend=backend, **extra)
+    session = node_mod.new_session_dir()
+    cs_proc, addr = node_mod.start_control_store(session)
+    standby = node_mod.start_standby_store(session, addr)
+    return session, cs_proc, addr, standby
+
+
+@pytest.fixture(autouse=True)
+def _reset_cfg():
+    yield
+    GLOBAL_CONFIG.reset()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: one kill+takeover with a handful of simnodes
+# ---------------------------------------------------------------------------
+
+
+def test_failover_smoke_quick():
+    session, cs_proc, addr, standby = _failover_session()
+    try:
+        out = asyncio.run(_run_failover(
+            nodes=8, deaths_each_side=10, churn=1,
+            session=session, addr=addr, cs_proc=cs_proc, standby=standby))
+        _assert_zero_loss(out)
+
+        async def post_checks():
+            # telemetry satellite: the failover counters moved in THIS
+            # process (the simnodes live here) ...
+            from ray_tpu.util.metrics import snapshot_all
+
+            series = {s["name"] for s in snapshot_all()}
+            assert "rt_store_failovers_total" in series
+            assert "rt_store_reconnect_seconds" in series
+            # ... and the new incumbent's flight recorder holds the
+            # takeover event (standby_waiting -> takeover)
+            c = RpcClient(addr, name="check")
+            await c.connect()
+            ring = (await c.call("dump_flight_recorder", {}))["events"]
+            kinds = {(e.get("category"), e.get("event")) for e in ring}
+            assert ("store", "takeover") in kinds, sorted(kinds)[:20]
+            assert ("store", "standby_waiting") in kinds
+            # the workers-channel delta plane answers cursor reads on the
+            # NEW incumbent with the version continuity the zero-loss
+            # reconcile rode (persisted _wv counter)
+            delta = await c.call("get_workers_delta", {"cursor": -1})
+            assert delta.get("full")
+            assert len(delta["workers"]) == out["published"]
+            assert delta["version"] >= out["published"]
+            await c.close()
+
+        asyncio.run(post_checks())
+    finally:
+        for proc in (cs_proc, standby):
+            node_mod.kill_process(proc, force=True)
+
+
+def test_failover_smoke_sqlite_backend():
+    """The alternate backend speaks the same HA protocol end to end (its
+    500-node churn run is slow-marked below)."""
+    session, cs_proc, addr, standby = _failover_session(backend="sqlite")
+    try:
+        out = asyncio.run(_run_failover(
+            nodes=6, deaths_each_side=8, churn=0,
+            session=session, addr=addr, cs_proc=cs_proc, standby=standby))
+        _assert_zero_loss(out)
+        db = os.path.join(session, "control_store", "store.sqlite3")
+        assert os.path.exists(db), "sqlite backend never materialized"
+    finally:
+        for proc in (cs_proc, standby):
+            node_mod.kill_process(proc, force=True)
+
+
+@pytest.mark.slow
+def test_store_standby_enabled_flag_end_to_end():
+    """`store_standby_enabled` wires HA into ray_tpu.init(): the standby
+    is spawned (and owned) automatically, and a real task submits through
+    a primary kill (the cluster-level twin of
+    test_spill_persist.test_cluster_failover_to_standby, driven by the
+    flag instead of manual process plumbing)."""
+    import signal as _signal
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2,
+                 system_config={"store_standby_enabled": True})
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get(f.remote(21), timeout=60) == 42
+        from ray_tpu._private.worker import global_context
+
+        ctx = global_context()
+        cs_proc = ctx.owned_processes[0]  # control store spawned first
+        os.kill(cs_proc.pid, _signal.SIGKILL)
+        cs_proc.wait(timeout=10)
+        # fresh submissions ride the failover (standby at the same addr)
+        assert ray_tpu.get(f.remote(5), timeout=120) == 10
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# slow matrix: 500-simnode churn, both backends, multiple seeds
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend,seed", [
+    ("file", 101), ("file", 202), ("sqlite", 101),
+])
+def test_failover_under_500_simnode_churn(backend, seed):
+    """The acceptance bar: store kill under 500-simnode churn — standby
+    takes over within store_failover_timeout_s, all subscribers cursor-
+    reconcile with zero lost/duplicated notices, drain waves straddling
+    the failover still converge."""
+    session, cs_proc, addr, standby = _failover_session(
+        backend=backend,
+        # coalesced fanout + jitter: the 1000-node posture
+        pubsub_flush_window_ms=25.0, heartbeat_jitter=0.2)
+    try:
+        out = asyncio.run(_run_failover(
+            nodes=500, deaths_each_side=40, churn=25,
+            session=session, addr=addr, cs_proc=cs_proc, standby=standby,
+            seed=seed))
+        _assert_zero_loss(out, timeout_budget=GLOBAL_CONFIG.get(
+            "store_failover_timeout_s"))
+    finally:
+        for proc in (cs_proc, standby):
+            node_mod.kill_process(proc, force=True)
+
+
+# ---------------------------------------------------------------------------
+# wedged-primary takeover: the lease-staleness path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_wedged_primary_lease_stale_takeover():
+    """A SIGSTOP'd primary never frees its flock OR its port; the standby
+    must take over via lease staleness and finish the fenced zombie off
+    (same-host STONITH) so it can bind the takeover address."""
+    import signal as _signal
+
+    session, cs_proc, addr, standby = _failover_session(
+        store_failover_timeout_s=3.0)
+    try:
+        async def run():
+            from ray_tpu._private.simnode import SimNodePlane
+
+            plane = SimNodePlane(addr, 4, seed=7, watch_workers=True)
+            await plane.start()
+            await plane.await_converged(timeout=30)
+            published = await _publish_deaths(addr, 0, 4)
+            os.kill(cs_proc.pid, _signal.SIGSTOP)  # wedge, don't kill
+            info = await asyncio.to_thread(
+                node_mod._wait_ready, standby.standby_ready_file,
+                standby, 60.0)
+            assert info["mode"] == "lease_stale", info
+            assert info["epoch"] >= 2
+            # the fenced zombie was killed by the takeover (it could never
+            # have fence-exited on its own: its loop is wedged)
+            deadline = time.monotonic() + 15
+            while cs_proc.poll() is None:
+                assert time.monotonic() < deadline, (
+                    "fenced zombie primary still running")
+                await asyncio.sleep(0.25)
+            published |= await _publish_deaths(addr, 10, 4)
+            await plane.await_worker_deaths(published, timeout=60)
+            stats = plane.stats()
+            assert stats["worker_dup_applied"] == 0
+            await plane.stop()
+
+        asyncio.run(run())
+    finally:
+        for proc in (cs_proc, standby):
+            node_mod.kill_process(proc, force=True)
