@@ -2096,8 +2096,9 @@ class ControlStore:
             try:
                 daemon = await self._daemon(nid)
                 await daemon.call("return_bundles", {"pg_id": rec.pg_id.binary()}, timeout=5)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — best-effort: node may be dead
+                logger.debug("return_bundles to node %s skipped during PG "
+                             "removal: %r", nid.hex()[:12], e)
         self.pubsub.publish("placement_groups", rec.to_wire())
         return {"ok": True}
 
@@ -2341,6 +2342,7 @@ async def run_control_store(host: str, port: int, ready_file: Optional[str] = No
         logger.info("standby takeover complete: serving at %s (epoch %d)",
                     addr, epoch)
         if ready_file:
+            # rtlint: disable=R001 one-shot takeover marker; written once before the run-forever wait
             with open(ready_file, "w") as f:
                 json.dump({"address": addr, "epoch": epoch, "mode": mode,
                            "won_ts": won_ts, "serving_ts": serving_ts}, f)
@@ -2361,6 +2363,7 @@ async def run_control_store(host: str, port: int, ready_file: Optional[str] = No
     if lease is not None and lease.epoch:
         spawn(_lease_renew_loop(store, lease))
     if ready_file:
+        # rtlint: disable=R001 one-shot startup marker write before serving
         with open(ready_file, "w") as f:
             json.dump({"address": addr, "epoch": epoch}, f)
     _ = lock  # pinned for process lifetime

@@ -43,6 +43,15 @@ from ray_tpu.runtime.rpc import RpcClient, RpcServer
 
 logger = logging.getLogger(__name__)
 
+
+def _read_file_range(path: str, offset: int, limit: int) -> bytes:
+    """Bounded positional read, run in a worker thread by the async log/
+    profile paths so the daemon's event loop never blocks on disk."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        return f.read(limit)
+
+
 W_STARTING = "STARTING"
 W_IDLE = "IDLE"
 W_LEASED = "LEASED"
@@ -787,9 +796,11 @@ class NodeDaemon:
                     if size <= off:
                         continue
                     try:
-                        with open(path, "rb") as f:
-                            f.seek(off)
-                            chunk = f.read(min(size - off, 256 * 1024))
+                        # off-loop: one tail read per worker per tick adds up
+                        # on a busy node, and log files can sit on slow disks
+                        chunk = await asyncio.to_thread(
+                            _read_file_range, path, off,
+                            min(size - off, 256 * 1024))
                     except OSError:
                         continue
                     offsets[key] = off + len(chunk)
@@ -856,8 +867,9 @@ class NodeDaemon:
                 env, list(tpu_chips), self._tpu_chips_per_host
             )
         try:
+            # rtlint: disable=R001 paired with the Popen below: worker spawn is a ms-scale cold path, not per-task
             out = open(log_base + ".out", "ab")
-            err = open(log_base + ".err", "ab")
+            err = open(log_base + ".err", "ab")  # rtlint: disable=R001 see line above
             proc = subprocess.Popen(
                 [python_exe, "-m", "ray_tpu._private.default_worker"],
                 env=env, stdout=out, stderr=err, start_new_session=True,
@@ -1858,9 +1870,9 @@ class NodeDaemon:
             return {"ok": False, "error": "worker died"}
         await asyncio.sleep(0.4)  # dump is async-signal-driven
         try:
-            with open(log_path, "rb") as f:
-                f.seek(before)
-                dump = f.read(256 * 1024).decode("utf-8", "replace")
+            raw = await asyncio.to_thread(
+                _read_file_range, log_path, before, 256 * 1024)
+            dump = raw.decode("utf-8", "replace")
         except OSError as e:
             return {"ok": False, "error": f"log unreadable: {e}"}
         return {"ok": True, "worker_id": w.worker_id.hex(), "pid": w.pid,
@@ -2267,7 +2279,9 @@ class NodeDaemon:
                         "dump_flight_recorder", {}, timeout=5)
                 finally:
                     await client.close()
-            except Exception:  # noqa: BLE001 — wedged worker: skip it
+            except Exception as e:  # noqa: BLE001 — wedged worker: skip it
+                logger.debug("flight-recorder pull from worker %s skipped: %r",
+                             w.worker_id.hex()[:12], e)
                 continue
         return out
 
@@ -2568,6 +2582,7 @@ async def run_daemon(args):
     )
     addr = await daemon.start(args.port)
     if args.ready_file:
+        # rtlint: disable=R001 one-shot startup marker write before the daemon serves traffic
         with open(args.ready_file, "w") as f:
             json.dump(
                 {

@@ -759,6 +759,7 @@ async def _run_plane(args) -> None:
     elapsed = await plane.start()
     logger.info("simnode plane up: %d nodes in %.2fs", plane.count, elapsed)
     if args.ready_file:
+        # rtlint: disable=R001 one-shot startup marker write after the plane is up
         with open(args.ready_file, "w") as f:
             json.dump({"count": plane.count,
                        "register_storm_s": elapsed,

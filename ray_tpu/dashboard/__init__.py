@@ -16,6 +16,14 @@ from typing import Any, Dict
 
 import ray_tpu
 
+
+def _read_text(path: str) -> str:
+    """Whole-file read for asyncio.to_thread (the handler itself must not
+    touch disk on the event loop)."""
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
 DASHBOARD_NAME = "dashboard"
 DASH_NAMESPACE = "_dashboard"
 
@@ -142,13 +150,15 @@ class DashboardActor:
 
         import os
 
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "static", "index.html")
-        try:
-            with open(path, encoding="utf-8") as f:
-                page = f.read()
-        except OSError:  # packaged without assets: minimal inline fallback
-            page = _PAGE
+        page = getattr(self, "_index_page", None)
+        if page is None:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "static", "index.html")
+            try:
+                page = await asyncio.to_thread(_read_text, path)
+            except OSError:  # packaged without assets: minimal inline fallback
+                page = _PAGE
+            self._index_page = page  # static asset: read once, serve cached
         return web.Response(text=page, content_type="text/html")
 
     async def _resolve_node(self, node_hex: str) -> dict:

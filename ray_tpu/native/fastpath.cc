@@ -142,6 +142,9 @@ struct Ring {
     while (cap < slots) cap <<= 1;
     cells = static_cast<Cell*>(calloc(cap, sizeof(Cell)));
     mask = cap - 1;
+    // tsan: relaxed init stores — single-threaded constructor; the Ring is
+    // published to other threads only via nrings.store(release) in
+    // rt_fp_ring_create, which orders all of these before any reader.
     for (uint64_t i = 0; i < cap; i++)
       cells[i].seq.store(i, std::memory_order_relaxed);
     enqueue_pos.store(0, std::memory_order_relaxed);
@@ -149,47 +152,66 @@ struct Ring {
   }
   ~Ring() { free(cells); }
 
+  // Vyukov bounded MPMC: the cell's `seq` is the only synchronization edge
+  // for the payload. Positions are mere tickets — a stale read just retries.
   bool push(FpEntry* e) {
     Cell* cell;
+    // tsan: relaxed — enqueue_pos is a ticket counter, not a publication
+    // point; a stale value fails the seq check below and reloads.
     uint64_t pos = enqueue_pos.load(std::memory_order_relaxed);
     for (;;) {
       cell = &cells[pos & mask];
+      // acquire pairs with the consumer's seq.store(release) in pop():
+      // seeing seq==pos proves the previous occupant's payload read is done.
       uint64_t seq = cell->seq.load(std::memory_order_acquire);
       intptr_t dif = static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos);
       if (dif == 0) {
+        // tsan: relaxed CAS — winning only claims the ticket; the payload
+        // publication below rides cell->seq.store(release), not this CAS.
         if (enqueue_pos.compare_exchange_weak(pos, pos + 1,
                                               std::memory_order_relaxed))
           break;
       } else if (dif < 0) {
         return false;  // full
       } else {
+        // tsan: relaxed — refresh the ticket after losing a race; validated
+        // by the next acquire load of cell->seq.
         pos = enqueue_pos.load(std::memory_order_relaxed);
       }
     }
     cell->ent = e;
+    // release publishes cell->ent to the consumer's acquire load of seq.
     cell->seq.store(pos + 1, std::memory_order_release);
     return true;
   }
 
   FpEntry* pop() {
     Cell* cell;
+    // tsan: relaxed — dequeue_pos is a ticket counter (see push()).
     uint64_t pos = dequeue_pos.load(std::memory_order_relaxed);
     for (;;) {
       cell = &cells[pos & mask];
+      // acquire pairs with the producer's seq.store(pos+1, release): seeing
+      // seq==pos+1 makes the cell->ent write below visible to this thread.
       uint64_t seq = cell->seq.load(std::memory_order_acquire);
       intptr_t dif =
           static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos + 1);
       if (dif == 0) {
+        // tsan: relaxed CAS — claims the ticket only; the payload was
+        // already acquired via cell->seq above.
         if (dequeue_pos.compare_exchange_weak(pos, pos + 1,
                                               std::memory_order_relaxed))
           break;
       } else if (dif < 0) {
         return nullptr;  // empty
       } else {
+        // tsan: relaxed — ticket refresh after a lost race (see push()).
         pos = dequeue_pos.load(std::memory_order_relaxed);
       }
     }
     FpEntry* e = cell->ent;
+    // release hands the cell back to a producer one lap ahead: pairs with
+    // push()'s acquire load and orders our cell->ent read before reuse.
     cell->seq.store(pos + mask + 1, std::memory_order_release);
     return e;
   }
@@ -254,9 +276,13 @@ void rt_fp_engine_destroy(void* h) {
 int32_t rt_fp_ring_create(void* h) {
   Engine* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->reg_mu);
+  // tsan: relaxed — only registrars mutate nrings and they serialize on
+  // reg_mu; concurrent readers use the acquire load at the call sites.
   int32_t id = e->nrings.load(std::memory_order_relaxed);
   if (id >= kMaxRings) return -1;
   e->rings[id] = new Ring(e->ring_slots);
+  // release publishes rings[id] (and the Ring's relaxed init) to readers'
+  // acquire loads of nrings.
   e->nrings.store(id + 1, std::memory_order_release);
   return id;
 }
@@ -266,6 +292,7 @@ int32_t rt_fp_template_register(void* h, const uint8_t* pre, uint64_t pre_len,
                                 const uint8_t* suf, uint64_t suf_len) {
   Engine* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->reg_mu);
+  // tsan: relaxed — writers serialize on reg_mu (see rt_fp_ring_create).
   int32_t id = e->ntemplates.load(std::memory_order_relaxed);
   if (id >= kMaxTemplates) return -1;
   Template& t = e->templates[id];

@@ -107,6 +107,8 @@ int rt_chan_init(void* base, uint64_t region_size, uint64_t nslots,
   h->magic = kChanMagic;
   h->nslots = nslots;
   h->slot_size = slot_size;
+  // tsan: relaxed init stores — rt_chan_init runs before the region's fd/
+  // name is handed to the peer, so no second thread can observe them yet.
   h->write_seq.store(0, std::memory_order_relaxed);
   h->read_seq.store(0, std::memory_order_relaxed);
   h->closed.store(0, std::memory_order_relaxed);
@@ -129,7 +131,11 @@ int rt_chan_validate(void* base) {
 int64_t rt_chan_reserve(void* base) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
   if (h->closed.load(std::memory_order_acquire)) return -3;
+  // tsan: relaxed — SPSC: write_seq is only ever stored by this (the single
+  // writer) thread, so reading our own last store needs no ordering.
   uint64_t w = h->write_seq.load(std::memory_order_relaxed);
+  // acquire pairs with rt_chan_release's read_seq.store(release): seeing
+  // r proves the reader is done with slots below r, so reuse is safe.
   uint64_t r = h->read_seq.load(std::memory_order_acquire);
   if (w - r >= h->nslots) return -1;  // full
   auto* s = slot_at(h, w);
@@ -140,8 +146,10 @@ int64_t rt_chan_reserve(void* base) {
 int rt_chan_commit(void* base, uint64_t len) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
   if (len > h->slot_size) return -2;
+  // tsan: relaxed — writer-owned counter (see rt_chan_reserve).
   uint64_t w = h->write_seq.load(std::memory_order_relaxed);
   slot_at(h, w)->len = len;
+  // release publishes the payload + len to the reader's acquire load.
   h->write_seq.store(w + 1, std::memory_order_release);
   h->write_ding.fetch_add(1, std::memory_order_release);
   if (h->read_waiters.load(std::memory_order_acquire) != 0)
@@ -153,7 +161,11 @@ int rt_chan_commit(void* base, uint64_t len) {
 // next unread slot, or -1 if empty, -2 if empty AND closed (EOF).
 int64_t rt_chan_acquire(void* base, uint64_t* out_len) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
+  // tsan: relaxed — SPSC: read_seq is only ever stored by this (the single
+  // reader) thread, so reading our own last store needs no ordering.
   uint64_t r = h->read_seq.load(std::memory_order_relaxed);
+  // acquire pairs with rt_chan_commit's write_seq.store(release) and makes
+  // the slot payload + len visible before we touch them.
   uint64_t w = h->write_seq.load(std::memory_order_acquire);
   if (r == w) {
     return h->closed.load(std::memory_order_acquire) ? -2 : -1;
@@ -166,7 +178,10 @@ int64_t rt_chan_acquire(void* base, uint64_t* out_len) {
 
 int rt_chan_release(void* base) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
+  // tsan: relaxed — reader-owned counter (see rt_chan_acquire).
   uint64_t r = h->read_seq.load(std::memory_order_relaxed);
+  // release returns the slot to the writer: pairs with rt_chan_reserve's
+  // acquire load and orders our payload reads before slot reuse.
   h->read_seq.store(r + 1, std::memory_order_release);
   h->read_ding.fetch_add(1, std::memory_order_release);
   if (h->write_waiters.load(std::memory_order_acquire) != 0)
@@ -193,6 +208,7 @@ void rt_chan_close(void* base) {
 int rt_chan_wait_readable(void* base, int64_t timeout_us) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
   uint32_t ding = h->write_ding.load(std::memory_order_acquire);
+  // tsan: relaxed — reader-owned counter; only the reader parks here.
   uint64_t r = h->read_seq.load(std::memory_order_relaxed);
   if (h->write_seq.load(std::memory_order_acquire) != r ||
       h->closed.load(std::memory_order_acquire))
@@ -212,6 +228,7 @@ int rt_chan_wait_writable(void* base, int64_t timeout_us) {
   auto* h = reinterpret_cast<ChannelHeader*>(base);
   uint32_t ding = h->read_ding.load(std::memory_order_acquire);
   if (h->closed.load(std::memory_order_acquire)) return 0;  // fail fast
+  // tsan: relaxed — writer-owned counter; only the writer parks here.
   uint64_t w = h->write_seq.load(std::memory_order_relaxed);
   if (w - h->read_seq.load(std::memory_order_acquire) < h->nslots) return 0;
   h->write_waiters.fetch_add(1, std::memory_order_acq_rel);

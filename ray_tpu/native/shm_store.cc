@@ -65,6 +65,10 @@ struct StoreHeader {
   uint64_t bytes_in_use;
   uint64_t num_objects;
   pthread_mutex_t lock;
+  // tsan: seal_seq is the store's only atomic — every other header field is
+  // written exclusively under the robust `lock` (Guard). It stays seq_cst
+  // (defaulted orders) so a poller may read it WITHOUT the lock and still
+  // see a monotone value; today's callers happen to hold the Guard anyway.
   std::atomic<uint64_t> seal_seq;  // bumped on every seal, for pollers
 };
 
@@ -298,6 +302,8 @@ void* rt_store_create(const char* name, uint64_t size, uint64_t capacity) {
   pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
   pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
   pthread_mutex_init(&s->hdr->lock, &attr);
+  // tsan: seq_cst init store — runs before the segment name is returned to
+  // any peer, so no concurrent observer exists yet.
   s->hdr->seal_seq.store(0);
   return s;
 }
@@ -391,6 +397,9 @@ int rt_object_seal(void* handle, const uint8_t* id) {
   if (e->state != kEntryCreated) return RT_ERR_STATE;
   e->state = kEntrySealed;
   e->refcount -= 1;  // drop creator ref
+  // tsan: seq_cst bump under the Guard; ordered after the state flip above
+  // so a lock-free poller that sees the new seq can safely take the lock
+  // and find the object sealed.
   s->hdr->seal_seq.fetch_add(1);
   return RT_OK;
 }

@@ -1,0 +1,30 @@
+"""R001 fixture: no findings — sync contexts, async equivalents, nested sync
+defs, and a waived call."""
+import asyncio
+import subprocess
+import time
+
+
+def sync_is_fine():
+    time.sleep(0.5)
+    subprocess.run(["ls"])
+    with open("/dev/null") as f:
+        return f.read()
+
+
+async def async_equivalents():
+    await asyncio.sleep(0.5)
+    proc = await asyncio.create_subprocess_exec("ls")
+    await proc.wait()
+
+
+async def nested_sync_def_is_its_own_context():
+    def helper():
+        time.sleep(0.1)  # runs wherever helper is called (e.g. a thread)
+    await asyncio.to_thread(helper)
+
+
+async def waived_startup_read(path):
+    # one-shot marker read before the loop serves traffic
+    with open(path) as f:  # rtlint: disable=R001 one-shot startup read
+        return f.read()

@@ -1,0 +1,23 @@
+"""R001 fixture: blocking calls inside async defs (4 findings)."""
+import asyncio
+import subprocess
+import time
+from pathlib import Path
+
+
+async def stalls_on_sleep():
+    time.sleep(0.5)  # finding 1
+
+
+async def stalls_on_subprocess():
+    subprocess.run(["ls"])  # finding 2
+
+
+async def stalls_on_file_io(path):
+    with open(path) as f:  # finding 3
+        data = f.read()
+    return data + Path(path).read_text()  # finding 4
+
+
+async def fine():
+    await asyncio.sleep(0.5)

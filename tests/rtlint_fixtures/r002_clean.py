@@ -1,0 +1,39 @@
+"""R002 fixture: no findings — lock released before the await, asyncio.Lock,
+non-lock context managers, and a waived hold."""
+import asyncio
+import threading
+
+_LOCK = threading.Lock()
+_ALOCK = asyncio.Lock()
+
+
+async def lock_released_before_await():
+    with _LOCK:
+        snapshot = 1
+    await asyncio.sleep(0)
+    return snapshot
+
+
+async def asyncio_lock_is_fine():
+    async with _ALOCK:
+        await asyncio.sleep(0)
+
+
+async def non_lock_context_manager(path):
+    import contextlib
+
+    with contextlib.suppress(ValueError):
+        await asyncio.sleep(0)
+
+
+async def nested_def_await_not_under_lock():
+    with _LOCK:
+        async def later():
+            await asyncio.sleep(0)
+    return later
+
+
+async def waived_hold():
+    # the awaited coroutine never yields (pure bookkeeping)
+    with _LOCK:  # rtlint: disable=R002 awaitee is non-yielding by contract
+        await asyncio.sleep(0)

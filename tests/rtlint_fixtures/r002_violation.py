@@ -1,0 +1,21 @@
+"""R002 fixture: threading locks held across awaits (2 findings)."""
+import asyncio
+import threading
+
+_MODULE_LOCK = threading.Lock()
+
+
+class Holder:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.value = 0
+
+    async def attr_lock_across_await(self):
+        with self._lock:  # finding 1
+            self.value += 1
+            await asyncio.sleep(0)
+
+
+async def module_lock_across_await():
+    with _MODULE_LOCK:  # finding 2
+        await asyncio.sleep(0)

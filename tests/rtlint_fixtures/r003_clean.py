@@ -1,0 +1,32 @@
+"""R003 fixture: no findings — retained, awaited, returned, or spawned."""
+import asyncio
+
+_TASKS = set()
+
+
+async def work():
+    pass
+
+
+async def retained():
+    t = asyncio.create_task(work())
+    _TASKS.add(t)
+    t.add_done_callback(_TASKS.discard)
+
+
+async def awaited():
+    await asyncio.create_task(work())
+
+
+def returned():
+    return asyncio.ensure_future(work())
+
+
+async def via_spawn_helper():
+    from ray_tpu._private.aio import spawn
+
+    spawn(work())  # pins the task in a strong set until done
+
+
+async def waived():
+    asyncio.create_task(work())  # rtlint: disable=R003 test-only fixture

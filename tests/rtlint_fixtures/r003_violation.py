@@ -1,0 +1,20 @@
+"""R003 fixture: fire-and-forget tasks with no retained reference
+(3 findings)."""
+import asyncio
+
+
+async def work():
+    pass
+
+
+async def discards_create_task():
+    asyncio.create_task(work())  # finding 1
+
+
+async def discards_ensure_future():
+    asyncio.ensure_future(work())  # finding 2
+
+
+async def discards_loop_create_task():
+    loop = asyncio.get_running_loop()
+    loop.create_task(work())  # finding 3

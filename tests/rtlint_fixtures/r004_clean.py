@@ -1,0 +1,27 @@
+"""R004 fixture: no findings — declared knobs, plain dict .get, dynamic
+names, and a waived read."""
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+
+def _cfg(name):
+    return GLOBAL_CONFIG.get(name)
+
+
+def reads_declared_knobs():
+    a = GLOBAL_CONFIG.get("health_check_period_s")
+    b = _cfg("native_fastpath")
+    return a, b
+
+
+def dict_get_is_not_a_knob_read(cfg: dict, config: dict):
+    # receivers are plain dicts, not the registry module
+    return cfg.get("whatever"), config.get("anything", 3)
+
+
+def dynamic_names_are_skipped(name):
+    return GLOBAL_CONFIG.get(name)
+
+
+def waived_forward_reference():
+    # knob declared by a sibling branch that lands after this one
+    return GLOBAL_CONFIG.get("rtlint_fixture_future_knob")  # rtlint: disable=R004 declared in the stacked PR above

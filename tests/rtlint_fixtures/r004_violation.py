@@ -1,0 +1,14 @@
+"""R004 fixture: undeclared config-knob reads (3 findings)."""
+from ray_tpu._private import config
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+
+def _cfg(name):
+    return GLOBAL_CONFIG.get(name)
+
+
+def reads_undeclared_knobs():
+    a = GLOBAL_CONFIG.get("rtlint_fixture_undeclared_knob")  # finding 1
+    b = config.get("rtlint_fixture_also_undeclared")  # finding 2
+    c = _cfg("rtlint_fixture_still_undeclared")  # finding 3
+    return a, b, c

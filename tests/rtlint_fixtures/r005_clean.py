@@ -1,0 +1,21 @@
+"""R005 fixture: no findings — registry-backed metrics with literal names,
+collections.Counter, and a waived construction."""
+from collections import Counter
+
+from ray_tpu.util import metrics
+from ray_tpu.util.metrics import Gauge
+
+
+def registry_backed():
+    c = metrics.Counter("rt_fixture_total", "fine", tag_keys=("k",))
+    g = Gauge("rt_fixture_gauge", "also fine")
+    return c, g
+
+
+def collections_counter_is_not_a_metric(sizes):
+    return Counter(sizes)
+
+
+def waived(suffix):
+    return metrics.Counter(
+        "rt_%s_total" % suffix)  # rtlint: disable=R005 bounded test-only names

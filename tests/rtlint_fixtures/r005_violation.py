@@ -1,0 +1,22 @@
+"""R005 fixture: metrics outside the registry / with dynamic names
+(3 findings)."""
+from prometheus_client import Counter  # hand-rolled exporter bypass
+
+from ray_tpu.util import metrics
+
+
+class Histogram:  # local shadow of the registry class
+    def __init__(self, name, boundaries=()):
+        self.name = name
+
+
+def hand_rolled_metrics():
+    c = Counter("rt_requests_total", "bypasses the node-daemon "
+                "aggregation entirely")  # finding 1
+    h = Histogram("rt_latency_seconds", boundaries=(0.1, 1.0))  # finding 2
+    return c, h
+
+
+def dynamic_metric_name(suffix):
+    return metrics.Counter(f"rt_dynamic_{suffix}_total",
+                           "cardinality bomb")  # finding 3

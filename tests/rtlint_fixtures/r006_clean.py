@@ -1,0 +1,36 @@
+"""R006 fixture: no findings — handled/reported errors, non-handler
+functions, and a waived swallow."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class Service:
+    async def rpc_logged(self, conn_id, payload):
+        try:
+            return {"value": payload["key"]}
+        except Exception as e:
+            logger.warning("lookup failed: %r", e)
+            return {"error": str(e)}
+
+    async def rpc_narrow_type(self, conn_id, payload):
+        try:
+            return {"value": payload["key"]}
+        except KeyError:
+            return {}
+
+    async def rpc_waived(self, conn_id, payload):
+        try:
+            self.best_effort(payload)
+        except Exception:  # rtlint: disable=R006 best-effort notify; peer may be mid-death
+            pass
+        return {"ok": True}
+
+    def not_a_handler(self, payload):
+        try:
+            return payload["key"]
+        except:  # noqa: E722 — R006 scopes to rpc_* handlers only
+            return None
+
+    def best_effort(self, payload):
+        raise NotImplementedError

@@ -1,0 +1,19 @@
+"""R006 fixture: swallowed exceptions in rpc_* handlers (2 findings)."""
+
+
+class Service:
+    async def rpc_bare_except(self, conn_id, payload):
+        try:
+            return {"value": payload["key"]}
+        except:  # noqa: E722 — finding 1
+            return {}
+
+    async def rpc_silent_swallow(self, conn_id, payload):
+        try:
+            self.apply(payload)
+        except Exception:  # finding 2
+            pass
+        return {"ok": True}
+
+    def apply(self, payload):
+        raise NotImplementedError

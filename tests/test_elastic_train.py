@@ -220,7 +220,7 @@ def test_checkpoint_finalize_idempotent_for_duplicate_step(tmp_path):
     assert not os.path.isdir(mgr.staging_dir(9, generation=2))
 
 
-def test_controller_knobs_promoted_to_config(tmp_path):
+def test_controller_reads_config_knobs(tmp_path):
     from ray_tpu.train._checkpoint import CheckpointManager
     from ray_tpu.train._controller import TrainController
     from ray_tpu.train._policies import FailurePolicy, FixedScalingPolicy
@@ -239,10 +239,6 @@ def test_controller_knobs_promoted_to_config(tmp_path):
     )
     assert c.max_drain_rejoins == 3
     assert float(GLOBAL_CONFIG.get("train_expected_death_fresh_s")) == 45.0
-    # the elastic knobs exist with sane defaults
-    for name in ("train_live_resize", "train_resize_park_timeout_s",
-                 "train_node_watch_period_s", "train_regrow_cooldown_s"):
-        assert name in GLOBAL_CONFIG.all_flags()
 
 
 def test_preemption_watcher_rearm_fires_again():

@@ -146,18 +146,8 @@ def test_task_event_buffer_counts_drops():
 
 
 # ---------------------------------------------------------------------------
-# satellite: config knob promotion
+# tracing flag plumbing
 # ---------------------------------------------------------------------------
-
-
-def test_observability_knobs_promoted():
-    flags = GLOBAL_CONFIG.all_flags()
-    for name in ("tracing_enabled", "flight_recorder_ring_size",
-                 "metrics_node_series_max"):
-        assert name in flags, name
-        assert flags[name].doc, f"{name} needs a help string"
-    assert flags["tracing_enabled"].type is bool
-    assert flags["flight_recorder_ring_size"].type is int
 
 
 def test_tracing_flag_and_env_override():

@@ -1,0 +1,197 @@
+"""rtlint — the repo-invariant static analyzer (tools/rtlint).
+
+Three layers:
+  1. per-rule fixtures (tests/rtlint_fixtures/): each of R001–R006 proven to
+     fire on its violation file and stay silent on its clean/waiver file;
+  2. the full-tree gate: `ray_tpu/` + `tools/` lint clean — this IS the
+     tier-1 CI gate, so a new violation fails the suite with the finding
+     text in the assertion;
+  3. CLI/format stability for CI consumption: exit codes (0 clean,
+     1 findings, 2 usage error), `path:line:col: RXXX message` lines, and
+     `--list-rules`.
+
+Also home of the R004 knob-promotion regression (replacing the hand-written
+per-plane `*_knobs_promoted` tests: the lint rule now mechanizes "every knob
+read is declared", and declared-knob hygiene is asserted here once).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from tools.rtlint import (
+    RULES,
+    find_config_py,
+    format_finding,
+    lint_file,
+    lint_paths,
+    load_declared_knobs,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(_REPO, "tests", "rtlint_fixtures")
+_CONFIG = os.path.join(_REPO, "ray_tpu", "_private", "config.py")
+
+
+def _lint(name, rules=None):
+    return lint_file(os.path.join(_FIXTURES, name),
+                     declared_knobs=load_declared_knobs(_CONFIG),
+                     rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: positive, negative, waiver
+# ---------------------------------------------------------------------------
+
+_EXPECTED = {
+    "R001": 4,  # time.sleep, subprocess.run, open(), Path.read_text
+    "R002": 2,  # attr lock + module lock held across await
+    "R003": 3,  # create_task, ensure_future, loop.create_task
+    "R004": 3,  # GLOBAL_CONFIG.get, config.get, local _cfg helper
+    "R005": 3,  # prometheus_client, local shadow class, dynamic name
+    "R006": 2,  # bare except, except Exception: pass
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_EXPECTED))
+def test_rule_fires_on_violation_fixture(rule):
+    findings = _lint(f"{rule.lower()}_violation.py")
+    fired = [f for f in findings if f.rule == rule]
+    assert len(fired) == _EXPECTED[rule], (
+        f"{rule}: expected {_EXPECTED[rule]} findings, got "
+        f"{[format_finding(f) for f in findings]}")
+    # and nothing else fires on the fixture (rules don't bleed into each
+    # other's fixtures)
+    assert len(findings) == len(fired), [format_finding(f) for f in findings]
+
+
+@pytest.mark.parametrize("rule", sorted(_EXPECTED))
+def test_rule_silent_on_clean_fixture(rule):
+    findings = _lint(f"{rule.lower()}_clean.py")
+    assert findings == [], [format_finding(f) for f in findings]
+
+
+def test_waiver_without_reason_does_not_waive(tmp_path):
+    bad = tmp_path / "bad_waiver.py"
+    bad.write_text(
+        "import asyncio, time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # rtlint: disable=R001\n")
+    findings = lint_file(str(bad))
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["R001", "W000"], [format_finding(f) for f in findings]
+
+
+def test_waiver_line_above_covers_statement(tmp_path):
+    src = tmp_path / "above.py"
+    src.write_text(
+        "import asyncio, time\n"
+        "async def f():\n"
+        "    # rtlint: disable=R001 warm-up jitter before the loop serves\n"
+        "    time.sleep(1)\n")
+    assert lint_file(str(src)) == []
+
+
+def test_select_runs_only_requested_rules():
+    findings = _lint("r001_violation.py", rules=["R006"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the gate: the whole tree lints clean
+# ---------------------------------------------------------------------------
+
+def test_full_tree_is_clean():
+    findings = lint_paths([os.path.join(_REPO, "ray_tpu"),
+                           os.path.join(_REPO, "tools")])
+    assert findings == [], "\n".join(format_finding(f) for f in findings)
+
+
+def test_config_py_is_discovered_from_tree_roots():
+    cfg = find_config_py([os.path.join(_REPO, "ray_tpu")])
+    assert cfg and cfg.endswith(os.path.join("_private", "config.py"))
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes + finding format are stable for CI
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.rtlint", *args],
+        cwd=_REPO, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_exit_1_and_stable_format_on_findings():
+    proc = _run_cli("tests/rtlint_fixtures/r001_violation.py")
+    assert proc.returncode == 1
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == _EXPECTED["R001"]
+    pat = re.compile(r"^tests/rtlint_fixtures/r001_violation\.py"
+                     r":\d+:\d+: R\d{3} .+")
+    for line in lines:
+        assert pat.match(line), line
+    assert "finding(s)" in proc.stderr
+
+
+def test_cli_exit_0_on_clean():
+    proc = _run_cli("tests/rtlint_fixtures/r006_clean.py")
+    assert proc.returncode == 0
+    assert proc.stdout.strip() == ""
+
+
+def test_cli_exit_2_on_unknown_rule():
+    proc = _run_cli("--select", "R999", "ray_tpu")
+    assert proc.returncode == 2
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in _EXPECTED:
+        assert rule in proc.stdout
+    assert len(RULES) == 6
+
+
+# ---------------------------------------------------------------------------
+# R004 as the knob-promotion mechanism (replaces the per-plane hand tests)
+# ---------------------------------------------------------------------------
+
+def test_r004_catches_an_undeclared_knob(tmp_path):
+    """The regression the hand-written knob tests used to provide: reading a
+    knob nobody declared is caught — now by the analyzer, for every file,
+    instead of by a hand-maintained list per subsystem."""
+    mod = tmp_path / "uses_knob.py"
+    mod.write_text(
+        "from ray_tpu._private.config import GLOBAL_CONFIG\n"
+        "def f():\n"
+        "    return GLOBAL_CONFIG.get('knob_nobody_declared')\n")
+    findings = lint_file(str(mod),
+                         declared_knobs=load_declared_knobs(_CONFIG))
+    assert [f.rule for f in findings] == ["R004"]
+    assert "knob_nobody_declared" in findings[0].message
+
+
+def test_every_declared_knob_has_a_help_string():
+    """Declared-knob hygiene previously asserted plane-by-plane: every flag
+    carries a doc (they render in --help surfaces and the README catalog)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    flags = GLOBAL_CONFIG.all_flags()
+    assert len(flags) > 80
+    missing = [n for n, f in flags.items() if not f.doc]
+    assert missing == [], f"flags without help strings: {missing}"
+
+
+def test_declared_knob_extraction_matches_runtime_registry():
+    """The analyzer's static view of config.py agrees with what the registry
+    actually declares at import time — if these drift, R004 would lie."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    static = load_declared_knobs(_CONFIG)
+    runtime = set(GLOBAL_CONFIG.all_flags())
+    assert static == runtime, (
+        f"static-only: {static - runtime}, runtime-only: {runtime - static}")

@@ -12,8 +12,10 @@ Covers (ROADMAP item 5):
   * WAL/snapshot compaction under 500-simnode churn + exact live-set
     recovery on restart (the satellite's persistence bound);
   * the SimNode plane itself: register storm, membership convergence,
-    scripted drain, lease grant/spillback, cluster_utils integration;
-  * scale-knob promotion to _private/config.py.
+    scripted drain, lease grant/spillback, cluster_utils integration.
+
+(Knob promotion is no longer hand-asserted here — rtlint R004 verifies
+every knob read against _private/config.py tree-wide; see test_rtlint.py.)
 """
 
 import asyncio
@@ -35,28 +37,6 @@ def _node_wire(node_id=None, address="127.0.0.1:1"):
         object_store_name="none",
         resources=ResourceSet({"CPU": 2}),
     ).to_wire()
-
-
-# ---------------------------------------------------------------------------
-# knob promotion (satellite)
-# ---------------------------------------------------------------------------
-
-
-def test_scale_knobs_promoted_to_config():
-    flags = GLOBAL_CONFIG.all_flags()
-    for name in (
-        "heartbeat_period_s", "heartbeat_jitter",
-        "pubsub_flush_window_ms", "pubsub_max_backlog",
-        "node_delta_retention", "node_dead_retention",
-        "node_table_delta_sync", "simnode_count", "simnode_seed",
-        # control-store HA (pluggable persistence + warm-standby failover)
-        "control_store_backend", "store_standby_enabled",
-        "store_failover_timeout_s", "store_fence_epoch_renew_s",
-    ):
-        assert name in flags, name
-        assert flags[name].doc, f"{name} needs a help string"
-    assert flags["control_store_backend"].default == "file"
-    assert flags["store_standby_enabled"].default is False
 
 
 # ---------------------------------------------------------------------------

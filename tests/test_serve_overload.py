@@ -410,27 +410,6 @@ def test_http_maps_backpressure_and_deadline(ray_init):
     assert hz["shed"] >= 1 and hz["deadline_exceeded"] >= 1
 
 
-def test_serve_overload_knobs_promoted_to_config():
-    """Every overload-plane knob is a first-class config flag with a help
-    string (tunable via env RAY_TPU_* / ray_tpu.init(system_config=))."""
-    flags = GLOBAL_CONFIG.all_flags()
-    for name in (
-        "serve_max_queued_requests",
-        "serve_default_timeout_s",
-        "serve_retry_after_s",
-        "serve_retry_budget_ratio",
-        "serve_retry_budget_min",
-        "serve_outlier_consecutive_failures",
-        "serve_outlier_probation_s",
-        "serve_shed_at_ingress",
-        "serve_refresh_timeout_s",
-        "serve_health_probe_timeout_s",
-        "serve_replica_init_timeout_s",
-    ):
-        assert name in flags, name
-        assert flags[name].doc, f"{name} missing help string"
-
-
 def test_default_timeout_config_applies(ray_init):
     """serve_default_timeout_s supplies a deadline when the caller sets
     none — and an explicit timeout_s always wins."""

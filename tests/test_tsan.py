@@ -1,0 +1,361 @@
+"""ThreadSanitizer story for the lock-free native plane.
+
+Two layers:
+
+  * a fast audit (tier-1): every `memory_order_relaxed` in fastpath.cc /
+    shm_channel.cc / shm_store.cc must sit under a `// tsan:` comment
+    justifying why relaxed is safe — the ordering argument lives next to
+    the code, and this test keeps it from rotting;
+  * slow race amplifiers: the three lock-free structures are hammered by
+    threads in a child interpreter built with RAY_TPU_NATIVE_SANITIZE=thread
+    and LD_PRELOADed libtsan. ctypes calls release the GIL, so the threads
+    interleave for real inside the instrumented C++. Any data race aborts
+    the child (halt_on_error) and fails the assertion here.
+
+    Scenarios (run via `python tests/test_tsan.py <name>` in the child):
+      ring        4 producers vs 4 consumers on an 8-slot Vyukov MPMC ring —
+                  every ~8 ops crosses the wrap-around where the seq/pos
+                  lap arithmetic is easiest to get wrong;
+      chan_close  SPSC writer + reader at full throttle on a 2-slot channel
+                  while a third thread slams rt_chan_close mid-flight
+                  (close must reach parked futex waiters with no race on
+                  the doorbells);
+      store       creators / pinning readers / deleters / stats pollers on
+                  a deliberately tiny store with destructive eviction on,
+                  so pin/release races the LRU reaping path.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ray_tpu.native import build
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE = os.path.join(_REPO, "ray_tpu", "native")
+_CC_FILES = ("fastpath.cc", "shm_channel.cc", "shm_store.cc")
+
+
+# ---------------------------------------------------------------------------
+# fast: the `// tsan:` audit must cover every relaxed site
+# ---------------------------------------------------------------------------
+
+def test_every_relaxed_site_has_a_tsan_audit_comment():
+    """Each memory_order_relaxed is a claim that no synchronization edge is
+    needed there. The claim must be written down within the 8 lines above
+    the load/store, as a `// tsan:` comment, or this fails."""
+    undocumented = []
+    for name in _CC_FILES:
+        path = os.path.join(_NATIVE, name)
+        lines = open(path).read().splitlines()
+        for i, line in enumerate(lines):
+            if "memory_order_relaxed" not in line:
+                continue
+            window = lines[max(0, i - 8):i + 1]
+            if not any("tsan:" in w for w in window):
+                undocumented.append(f"{name}:{i + 1}: {line.strip()}")
+    assert undocumented == [], (
+        "relaxed atomics without a // tsan: justification:\n"
+        + "\n".join(undocumented))
+
+
+def test_every_native_file_carries_a_tsan_audit():
+    """shm_store.cc has no relaxed sites but its single atomic still gets an
+    ordering note; all three files must participate in the audit."""
+    for name in _CC_FILES:
+        src = open(os.path.join(_NATIVE, name)).read()
+        assert "tsan:" in src, f"{name} has no // tsan: audit comments"
+
+
+# ---------------------------------------------------------------------------
+# slow: race amplifiers in a TSan-instrumented child
+# ---------------------------------------------------------------------------
+
+def _tsan_env() -> dict:
+    env = dict(os.environ)
+    env["RAY_TPU_NATIVE_SANITIZE"] = "thread"
+    env["LD_PRELOAD"] = build.sanitizer_preload("thread")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO  # the child runs this file as a script
+    # halt on the first report: the amplifier loops would otherwise bury it;
+    # CPython itself is uninstrumented, so reports can only come from our
+    # .so code (plus intercepted memcpy/malloc on its behalf).
+    env["TSAN_OPTIONS"] = (
+        "halt_on_error=1:abort_on_error=1:report_signal_unsafe=0:"
+        "history_size=7")
+    return env
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ compiler")
+@pytest.mark.skipif(not build.sanitizer_preload("thread"),
+                    reason="libtsan runtime not installed")
+@pytest.mark.parametrize("scenario", ["ring", "chan_close", "store"])
+def test_race_amplifier_clean_under_tsan(scenario):
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tests", "test_tsan.py"), scenario],
+        env=_tsan_env(), cwd=_REPO, capture_output=True, text=True,
+        timeout=600,
+    )
+    tail = (proc.stdout + "\n" + proc.stderr)[-6000:]
+    assert proc.returncode == 0, f"{scenario} amplifier failed:\n{tail}"
+    assert "SCENARIO-OK" in proc.stdout, tail
+    assert "ThreadSanitizer" not in proc.stdout, tail
+    assert "ThreadSanitizer" not in proc.stderr, tail
+
+
+# ---------------------------------------------------------------------------
+# child-side scenarios (module is re-run as a script inside the TSan env)
+# ---------------------------------------------------------------------------
+
+def _bind_fastpath():
+    import ctypes
+
+    from ray_tpu.native.build import lib_path
+
+    lib = ctypes.CDLL(lib_path("fastpath"))
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.rt_fp_engine_create.restype = ctypes.c_void_p
+    lib.rt_fp_engine_create.argtypes = [ctypes.c_uint64]
+    lib.rt_fp_engine_destroy.argtypes = [ctypes.c_void_p]
+    lib.rt_fp_ring_create.restype = ctypes.c_int32
+    lib.rt_fp_ring_create.argtypes = [ctypes.c_void_p]
+    lib.rt_fp_encode_raw.restype = ctypes.c_int32
+    lib.rt_fp_encode_raw.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint64]
+    lib.rt_fp_ring_len.restype = ctypes.c_uint64
+    lib.rt_fp_ring_len.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.rt_fp_pop.restype = ctypes.c_int32
+    lib.rt_fp_pop.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint64), u8p,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_fp_entry_free.argtypes = [ctypes.c_uint64]
+    return lib
+
+
+def _scenario_ring():
+    """4 producers vs 4 consumers on an 8-slot MPMC ring: constant
+    wrap-around, constant CAS contention on both positions. Raw ctypes so
+    every consumer gets private pop buffers (the FastPathEngine wrapper
+    shares its scratch arrays and is popped from one thread in production).
+    """
+    import ctypes
+
+    lib = _bind_fastpath()
+    eng = lib.rt_fp_engine_create(8)
+    ring = lib.rt_fp_ring_create(eng)
+    assert ring == 0
+    nprod, ncons, per = 4, 4, 3000
+    total = nprod * per
+    consumed = [0] * ncons
+    done = threading.Event()
+    tid_slot = 33  # 1 length byte + 32-byte max task id
+
+    def produce(i):
+        tid = bytes([i + 1]) * 8
+        spec = b"\x92\xc4\x08" + tid + b"\xc4\x20" + b"a" * 32
+        for _ in range(per):
+            while lib.rt_fp_encode_raw(eng, ring, tid, 8, spec,
+                                       len(spec)) == -1:
+                pass  # full: spin across the wrap boundary
+
+    def consume(k):
+        handles = (ctypes.c_uint64 * 16)()
+        tids = (ctypes.c_uint8 * (tid_slot * 16))()
+        waits = (ctypes.c_uint64 * 16)()
+        u8p = ctypes.cast(tids, ctypes.POINTER(ctypes.c_uint8))
+        while not done.is_set():
+            n = lib.rt_fp_pop(eng, ring, 16, handles, u8p, waits)
+            for j in range(n):
+                lib.rt_fp_entry_free(handles[j])
+            consumed[k] += n
+
+    producers = [threading.Thread(target=produce, args=(i,))
+                 for i in range(nprod)]
+    consumers = [threading.Thread(target=consume, args=(k,))
+                 for k in range(ncons)]
+    for t in producers + consumers:
+        t.start()
+    for t in producers:
+        t.join()
+    deadline = time.monotonic() + 60
+    while sum(consumed) < total:
+        assert time.monotonic() < deadline, (sum(consumed), total)
+        time.sleep(0.01)
+    done.set()
+    for t in consumers:
+        t.join()
+    assert sum(consumed) == total, (sum(consumed), total)
+    assert lib.rt_fp_ring_len(eng, ring) == 0
+    lib.rt_fp_engine_destroy(eng)
+
+
+def _scenario_chan_close():
+    """SPSC channel at full throttle with a 2-slot ring (max backpressure,
+    both sides constantly parking on the futex doorbells) while a third
+    thread closes mid-flight. Repeated so close lands in different phases:
+    reader parked, writer parked, both mid-copy."""
+    import ctypes
+
+    from ray_tpu.native.build import lib_path
+
+    lib = ctypes.CDLL(lib_path("shm_channel"))
+    lib.rt_chan_required_size.restype = ctypes.c_uint64
+    lib.rt_chan_required_size.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.rt_chan_init.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+    lib.rt_chan_reserve.restype = ctypes.c_int64
+    lib.rt_chan_reserve.argtypes = [ctypes.c_void_p]
+    lib.rt_chan_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rt_chan_acquire.restype = ctypes.c_int64
+    lib.rt_chan_acquire.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_chan_release.argtypes = [ctypes.c_void_p]
+    lib.rt_chan_close.argtypes = [ctypes.c_void_p]
+    lib.rt_chan_wait_readable.restype = ctypes.c_int
+    lib.rt_chan_wait_readable.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.rt_chan_wait_writable.restype = ctypes.c_int
+    lib.rt_chan_wait_writable.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+
+    nslots, slot_size, payload = 2, 256, b"y" * 192
+    size = lib.rt_chan_required_size(nslots, slot_size)
+    for rnd in range(10):
+        buf = ctypes.create_string_buffer(size)
+        base = ctypes.addressof(buf)
+        assert lib.rt_chan_init(base, size, nslots, slot_size) == 0
+        sent = [0]
+
+        def write_loop():
+            while True:
+                off = lib.rt_chan_reserve(base)
+                if off == -3:
+                    return  # closed
+                if off == -1:
+                    lib.rt_chan_wait_writable(base, 2000)
+                    continue
+                ctypes.memmove(base + off, payload, len(payload))
+                lib.rt_chan_commit(base, len(payload))
+                sent[0] += 1
+
+        def read_loop():
+            out_len = ctypes.c_uint64()
+            while True:
+                off = lib.rt_chan_acquire(base, ctypes.byref(out_len))
+                if off == -2:
+                    return  # closed and drained
+                if off == -1:
+                    lib.rt_chan_wait_readable(base, 2000)
+                    continue
+                blob = ctypes.string_at(base + off, out_len.value)
+                assert blob == payload
+                lib.rt_chan_release(base)
+
+        w = threading.Thread(target=write_loop)
+        r = threading.Thread(target=read_loop)
+        w.start(), r.start()
+        time.sleep(0.005 * (rnd % 4))  # vary which phase close lands in
+        lib.rt_chan_close(base)
+        w.join(30), r.join(30)
+        assert not w.is_alive() and not r.is_alive()
+        del buf  # keep the region alive until both sides exited
+
+
+def _scenario_store():
+    """Pin/release vs. the destructive-eviction reaper on a tiny store:
+    creators churn short-lived objects through a store sized so allocation
+    routinely triggers the LRU walk, while readers pin/unpin a shared
+    working set, a deleter removes and re-puts, and pollers read stats
+    (the lock-free seal_seq) the whole time."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.runtime.object_store import (
+        ObjectStoreFullError, ShmObjectStore)
+
+    name = f"rt-tsan-{os.getpid()}"
+    store = ShmObjectStore(name, create=True, size=256 * 1024, capacity=128,
+                           allow_evict=True)
+    try:
+        shared = [ObjectID(bytes([i + 1]) * 24) for i in range(8)]
+        for oid in shared:
+            store.put_bytes(oid, b"s" * 1024)
+        stop = threading.Event()
+        errors = []
+
+        def run(fn):
+            try:
+                while not stop.is_set():
+                    fn()
+            except Exception as e:  # noqa: BLE001 — surfaced to the parent
+                errors.append(repr(e))
+
+        counters = {"created": 0, "pinned": 0}
+
+        def creator_fn(worker=[0]):
+            worker[0] += 1
+            oid = ObjectID(os.urandom(24))
+            try:
+                view = store.create(oid, 8 * 1024)
+            except (ObjectStoreFullError, FileExistsError):
+                return
+            view[:] = b"c" * (8 * 1024)
+            view.release()
+            store.seal(oid)
+            store.delete(oid)
+            counters["created"] += 1
+
+        def getter_fn(i=[0]):
+            oid = shared[i[0] % len(shared)]
+            i[0] += 1
+            got = store.get(oid)
+            if got is None:
+                return  # evicted by a full creator — legal here
+            view, _meta = got
+            assert view[:1] in (b"s", b"r")
+            view.release()
+            store.release(oid)
+            counters["pinned"] += 1
+
+        def deleter_fn(i=[0]):
+            oid = shared[i[0] % len(shared)]
+            i[0] += 1
+            if store.delete(oid):
+                try:
+                    store.put_bytes(oid, b"r" * 1024)
+                except (ObjectStoreFullError, FileExistsError):
+                    pass
+
+        def poller_fn():
+            store.stats()
+
+        threads = [threading.Thread(target=run, args=(f,))
+                   for f in (creator_fn, creator_fn, getter_fn, getter_fn,
+                             deleter_fn, poller_fn)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(30)
+            assert not t.is_alive()
+        assert errors == [], errors
+        assert counters["created"] > 0 and counters["pinned"] > 0, counters
+    finally:
+        store.destroy()
+
+
+_SCENARIOS = {
+    "ring": _scenario_ring,
+    "chan_close": _scenario_chan_close,
+    "store": _scenario_store,
+}
+
+
+if __name__ == "__main__":
+    _SCENARIOS[sys.argv[1]]()
+    print("SCENARIO-OK")

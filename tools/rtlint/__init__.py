@@ -1,0 +1,336 @@
+"""rtlint — repo-invariant static analyzer for the async control plane.
+
+The reference enforces its concurrency invariants with clang-tidy + absl
+thread-safety annotations on the C++ side; this framework's control plane is
+~250 `async def`s of CPython where the equivalent bug classes — a blocking
+call stalling the event loop, a `threading.Lock` held across an `await`, a
+GC'd fire-and-forget task — are invisible to generic linters because they are
+*repo* invariants, not language ones. rtlint encodes them as AST rules:
+
+  R001  blocking call (time.sleep / subprocess.* / os.system / sync file IO)
+        inside an `async def` — stalls every coroutine on the loop
+  R002  `threading.Lock`/`RLock` held across an `await` — the loop parks
+        inside the critical section; any other loop-thread acquirer deadlocks
+  R003  `asyncio.create_task`/`ensure_future` result discarded — the loop
+        keeps only weak refs, the task can be GC'd mid-flight (use
+        `_private.aio.spawn`)
+  R004  config knob read that is not declared in `_private/config.py` —
+        undeclared knobs silently read defaults and are invisible to
+        `system_config` / env override
+  R005  metric constructed outside the `ray_tpu.util.metrics` registry, or
+        with a dynamic name — bypasses idempotent registration and the
+        per-node cardinality cap
+  R006  `except:` / `except Exception: pass` inside an `rpc_*` handler —
+        swallows the error the RPC plane would have reported to the caller
+
+False positives are waived inline with a reason:
+
+    time.sleep(0.01)  # rtlint: disable=R001 <why this is safe>
+
+A waiver comment may sit on the offending line or alone on the line above.
+A waiver without a reason does not waive and is itself reported (W000).
+
+Exit codes (stable for CI): 0 clean, 1 findings, 2 usage/internal error.
+Finding format (stable for CI): `path:line:col: RXXX message`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "iter_py_files",
+    "format_finding",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    # extra lines a waiver comment may sit on (e.g. the closing line of a
+    # multi-line call); the reported `line` is always implicitly included
+    span: Tuple[int, ...] = ()
+
+
+def format_finding(f: Finding) -> str:
+    return f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}"
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+
+_WAIVER_RE = re.compile(
+    r"#\s*rtlint:\s*disable=([A-Z]\d{3}(?:\s*,\s*[A-Z]\d{3})*)(.*)$")
+
+
+def _parse_waivers(lines: List[str], path: str
+                   ) -> Tuple[Dict[int, Set[str]], List[Finding]]:
+    """Map line number -> waived rule ids. A waiver on line N covers N; a
+    comment-only waiver line also covers N+1 (the statement below it)."""
+    waived: Dict[int, Set[str]] = {}
+    bad: List[Finding] = []
+    for i, text in enumerate(lines, start=1):
+        m = _WAIVER_RE.search(text)
+        if not m:
+            continue
+        reason = m.group(2).strip()
+        if not reason:
+            bad.append(Finding(path, i, 1, "W000",
+                               "waiver has no reason; it does not waive "
+                               "(write `# rtlint: disable=RXXX <reason>`)"))
+            continue
+        rules = {r.strip() for r in m.group(1).split(",")}
+        waived.setdefault(i, set()).update(rules)
+        if text.lstrip().startswith("#"):
+            waived.setdefault(i + 1, set()).update(rules)
+    return waived, bad
+
+
+# ---------------------------------------------------------------------------
+# per-file context shared by the rules
+# ---------------------------------------------------------------------------
+
+class FileContext:
+    """One parsed file plus the symbol facts every rule needs: the import
+    map (local name -> dotted module), `threading.Lock()` bindings, and
+    module-local config accessors."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST,
+                 declared_knobs: Optional[Set[str]] = None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.declared_knobs = declared_knobs
+        self.package = _package_of(path)
+        self.imports: Dict[str, str] = {}          # local name -> module path
+        self.import_members: Dict[str, Tuple[str, str]] = {}  # name -> (mod, attr)
+        self.lock_names: Set[str] = set()          # bare names bound to Lock()
+        self.lock_attrs: Set[str] = set()          # attr names: self.<X> = Lock()
+        self.cfg_helpers: Set[str] = set()         # local fns wrapping GLOBAL_CONFIG.get
+        self._scan()
+
+    def _scan(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = self._resolve_from(node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.import_members[a.asname or a.name] = (mod, a.name)
+                    # `from ray_tpu._private import config` style: the member
+                    # is itself a module
+                    self.imports.setdefault(
+                        a.asname or a.name, f"{mod}.{a.name}" if mod else a.name)
+            elif isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.lock_names.add(tgt.id)
+                    elif isinstance(tgt, ast.Attribute):
+                        self.lock_attrs.add(tgt.attr)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _is_cfg_helper(node):
+                    self.cfg_helpers.add(node.name)
+
+    def _resolve_from(self, node: ast.ImportFrom) -> str:
+        if not node.level:
+            return node.module or ""
+        # relative import: resolve against this file's package path
+        parts = self.package.split(".") if self.package else []
+        if node.level > len(parts):
+            base: List[str] = []
+        else:
+            base = parts[: len(parts) - (node.level - 1)]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    def module_of(self, name: str) -> str:
+        """Dotted module a bare name refers to ('' if unknown/local)."""
+        if name in self.imports:
+            return self.imports[name]
+        if name in self.import_members:
+            mod, attr = self.import_members[name]
+            return f"{mod}.{attr}" if mod else attr
+        return ""
+
+    def member_origin(self, name: str) -> Tuple[str, str]:
+        """(module, attr) for a `from module import attr` binding."""
+        return self.import_members.get(name, ("", name))
+
+
+def _package_of(path: str) -> str:
+    """Best-effort dotted package for `path` ('ray_tpu._private' for
+    ray_tpu/_private/chaos.py) so relative imports resolve."""
+    norm = path.replace(os.sep, "/")
+    for root in ("ray_tpu", "tools", "tests"):
+        marker = f"{root}/"
+        idx = norm.rfind(marker)
+        if idx != -1:
+            rel = norm[idx:]
+            parts = rel.split("/")
+            return ".".join(parts[:-1])
+    return ""
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """threading.Lock() / threading.RLock() (also bare Lock() when imported
+    from threading — resolved by the caller via FileContext if needed; the
+    dotted form is what the tree uses)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in ("Lock", "RLock"):
+        return isinstance(fn.value, ast.Name) and fn.value.id == "threading"
+    if isinstance(fn, ast.Name) and fn.id in ("Lock", "RLock"):
+        return True
+    return False
+
+
+def _is_cfg_helper(fn: ast.AST) -> bool:
+    """A one-param module-local wrapper whose body reads
+    GLOBAL_CONFIG.get(<param>) — calls to it are knob reads."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    args = fn.args
+    if len(args.args) != 1 or args.vararg or args.kwonlyargs:
+        return False
+    param = args.args[0].arg
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "GLOBAL_CONFIG"
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == param):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# declared-knob extraction (for R004)
+# ---------------------------------------------------------------------------
+
+def load_declared_knobs(config_path: str) -> Set[str]:
+    """Parse `_private/config.py` for `_flag("name", ...)` /
+    `GLOBAL_CONFIG.declare("name", ...)` calls."""
+    with open(config_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=config_path)
+    knobs: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        is_decl = (
+            (isinstance(fn, ast.Name) and fn.id == "_flag")
+            or (isinstance(fn, ast.Attribute) and fn.attr == "declare"))
+        if is_decl and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            knobs.add(node.args[0].value)
+    return knobs
+
+
+def find_config_py(paths: Iterable[str]) -> Optional[str]:
+    """Locate ray_tpu/_private/config.py relative to the lint targets (walk
+    up from each target looking for it)."""
+    for p in paths:
+        cur = os.path.abspath(p)
+        if os.path.isfile(cur):
+            cur = os.path.dirname(cur)
+        for _ in range(8):
+            cand = os.path.join(cur, "ray_tpu", "_private", "config.py")
+            if os.path.isfile(cand):
+                return cand
+            cand = os.path.join(cur, "_private", "config.py")
+            if os.path.isfile(cand):
+                return cand
+            nxt = os.path.dirname(cur)
+            if nxt == cur:
+                break
+            cur = nxt
+    return None
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d not in ("__pycache__", "_build", ".git", "node_modules"))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return out
+
+
+def lint_file(path: str, declared_knobs: Optional[Set[str]] = None,
+              rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    from tools.rtlint import rules as rules_mod
+
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding(path, 1, 1, "E000", f"unreadable: {e}")]
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.offset or 1, "E001",
+                        f"syntax error: {e.msg}")]
+    ctx = FileContext(path, source, tree, declared_knobs)
+    waived, findings = _parse_waivers(ctx.lines, path)
+    selected = set(rules) if rules is not None else set(RULES)
+    for rule_id, (check, _doc) in RULES.items():
+        if rule_id not in selected:
+            continue
+        for f in check(ctx):
+            if any(f.rule in waived.get(ln, ())
+                   for ln in (f.line, *f.span)):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    files = iter_py_files(paths)
+    cfg = find_config_py(paths)
+    knobs = load_declared_knobs(cfg) if cfg else None
+    out: List[Finding] = []
+    for f in files:
+        out.extend(lint_file(f, declared_knobs=knobs, rules=rules))
+    return out
+
+
+# populated at import time from rules.py (kept in a separate module so the
+# engine above stays rule-agnostic)
+from tools.rtlint.rules import RULES  # noqa: E402

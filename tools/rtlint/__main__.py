@@ -1,0 +1,52 @@
+"""CLI: `python -m tools.rtlint [paths...]`.
+
+Exit codes (stable for CI): 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.rtlint import RULES, format_finding, lint_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.rtlint",
+        description="Repo-invariant static analyzer for the async control "
+                    "plane (see tools/rtlint/__init__.py for the rule "
+                    "catalog and waiver syntax).")
+    ap.add_argument("paths", nargs="*", default=["ray_tpu"],
+                    help="files/directories to lint (default: ray_tpu)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--select", metavar="RULES",
+                    help="comma-separated rule ids to run (default: all)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid][1]}")
+        return 0
+
+    rules = None
+    if args.select:
+        rules = [r.strip() for r in args.select.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"rtlint: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    findings = lint_paths(args.paths or ["ray_tpu"], rules=rules)
+    for f in findings:
+        print(format_finding(f))
+    if findings:
+        print(f"rtlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

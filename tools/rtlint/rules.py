@@ -1,0 +1,370 @@
+"""The rtlint rule set (R001–R006). Each rule is `check(ctx) -> [Finding]`
+over one parsed file; shared symbol facts (imports, lock bindings, config
+helpers) come from `FileContext`. Registered in RULES at the bottom —
+`python -m tools.rtlint --list-rules` renders the catalog from there."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Tuple
+
+from tools.rtlint import FileContext, Finding
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk `node` without descending into nested function/lambda bodies:
+    code in a nested def runs in its own (possibly non-async) context."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, _FUNC_DEFS):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _span(node: ast.AST) -> Tuple[int, ...]:
+    """Every line the statement/expression occupies, so a waiver comment on
+    any of them (typically the closing line of a multi-line call) applies."""
+    end = getattr(node, "end_lineno", None) or node.lineno
+    return tuple(range(node.lineno, end + 1))
+
+
+def _async_defs(tree: ast.AST) -> Iterator[ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+def _call_name(ctx: FileContext, call: ast.Call
+               ) -> Tuple[str, str]:
+    """(module, attr) a call resolves to: `time.sleep(...)` ->
+    ('time', 'sleep'); `sleep(...)` after `from time import sleep` ->
+    ('time', 'sleep'); unresolvable receivers give ('', attr)."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if isinstance(fn.value, ast.Name):
+            return ctx.module_of(fn.value.id), fn.attr
+        return "", fn.attr
+    if isinstance(fn, ast.Name):
+        mod, attr = ctx.member_origin(fn.id)
+        return mod, attr
+    return "", ""
+
+
+# ---------------------------------------------------------------------------
+# R001 — blocking call inside `async def`
+# ---------------------------------------------------------------------------
+
+_R001_BLOCKING = {
+    ("time", "sleep"): "use `await asyncio.sleep(...)`",
+    ("subprocess", "run"): "use `asyncio.create_subprocess_exec` or a thread",
+    ("subprocess", "call"): "use `asyncio.create_subprocess_exec` or a thread",
+    ("subprocess", "check_call"):
+        "use `asyncio.create_subprocess_exec` or a thread",
+    ("subprocess", "check_output"):
+        "use `asyncio.create_subprocess_exec` or a thread",
+    ("os", "system"): "use `asyncio.create_subprocess_exec` or a thread",
+    ("os", "wait"): "reap in an executor thread",
+    ("os", "waitpid"): "reap in an executor thread",
+    ("socket", "create_connection"): "use `loop.sock_connect`/open_connection",
+    ("socket", "getaddrinfo"): "use `loop.getaddrinfo`",
+}
+
+# sync file-IO attribute calls (pathlib idiom) — receiver-agnostic
+_R001_IO_ATTRS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+
+
+def check_r001(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _async_defs(ctx.tree):
+        for node in _walk_same_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            mod, attr = _call_name(ctx, node)
+            hint = _R001_BLOCKING.get((mod.split(".")[0] if mod else mod,
+                                       attr))
+            what = None
+            if hint is not None:
+                what = f"{mod.split('.')[0]}.{attr}"
+            elif isinstance(node.func, ast.Name) and node.func.id == "open" \
+                    and not ctx.member_origin("open")[0]:
+                what, hint = "open()", (
+                    "sync file IO; do it in a thread (or before the await "
+                    "point) — the loop stalls for the duration")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _R001_IO_ATTRS:
+                what, hint = f".{node.func.attr}()", (
+                    "sync file IO; do it in a thread (or before the await "
+                    "point) — the loop stalls for the duration")
+            if what is None:
+                continue
+            out.append(Finding(
+                ctx.path, node.lineno, node.col_offset + 1, "R001",
+                f"blocking call {what} inside `async def {fn.name}` stalls "
+                f"the event loop — {hint}", span=_span(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R002 — threading.Lock held across an await
+# ---------------------------------------------------------------------------
+
+def _is_lock_expr(ctx: FileContext, expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name) and expr.id in ctx.lock_names:
+        return expr.id
+    if isinstance(expr, ast.Attribute) and expr.attr in ctx.lock_attrs:
+        return expr.attr
+    return None
+
+
+def check_r002(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _async_defs(ctx.tree):
+        for node in _walk_same_scope(fn):
+            if not isinstance(node, ast.With):
+                continue
+            lock = None
+            for item in node.items:
+                lock = _is_lock_expr(ctx, item.context_expr)
+                if lock:
+                    break
+            if not lock:
+                continue
+            for sub in _walk_same_scope(node):
+                if isinstance(sub, ast.Await):
+                    out.append(Finding(
+                        ctx.path, node.lineno, node.col_offset + 1,
+                        "R002",
+                        f"threading lock `{lock}` held across `await` "
+                        f"(line {sub.lineno}) in `async def {fn.name}` "
+                        f"— the loop parks inside the critical section; "
+                        f"any same-thread acquirer deadlocks. Release "
+                        f"before awaiting or use asyncio.Lock"))
+                    break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R003 — fire-and-forget task with no retained reference
+# ---------------------------------------------------------------------------
+
+def check_r003(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Expr) or not isinstance(node.value,
+                                                            ast.Call):
+            continue
+        call = node.value
+        fn = call.func
+        name = None
+        if isinstance(fn, ast.Attribute) and fn.attr in ("create_task",
+                                                         "ensure_future"):
+            name = fn.attr
+        elif isinstance(fn, ast.Name) and fn.id in ("create_task",
+                                                    "ensure_future"):
+            if ctx.member_origin(fn.id)[0] == "asyncio":
+                name = fn.id
+        if name is None:
+            continue
+        out.append(Finding(
+            ctx.path, node.lineno, node.col_offset + 1, "R003",
+            f"`{name}` result discarded — the event loop keeps only weak "
+            f"task refs, so the task can be garbage-collected mid-flight "
+            f"(silent cancellation). Use `ray_tpu._private.aio.spawn` or "
+            f"retain the handle", span=_span(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R004 — config knob read that is not declared in _private/config.py
+# ---------------------------------------------------------------------------
+
+_CONFIG_MODULE_RE = re.compile(r"(^|\.)_private\.config$")
+
+
+def _knob_read(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    """The knob name if `call` is a config-registry read with a literal
+    name, else None."""
+    fn = call.func
+    lit = None
+    if len(call.args) == 1 and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        lit = call.args[0].value
+    if lit is None:
+        return None
+    if isinstance(fn, ast.Attribute) and fn.attr == "get" \
+            and isinstance(fn.value, ast.Name):
+        recv = fn.value.id
+        if recv == "GLOBAL_CONFIG":
+            return lit
+        if _CONFIG_MODULE_RE.search(ctx.module_of(recv) or ""):
+            return lit
+        return None
+    if isinstance(fn, ast.Name):
+        if fn.id in ctx.cfg_helpers:
+            return lit
+        mod, attr = ctx.member_origin(fn.id)
+        if attr == "get" and _CONFIG_MODULE_RE.search(mod or ""):
+            return lit
+    return None
+
+
+def check_r004(ctx: FileContext) -> List[Finding]:
+    if ctx.declared_knobs is None:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        knob = _knob_read(ctx, node)
+        if knob is not None and knob not in ctx.declared_knobs:
+            out.append(Finding(
+                ctx.path, node.lineno, node.col_offset + 1, "R004",
+                f"config knob {knob!r} is read but not declared in "
+                f"_private/config.py — it would raise KeyError at runtime "
+                f"and is invisible to env/system_config override. Declare "
+                f"it with `_flag({knob!r}, <default>, <help>)`",
+                span=_span(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R005 — metric constructed outside the registry (or with a dynamic name)
+# ---------------------------------------------------------------------------
+
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_NON_METRIC_MODULES = ("collections", "typing", "multiprocessing")
+_METRIC_NAME_RE = re.compile(
+    r"^[a-z][a-z0-9_]*(_total|_seconds|_bytes|_count)$")
+
+
+def check_r005(ctx: FileContext) -> List[Finding]:
+    if ctx.path.replace("\\", "/").endswith("util/metrics.py"):
+        return []  # the registry itself
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _METRIC_CLASSES:
+            origin = ctx.member_origin(fn.id)[0]
+        elif isinstance(fn, ast.Attribute) and fn.attr in _METRIC_CLASSES \
+                and isinstance(fn.value, ast.Name):
+            origin = ctx.module_of(fn.value.id)
+        else:
+            continue
+        origin = origin or ""
+        if origin.split(".")[0] in _NON_METRIC_MODULES:
+            continue
+        blessed = origin == "ray_tpu.util.metrics" \
+            or origin.endswith("util.metrics")
+        name_arg: Optional[ast.expr] = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_arg = kw.value
+        literal_name = (isinstance(name_arg, ast.Constant)
+                        and isinstance(name_arg.value, str))
+        if blessed:
+            if not literal_name:
+                out.append(Finding(
+                    ctx.path, node.lineno, node.col_offset + 1, "R005",
+                    "metric constructed with a dynamic name — defeats the "
+                    "registry's idempotent registration and the per-node "
+                    "cardinality cap; put variability in tag values, not "
+                    "the metric name", span=_span(node)))
+            continue
+        metric_shaped = (
+            any(kw.arg in ("tag_keys", "boundaries") for kw in node.keywords)
+            or (literal_name and (
+                name_arg.value.startswith("rt_")  # type: ignore[union-attr]
+                or _METRIC_NAME_RE.match(name_arg.value))))  # type: ignore
+        if ("metric" in origin or "prometheus" in origin
+                or (not origin and metric_shaped)):
+            out.append(Finding(
+                ctx.path, node.lineno, node.col_offset + 1, "R005",
+                "metric constructed outside the ray_tpu.util.metrics "
+                "registry — it will not aggregate through the node daemon "
+                "or render in prometheus_text(); construct "
+                "Counter/Gauge/Histogram from ray_tpu.util.metrics",
+                span=_span(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R006 — swallowed exceptions in RPC handlers
+# ---------------------------------------------------------------------------
+
+def _body_is_swallow(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / Ellipsis
+        if isinstance(stmt, ast.Continue):
+            continue
+        return False
+    return True
+
+
+def check_r006(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not fn.name.startswith("rpc_"):
+            continue
+        for node in _walk_same_scope(fn):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                out.append(Finding(
+                    ctx.path, node.lineno, node.col_offset + 1, "R006",
+                    f"bare `except:` in RPC handler `{fn.name}` — catches "
+                    f"SystemExit/KeyboardInterrupt and hides the error the "
+                    f"RPC plane would report to the caller; catch a "
+                    f"concrete exception type"))
+                continue
+            names = []
+            t = node.type
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for e in elts:
+                if isinstance(e, ast.Name):
+                    names.append(e.id)
+            if set(names) & {"Exception", "BaseException"} \
+                    and _body_is_swallow(node.body):
+                out.append(Finding(
+                    ctx.path, node.lineno, node.col_offset + 1, "R006",
+                    f"`except {'/'.join(names)}: pass` in RPC handler "
+                    f"`{fn.name}` silently swallows the failure — the "
+                    f"caller sees a success/empty reply instead of the "
+                    f"error; log it or let the RPC plane report it"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# catalog
+# ---------------------------------------------------------------------------
+
+RULES = {
+    "R001": (check_r001,
+             "blocking call (time.sleep / subprocess.* / os.system / sync "
+             "file IO) inside an `async def` stalls the event loop"),
+    "R002": (check_r002,
+             "threading.Lock/RLock held across an `await` — deadlock class "
+             "+ latency cliff; release first or use asyncio.Lock"),
+    "R003": (check_r003,
+             "asyncio.create_task/ensure_future result discarded — the "
+             "task can be GC'd mid-flight; use _private.aio.spawn"),
+    "R004": (check_r004,
+             "config knob read that is not declared in _private/config.py"),
+    "R005": (check_r005,
+             "metric constructed outside the ray_tpu.util.metrics registry "
+             "(or with a dynamic name)"),
+    "R006": (check_r006,
+             "bare `except:` or `except Exception: pass` inside an `rpc_*` "
+             "handler swallows the error the caller should see"),
+}
