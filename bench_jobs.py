@@ -1,0 +1,304 @@
+"""Job-plane bench: a Tune-style trial fleet vs the autoscaler, A/B'ing
+demand-driven against liveness-reactive scale-up at 500+ simnodes.
+
+The workload (ROADMAP item 5's proof): hundreds of short trial jobs
+across 3 tenants — one tenant submitting 10x — burst-submitted into the
+durable job table, admitted in weighted fair-share order (the EXACT
+`FairShareQueue` the JobManager runs), each trial occupying one
+autoscaler-launched simnode for `--trial-s` seconds. The driver plays
+the job plane; the REAL reconciler (standalone mode, its own RPC loop
+against the store) plays capacity:
+
+  demand:   the reconciler sees queued-job resource shapes straight from
+            the submitted-job table (`pending_job_resources`) plus pushed
+            `report_demand` entries — capacity provisions before any
+            lease exists, so the whole fleet storms up in one pass.
+  reactive: the pre-PR signal path — only lease shapes already pending
+            on live daemons' heartbeats (capped per node), so capacity
+            compounds one poll round at a time from `min_workers`.
+
+Phases per mode:
+  trial_fleet        burst submit -> admission -> completion. Reports
+                     time-to-first-trial, makespan, ramp-to-90%-capacity,
+                     store CPU, per-tenant completed counts, and the
+                     fair-share error over the all-tenants-backlogged
+                     admission prefix (|share - 1/3| must stay bounded).
+  nodes_over_time    sampled {t, alive, running, queued, done} curve.
+  scale_down_drain   queue empty -> idle-past-timeout nodes drained and
+                     terminated by the reconciler; convergence time to
+                     min_workers + store CPU while shrinking.
+
+Plus (--autoscale) the bench_scale.py storm/drain column riding in this
+artifact: pure report_demand scale-up of N nodes and drain back to zero.
+
+Zero `protocol_errors` across every simnode is the correctness gate.
+
+Run: python bench_jobs.py [--quick] [--nodes N] [--jobs J]
+                          [--out BENCH_JOBS_r16.json]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+
+def _proc_cpu_s(pid: int) -> float:
+    with open(f"/proc/{pid}/stat") as f:
+        parts = f.read().split()
+    hz = os.sysconf("SC_CLK_TCK")
+    return (int(parts[13]) + int(parts[14])) / hz
+
+
+def _fair_share_error(admit_log, tenants):
+    """Max |admitted share - equal share| at the end of the prefix during
+    which EVERY tenant still had backlog (the window where fair share is
+    defined), skipping the first few admissions of warmup."""
+    counts = {t: 0 for t in tenants}
+    err, n = 0.0, 0
+    for _ts, tenant, backlog_before in admit_log:
+        if any(backlog_before[t] <= 0 for t in tenants):
+            break
+        counts[tenant] += 1
+        n += 1
+        if n >= 3 * len(tenants):
+            err = max(abs(counts[t] / n - 1.0 / len(tenants))
+                      for t in tenants)
+    return round(err, 4), n
+
+
+async def run_mode(mode: str, args) -> list:
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.protocol import ResourceSet
+    from ray_tpu.autoscaler import Autoscaler, AutoscalingConfig
+    from ray_tpu.autoscaler.fake_provider import FakeNodeProvider
+    from ray_tpu.job_submission import FairShareQueue
+    from ray_tpu.runtime.rpc import RpcClient
+
+    GLOBAL_CONFIG.reset()
+    GLOBAL_CONFIG.apply_system_config({
+        "node_table_delta_sync": True,
+        "pubsub_flush_window_ms": 25.0,
+        "heartbeat_jitter": 0.2,
+        "control_store_persist": True,
+        "autoscaler_job_shapes_max": 1024,
+    })
+    session = node_mod.new_session_dir()
+    cs_proc, addr = node_mod.start_control_store(session)
+    provider = FakeNodeProvider(addr, seed=args.seed)
+    scaler = Autoscaler(provider, AutoscalingConfig(
+        min_workers=1, max_workers=args.nodes,
+        worker_resources={"CPU": 4.0},
+        idle_timeout_s=args.idle_timeout_s,
+        poll_period_s=args.poll_s,
+        demand_driven=(mode == "demand"),
+    ), control_address=addr).start()
+
+    client = RpcClient(addr, name="bench-jobs")
+    await client.connect()
+
+    unit = max(1, args.jobs // 12)
+    fleet = [("flood", 10 * unit), ("team-a", unit), ("team-b", unit)]
+    tenants = [t for t, _ in fleet]
+    total_jobs = sum(n for _, n in fleet)
+    trial_res = {"CPU": 4.0}  # one trial fills one worker node
+    trial_set = ResourceSet(trial_res)
+
+    results = []
+
+    def rec(phase: str, **fields):
+        row = {"bench": phase, "mode": mode, "nodes_max": args.nodes,
+               "jobs": total_jobs, **fields}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    try:
+        # -- burst submit into the durable table -------------------------
+        queue = FairShareQueue(lambda t: 1.0)
+        sid_tenant = {}
+        t_sub = time.monotonic()
+        puts = []
+        for tenant, n in fleet:
+            for i in range(n):
+                sid = f"trial-{tenant}-{i:04d}"
+                sid_tenant[sid] = tenant
+                queue.push(tenant, sid, 4.0)
+                puts.append(client.call("job_put", {"job": {
+                    "submission_id": sid, "tenant": tenant,
+                    "entrypoint": f"trial {tenant}/{i}",
+                    "status": "QUEUED", "resources": dict(trial_res),
+                    "submit_time": time.time()}}, timeout=60))
+        await asyncio.gather(*puts)
+        submit_s = time.monotonic() - t_sub
+
+        # -- the fleet loop ----------------------------------------------
+        running = {}            # sid -> (handle, finish_ts)
+        backlog = {t: n for t, n in fleet}
+        completed = {t: 0 for t in tenants}
+        admit_log, samples = [], []
+        done, first_admit, last_sample = 0, None, -1e9
+        shape_cap = GLOBAL_CONFIG.get("heartbeat_pending_shapes_max")
+        cpu0 = _proc_cpu_s(cs_proc.pid)
+        t0 = time.monotonic()
+        while done < total_jobs and time.monotonic() - t0 < args.timeout_s:
+            now = time.monotonic()
+            updates = []
+            for sid in [s for s, (_h, fin) in running.items() if fin <= now]:
+                h, _fin = running.pop(sid)
+                h["sim"].available = h["sim"].available + trial_set
+                completed[sid_tenant[sid]] += 1
+                done += 1
+                updates.append(client.call("job_update", {
+                    "submission_id": sid,
+                    "fields": {"status": "SUCCEEDED",
+                               "end_time": time.time()}}, timeout=60))
+            free = [h for h in provider.nodes.values()
+                    if h["sim"].state == "ALIVE"
+                    and trial_set.is_subset_of(h["sim"].available)]
+            while free:
+                picked = queue.pop(lambda t, s: True)
+                if picked is None:
+                    break
+                tenant, sid = picked
+                h = free.pop()
+                h["sim"].available = h["sim"].available - trial_set
+                admit_log.append((now, tenant, dict(backlog)))
+                backlog[tenant] -= 1
+                running[sid] = (h, now + args.trial_s)
+                if first_admit is None:
+                    first_admit = now
+                updates.append(client.call("job_update", {
+                    "submission_id": sid,
+                    "fields": {"status": "RUNNING",
+                               "start_time": time.time()}}, timeout=60))
+            if updates:
+                await asyncio.gather(*updates)
+            # the daemon-visible (reactive) signal: supervisors admitted
+            # ahead of capacity pend leases on live daemons — a 2x
+            # overcommit window spread node by node, heartbeat-capped;
+            # this is ALL the reactive reconciler ever sees
+            alive = [h for h in provider.nodes.values()
+                     if h["sim"].state == "ALIVE"]
+            overflow = min(queue.backlog(),
+                           max(0, max(8, 2 * len(alive)) - len(running)))
+            for h in alive:
+                share = min(overflow, shape_cap)
+                h["sim"].pending_shapes = [dict(trial_res)] * share
+                overflow -= share
+            if now - last_sample >= args.sample_s:
+                last_sample = now
+                samples.append({
+                    "t": round(now - t0, 2), "alive": len(alive),
+                    "running": len(running), "done": done,
+                    "queued": queue.backlog()})
+            await asyncio.sleep(args.tick_s)
+
+        makespan = time.monotonic() - t0
+        cpu1 = _proc_cpu_s(cs_proc.pid)
+        peak = max((s["alive"] for s in samples), default=0)
+        ramp90 = next((s["t"] for s in samples
+                       if s["alive"] >= 0.9 * peak), None)
+        fs_err, fs_window = _fair_share_error(admit_log, tenants)
+        errors = provider.protocol_errors()
+        rec("trial_fleet",
+            submit_s=round(submit_s, 3),
+            time_to_first_trial_s=(
+                round(first_admit - t0, 3) if first_admit else None),
+            makespan_s=round(makespan, 3),
+            ramp_90pct_s=ramp90, peak_nodes=peak,
+            store_cpu_frac=round((cpu1 - cpu0) / max(makespan, 1e-9), 4),
+            fair_share_err=fs_err, fair_share_window=fs_window,
+            completed=completed, timed_out=done < total_jobs,
+            protocol_errors=len(errors), errors_sample=errors[:3])
+        rec("nodes_over_time", samples=samples)
+
+        # -- scale-down drain --------------------------------------------
+        for h in provider.nodes.values():
+            h["sim"].pending_shapes = []
+        cpu0 = _proc_cpu_s(cs_proc.pid)
+        t0 = time.monotonic()
+        floor = 1  # min_workers
+        while time.monotonic() - t0 < args.drain_timeout_s:
+            alive_n = sum(1 for h in provider.nodes.values()
+                          if h["sim"].state == "ALIVE")
+            if alive_n <= floor:
+                break
+            await asyncio.sleep(0.25)
+        drain_s = time.monotonic() - t0
+        cpu1 = _proc_cpu_s(cs_proc.pid)
+        errors = provider.protocol_errors()
+        rec("scale_down_drain",
+            drain_s=round(drain_s, 3),
+            final_nodes=sum(1 for h in provider.nodes.values()
+                            if h["sim"].state == "ALIVE"),
+            converged=drain_s < args.drain_timeout_s,
+            store_cpu_frac=round((cpu1 - cpu0) / max(drain_s, 1e-9), 4),
+            protocol_errors=len(errors))
+    finally:
+        await client.close()
+        scaler.stop()
+        provider.shutdown()
+        node_mod.kill_process(cs_proc, force=True)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=0,
+                    help="max autoscaled simnodes (default 520, or 10 with "
+                         "--quick)")
+    ap.add_argument("--jobs", type=int, default=0,
+                    help="total trial jobs across the 3 tenants (default "
+                         "600, or 24 with --quick)")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--mode", choices=["demand", "reactive", "both"],
+                    default="both")
+    ap.add_argument("--seed", type=int, default=115)
+    ap.add_argument("--trial-s", type=float, default=0.0,
+                    help="per-trial runtime (default 2.0, or 0.6 quick)")
+    ap.add_argument("--poll-s", type=float, default=0.0,
+                    help="autoscaler poll period (default 0.5, 0.3 quick)")
+    ap.add_argument("--idle-timeout-s", type=float, default=0.0,
+                    help="autoscaler idle timeout (default 6.0, 1.5 quick)")
+    ap.add_argument("--tick-s", type=float, default=0.1)
+    ap.add_argument("--sample-s", type=float, default=0.0)
+    ap.add_argument("--timeout-s", type=float, default=600.0)
+    ap.add_argument("--drain-timeout-s", type=float, default=180.0)
+    ap.add_argument("--autoscale", action="store_true",
+                    help="also run bench_scale's pure storm/drain column "
+                         "into this artifact")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    args.nodes = args.nodes or (10 if args.quick else 520)
+    args.jobs = args.jobs or (24 if args.quick else 600)
+    args.trial_s = args.trial_s or (0.6 if args.quick else 2.0)
+    args.poll_s = args.poll_s or (0.3 if args.quick else 0.5)
+    args.idle_timeout_s = args.idle_timeout_s or (1.5 if args.quick else 6.0)
+    args.sample_s = args.sample_s or (0.5 if args.quick else 1.0)
+
+    modes = (["demand", "reactive"] if args.mode == "both" else [args.mode])
+    all_results = []
+    for mode in modes:
+        all_results.extend(asyncio.run(run_mode(mode, args)))
+    if args.autoscale:
+        import bench_scale
+
+        sc_args = argparse.Namespace(nodes=min(args.nodes, 500),
+                                     seed=args.seed)
+        all_results.extend(asyncio.run(bench_scale.run_autoscale(sc_args)))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({
+                "bench": "bench_jobs",
+                "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "nodes": args.nodes, "jobs": args.jobs,
+                "trial_s": args.trial_s, "seed": args.seed,
+                "results": all_results,
+            }, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

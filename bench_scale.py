@@ -393,6 +393,79 @@ async def run_failover(args, backend: str) -> list:
     return results
 
 
+async def run_autoscale(args) -> list:
+    """Scale-up-storm / scale-down-drain column (ROADMAP item 6): the
+    REAL autoscaler reconciler grows a simnode fleet to N via
+    FakeNodeProvider off pushed demand, then drains it back to zero once
+    the demand is withdrawn — convergence times + store CPU both ways,
+    with zero simnode protocol errors as the gate."""
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.autoscaler import Autoscaler, AutoscalingConfig
+    from ray_tpu.autoscaler.fake_provider import FakeNodeProvider
+    from ray_tpu.runtime.rpc import RpcClient
+
+    GLOBAL_CONFIG.reset()
+    GLOBAL_CONFIG.apply_system_config(dict(FIXES["on"]))
+    count = args.nodes
+    session_dir = node_mod.new_session_dir()
+    cs_proc, addr = node_mod.start_control_store(session_dir)
+    provider = FakeNodeProvider(addr, seed=args.seed)
+    scaler = Autoscaler(provider, AutoscalingConfig(
+        min_workers=0, max_workers=count,
+        worker_resources={"CPU": 4.0},
+        idle_timeout_s=2.0, poll_period_s=0.5,
+        demand_driven=True,
+    ), control_address=addr).start()
+    client = RpcClient(addr, name="bench-autoscale")
+    await client.connect()
+    results = []
+
+    def rec(phase: str, **fields):
+        row = {"bench": phase, "mode": "on", "nodes": count, **fields}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    async def wait_alive(predicate, timeout):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if predicate(provider.stats()["alive"]):
+                return time.monotonic() - t0, True
+            await asyncio.sleep(0.25)
+        return time.monotonic() - t0, False
+
+    try:
+        # storm: N one-node shapes of pushed demand -> fleet of N
+        cpu0 = _proc_cpu_s(cs_proc.pid)
+        await client.call("report_demand", {
+            "key": "bench-storm", "shapes": [{"CPU": 4.0}] * count,
+            "ttl_s": 3600.0})
+        storm_s, converged = await wait_alive(lambda a: a >= count, 300.0)
+        cpu1 = _proc_cpu_s(cs_proc.pid)
+        rec("autoscale_storm", storm_s=round(storm_s, 3),
+            converged=converged, alive=provider.stats()["alive"],
+            store_cpu_frac=round((cpu1 - cpu0) / max(storm_s, 1e-9), 4),
+            protocol_errors=len(provider.protocol_errors()))
+
+        # drain: withdraw the demand -> idle-timeout -> drain -> terminate
+        cpu0 = _proc_cpu_s(cs_proc.pid)
+        await client.call("report_demand", {
+            "key": "bench-storm", "shapes": []})
+        drain_s, converged = await wait_alive(lambda a: a == 0, 300.0)
+        cpu1 = _proc_cpu_s(cs_proc.pid)
+        errors = provider.protocol_errors()
+        rec("autoscale_drain", drain_s=round(drain_s, 3),
+            converged=converged, alive=provider.stats()["alive"],
+            store_cpu_frac=round((cpu1 - cpu0) / max(drain_s, 1e-9), 4),
+            protocol_errors=len(errors), errors_sample=errors[:3])
+    finally:
+        await client.close()
+        scaler.stop()
+        provider.shutdown()
+        node_mod.kill_process(cs_proc, force=True)
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=0,
@@ -415,6 +488,11 @@ def main():
     ap.add_argument("--failover-only", action="store_true",
                     help="skip the off/on mode sweep; run only the "
                          "failover column")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the autoscaler storm/drain column after the "
+                         "sweep (FakeNodeProvider + real reconciler)")
+    ap.add_argument("--autoscale-only", action="store_true",
+                    help="run only the autoscaler storm/drain column")
     args = ap.parse_args()
     if not args.nodes:
         args.nodes = 100 if args.quick else 1000
@@ -423,9 +501,13 @@ def main():
 
     modes = ["off", "on"] if args.mode == "both" else [args.mode]
     all_results = []
-    if not args.failover_only:
+    if not (args.failover_only or args.autoscale_only):
         for mode in modes:
             all_results.extend(asyncio.run(run_mode(mode, args)))
+    if args.autoscale or args.autoscale_only:
+        as_args = argparse.Namespace(**vars(args))
+        as_args.nodes = min(args.nodes, 500)
+        all_results.extend(asyncio.run(run_autoscale(as_args)))
     if args.failover != "off":
         backends = (["file", "sqlite"] if args.failover == "both"
                     else [args.failover])

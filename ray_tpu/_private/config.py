@@ -204,8 +204,26 @@ _flag("pubsub_max_backlog", 1000, "Bound on the per-subscriber pubsub backlog: b
 _flag("node_delta_retention", 1024, "Node-table delta-log retention (entries): subscribers reconcile from a version cursor via get_nodes_delta instead of full get_all_nodes snapshots; a cursor older than the retained window falls back to one full snapshot.")
 _flag("node_dead_retention", 512, "DEAD node records kept in the node table (oldest evicted with a persisted tombstone): bounds get_all_nodes payloads, the WAL/snapshot, and death-record memory under node churn. Live nodes are never evicted.")
 _flag("node_table_delta_sync", True, "Use the versioned node-table delta protocol: daemons/workers reconcile pubsub gaps from their version cursor (get_nodes_delta) and heartbeat replies carry only availability CHANGES since the daemon's cursor instead of the full O(nodes) view. Off = legacy full-snapshot reads everywhere (the bench_scale A/B lever).")
+_flag("heartbeat_pending_shapes_max", 32, "Cap on pending-lease resource shapes one daemon heartbeat carries (infeasible shapes ride a quarter of the budget); the uncounted tail still rides the pending count, which the demand-driven autoscaler treats as generic worker-sized demand.")
 _flag("simnode_count", 100, "Default simulated-node count for the scale harness (_private/simnode.py): protocol-faithful node-daemon speakers with no worker pools, hundreds per process, for control-plane scale testing.")
 _flag("simnode_seed", 0, "Seed for the simnode plane's deterministic node ids and jitter draws; 0 = fresh entropy.")
+
+# --- job plane (job_submission/: durable JobManager + per-tenant
+# fair-share admission; the job table lives in the control store) ---
+_flag("job_poll_period_s", 0.5, "JobManager reconcile cadence: supervisor liveness polls, queued-job admission, and store job-table writes all run on this period.")
+_flag("job_default_tenant", "default", "Tenant key assigned to submissions that carry none; quota/weight defaults below apply to tenants never configured explicitly via set_tenant.")
+_flag("job_tenant_max_running", 8, "Default per-tenant cap on concurrently RUNNING (admitted) jobs; a tenant's queued burst beyond the cap waits in the fair-share queue instead of flooding the cluster.")
+_flag("job_tenant_weight", 1.0, "Default fair-share weight for unconfigured tenants: admission order charges each tenant virtual time = job cost / weight, so completed-work share converges to the weight ratio under contention.")
+_flag("job_stop_grace_s", 5.0, "Seconds between SIGTERM and SIGKILL when stopping a job's driver process group.")
+_flag("job_supervisor_poll_timeout_s", 10.0, "Deadline on one JobManager->JobSupervisor liveness poll; expiry counts as a supervisor death (job FAILED or requeued under its max_retries).")
+
+# --- autoscaler (demand-driven reconciler; autoscaler/) ---
+_flag("autoscaler_poll_period_s", 1.0, "Autoscaler reconcile loop period (AutoscalingConfig.poll_period_s default).")
+_flag("autoscaler_idle_timeout_s", 10.0, "Nodes idle this long are drained (reversibly), then terminated if still idle on a later poll (AutoscalingConfig.idle_timeout_s default).")
+_flag("autoscaler_max_workers", 2, "Default cap on autoscaler-launched worker nodes (AutoscalingConfig.max_workers default).")
+_flag("autoscaler_demand_driven", True, "Scale on the full demand aggregate — pending lease shapes, unplaced placement-group bundles, QUEUED/PENDING job resources from the job table, and reported demand (elastic-train target width). Off = legacy liveness-reactive mode: only heartbeat-reported pending leases drive scale-up (the bench_jobs A/B lever).")
+_flag("autoscaler_job_shapes_max", 256, "Cap on queued-job resource shapes included in one get_cluster_load reply; the uncounted tail still rides the pending_jobs_total count.")
+_flag("report_demand_ttl_s", 10.0, "Default expiry on report_demand entries (elastic-train target width and other pushed demand sources); reporters refresh on their own cadence, so a dead reporter's demand ages out instead of holding nodes forever.")
 
 # --- retry policy (shared by RPC calls, object fetch, lease requests) ---
 _flag("retry_base_s", 0.2, "Unified retry policy: first backoff delay (reference: retryable_grpc_client backoff base).")

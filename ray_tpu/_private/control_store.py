@@ -298,6 +298,17 @@ class ControlStore:
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
         self.jobs: Dict[bytes, dict] = {}
         self._next_job = 1
+        # submitted-job table (the job PLANE: ray_tpu.job_submission
+        # records, distinct from the internal driver-job table above) —
+        # submission_id -> record. Persisted, so the table survives a
+        # control-store kill+takeover and the JobManager actor recovers
+        # all state from here (reference: the dashboard JobInfo storage
+        # client keeping job records in the GCS KV).
+        self.submitted_jobs: Dict[str, dict] = {}
+        # pushed demand with expiry (elastic-train target width, external
+        # reporters): key -> {"shapes": [wire], "expires": monotonic}.
+        # Ephemeral by design — reporters refresh on their own cadence.
+        self.reported_demand: Dict[str, dict] = {}
         self.actors: Dict[bytes, ActorRecord] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (namespace, name) -> actor_id
         self.placement_groups: Dict[bytes, PlacementGroupRecord] = {}
@@ -430,6 +441,7 @@ class ControlStore:
             "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
             "jobs": [dict(j) for j in self.jobs.values()],
             "next_job": self._next_job,
+            "submitted_jobs": [dict(j) for j in self.submitted_jobs.values()],
             "actors": [r.to_persist() for r in self.actors.values()],
             "pgs": [r.to_persist() for r in self.placement_groups.values()],
             # worker-death records + their delta-plane version: a failed-over
@@ -449,6 +461,7 @@ class ControlStore:
         self.nodes.clear()
         self.kv = {}
         self.jobs.clear()
+        self.submitted_jobs.clear()
         self.actors.clear()
         self.named_actors.clear()
         self.placement_groups.clear()
@@ -466,6 +479,8 @@ class ControlStore:
         for job in snap.get("jobs", []):
             self.jobs[job["job_id"]] = job
         self._next_job = snap.get("next_job", self._next_job)
+        for job in snap.get("submitted_jobs", []):
+            self.submitted_jobs[job["submission_id"]] = job
         for aw in snap.get("actors", []):
             rec = ActorRecord.from_persist(aw)
             self.actors[rec.spec.actor_id.binary()] = rec
@@ -503,6 +518,10 @@ class ControlStore:
             self.jobs[d["job"]["job_id"]] = d["job"]
             if "next_job" in d:
                 self._next_job = d["next_job"]
+        elif op == "subjob":
+            # full-record upsert: submitted-job records are small (the
+            # working-dir payload never enters the store)
+            self.submitted_jobs[d["submission_id"]] = d
         elif op == "actor":
             arec = ActorRecord.from_persist(d)
             self.actors[arec.spec.actor_id.binary()] = arec
@@ -980,10 +999,40 @@ class ControlStore:
                     "strategy": rec.strategy,
                     "labels": dict(rec.label_selector or {}),
                 })
+        # queued-job demand: jobs admitted-or-waiting in the submitted-job
+        # table that have not started running yet produce NO lease demand
+        # (their drivers don't exist) — the demand-driven autoscaler sees
+        # them here instead of waiting for admission + lease pending +
+        # heartbeat (the liveness-reactive pipeline)
+        pending_job_resources: List[dict] = []
+        pending_jobs_total = 0
+        shapes_cap = GLOBAL_CONFIG.get("autoscaler_job_shapes_max")
+        for j in self.submitted_jobs.values():
+            if j.get("status") not in ("QUEUED", "PENDING"):
+                continue
+            pending_jobs_total += 1
+            if len(pending_job_resources) < shapes_cap:
+                # job records hold human-unit floats; demand shapes travel
+                # in wire (fixed-point) format like heartbeat lease shapes
+                pending_job_resources.append(ResourceSet(
+                    dict(j.get("resources") or {"CPU": 1.0})).to_wire())
+        # pushed demand (elastic-train target width, external reporters),
+        # swept lazily on read
+        now_m = time.monotonic()
+        reported: List[dict] = []
+        for key in list(self.reported_demand):
+            ent = self.reported_demand[key]
+            if ent["expires"] < now_m:
+                del self.reported_demand[key]
+                continue
+            reported.extend(ent["shapes"])
         reply = {
             "pending_total": pending_total,
             "pending_resources": pending_resources,
             "pending_pg_bundles": pending_pg_bundles,
+            "pending_job_resources": pending_job_resources,
+            "pending_jobs_total": pending_jobs_total,
+            "reported_demand": reported,
             "nodes": nodes,
             "version": self._avail_version,
         }
@@ -1501,6 +1550,93 @@ class ControlStore:
 
     async def rpc_get_all_jobs(self, conn_id: int, payload) -> dict:
         return {"jobs": list(self.jobs.values())}
+
+    # ------------------------------------------------------------------
+    # submitted-job table (the job plane: ray_tpu.job_submission —
+    # reference: dashboard/modules/job JobInfoStorageClient, which keeps
+    # job records in the GCS so they survive component restarts)
+    # ------------------------------------------------------------------
+
+    _JOB_TERMINAL = ("SUCCEEDED", "FAILED", "STOPPED")
+
+    def _job_upsert(self, rec: dict) -> dict:
+        """Upsert one submitted-job record: terminal states never
+        transition (reference: JobStatus.is_terminal), every status change
+        lands in the WAL, the event stream, and the flight recorder."""
+        sid = rec.get("submission_id")
+        if not sid:
+            return {"ok": False, "error": "submission_id required"}
+        old = self.submitted_jobs.get(sid)
+        old_status = old.get("status") if old else None
+        new_status = rec.get("status")
+        if (old_status in self._JOB_TERMINAL
+                and new_status != old_status):
+            return {"ok": False, "error": f"job {sid} is terminal "
+                                          f"({old_status})", "terminal": True}
+        self.submitted_jobs[sid] = rec
+        self._persist("subjob", rec)
+        if new_status != old_status:
+            self._event("job", new_status or "UPDATED",
+                        rec.get("entrypoint", ""), submission_id=sid,
+                        tenant=rec.get("tenant", ""),
+                        detail=rec.get("message", ""))
+            flight_recorder.record(
+                "job", (new_status or "updated").lower(), sid=sid,
+                tenant=rec.get("tenant", ""))
+        return {"ok": True}
+
+    async def rpc_job_put(self, conn_id: int, payload: dict) -> dict:
+        return self._job_upsert(dict(payload["job"]))
+
+    async def rpc_job_update(self, conn_id: int, payload: dict) -> dict:
+        sid = payload.get("submission_id", "")
+        rec = self.submitted_jobs.get(sid)
+        if rec is None:
+            return {"ok": False, "error": f"no job {sid!r}"}
+        merged = {**rec, **(payload.get("fields") or {})}
+        return self._job_upsert(merged)
+
+    async def rpc_job_get(self, conn_id: int, payload: dict) -> dict:
+        return {"job": self.submitted_jobs.get(payload.get("submission_id", ""))}
+
+    async def rpc_job_list(self, conn_id: int, payload) -> dict:
+        """Paginated listing (newest first) with tenant/status filters —
+        the dashboard /api/jobs and CLI `job list` surface."""
+        payload = payload or {}
+        tenant = payload.get("tenant")
+        status = payload.get("status")
+        jobs = [
+            j for j in self.submitted_jobs.values()
+            if (tenant is None or j.get("tenant") == tenant)
+            and (status is None or j.get("status") == status)
+        ]
+        jobs.sort(key=lambda j: (-(j.get("submit_time") or 0.0),
+                                 j.get("submission_id", "")))
+        offset = max(0, int(payload.get("offset", 0)))
+        limit = max(1, min(1000, int(payload.get("limit", 100))))
+        return {"total": len(jobs), "offset": offset, "limit": limit,
+                "jobs": jobs[offset:offset + limit]}
+
+    async def rpc_report_demand(self, conn_id: int, payload: dict) -> dict:
+        """Pushed resource demand with expiry (reference: autoscaler sdk
+        request_resources) — the elastic-train controller posts its unmet
+        target width here; empty shapes withdraw the key immediately."""
+        key = payload.get("key", "")
+        if not key:
+            return {"ok": False, "error": "key required"}
+        shapes = payload.get("shapes") or []
+        if not shapes:
+            self.reported_demand.pop(key, None)
+            return {"ok": True}
+        ttl = float(payload.get("ttl_s")
+                    or GLOBAL_CONFIG.get("report_demand_ttl_s"))
+        self.reported_demand[key] = {
+            # reporters send human-unit floats; normalize to the wire
+            # (fixed-point) shape format the demand consumers bin-pack
+            "shapes": [ResourceSet(dict(s)).to_wire() for s in shapes],
+            "expires": time.monotonic() + ttl,
+        }
+        return {"ok": True}
 
     # ------------------------------------------------------------------
     # actor service (reference: gcs_actor_manager.h:94)

@@ -656,6 +656,10 @@ class NodeDaemon:
                   or GLOBAL_CONFIG.get("health_check_period_s"))
         jitter = GLOBAL_CONFIG.get("heartbeat_jitter")
         delta_sync = GLOBAL_CONFIG.get("node_table_delta_sync")
+        # demand-shape budget per beat: leases get the full cap, infeasible
+        # shapes a quarter (they only need to be sampled, not enumerated,
+        # for the autoscaler to see the node type that's missing)
+        shape_cap = GLOBAL_CONFIG.get("heartbeat_pending_shapes_max")
         while not self._stopped:
             try:
                 pending_leases = [
@@ -681,8 +685,9 @@ class NodeDaemon:
                     "pending": len(pending_leases) + len(self._infeasible_seen),
                     "pending_resources": [
                         p.spec_resources.to_wire()
-                        for p in pending_leases[:32]
-                    ] + [dict(k) for k in list(self._infeasible_seen)[:8]],
+                        for p in pending_leases[:shape_cap]
+                    ] + [dict(k) for k in
+                         list(self._infeasible_seen)[:max(1, shape_cap // 4)]],
                 }
                 if delta_sync:
                     # scale mode: present the availability cursor — the

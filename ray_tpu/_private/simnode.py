@@ -75,6 +75,9 @@ class SimNode:
         self.available = ResourceSet(self.total_resources.to_dict())
         self.labels = dict(labels or {})
         self.labels.setdefault("simnode", "true")
+        # scripted unmet lease demand (wire shapes) carried on heartbeats —
+        # the autoscaler-bench path for "leases queued on this daemon"
+        self.pending_shapes: List[dict] = []
         self.server: Optional[RpcServer] = None
         self.control: Optional[RpcClient] = None
         self.address = f"simnode-{self.node_id.hex()[:12]}:0"
@@ -321,13 +324,17 @@ class SimNode:
     async def heartbeat_once(self, delta_sync: Optional[bool] = None) -> dict:
         if delta_sync is None:
             delta_sync = GLOBAL_CONFIG.get("node_table_delta_sync")
+        shape_cap = GLOBAL_CONFIG.get("heartbeat_pending_shapes_max")
         payload = {
             "node_id": self.node_id.binary(),
             "available": self.available.to_wire(),
             "stats": {"cpu_percent": 0.0, "mem_percent": 0.0,
                       "store_bytes": 0},
-            "pending": 0,
-            "pending_resources": [],
+            "pending": len(self.pending_shapes),
+            # harness users script human-unit shapes; heartbeats carry the
+            # wire (fixed-point) format real daemons send
+            "pending_resources": [ResourceSet(dict(s)).to_wire()
+                                  for s in self.pending_shapes[:shape_cap]],
         }
         if delta_sync:
             payload["view_cursor"] = self._view_cursor

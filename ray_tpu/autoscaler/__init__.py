@@ -6,19 +6,32 @@ into node types), v2/instance_manager (provider reconciliation), and the
 fake_multi_node provider used as the test vehicle
 (python/ray/autoscaler/_private/fake_multi_node/node_provider.py).
 
-Shape: a driver-side reconciler polls the control store's cluster-load
-aggregate (pending lease demand from daemon heartbeats), bin-packs unmet
-demand into the provider's node type, launches up to max_workers nodes,
-and drains + terminates nodes idle past idle_timeout_s.
+Shape: a reconciler polls the control store's cluster-load aggregate,
+derives desired capacity from EVERY pending-demand source — unmet lease
+shapes from daemon heartbeats, unplaced placement-group bundles,
+queued-job resource requests from the job plane, and demand pushed via
+`report_demand` (elastic train posts its target width there) — bin-packs
+the remainder into the provider's node type, launches up to max_workers
+nodes, and drains + terminates nodes idle past idle_timeout_s (graceful
+drain first, never a kill). The `demand_driven` lever collapses the
+demand sources back to heartbeat shapes only — the liveness-reactive
+baseline the bench A/Bs against.
+
+Runs driver-side (through the core worker's control connection) or
+standalone against a `control_address` (its own RPC client on an owned
+event loop — the bench/daemon mode, no driver required).
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
 
 logger = logging.getLogger(__name__)
 
@@ -34,6 +47,13 @@ class NodeProvider:
 
     def terminate_node(self, handle: Any) -> None:
         raise NotImplementedError
+
+    def node_alive(self, handle: Any) -> bool:
+        """Whether the provider-side node behind `handle` still runs —
+        the reconciler prunes handles whose nodes died out-of-band. A
+        provider that can't tell returns True (the control store's death
+        records remain the arbiter)."""
+        return True
 
 
 class LocalNodeProvider(NodeProvider):
@@ -57,6 +77,9 @@ class LocalNodeProvider(NodeProvider):
         from ray_tpu._private import node as node_mod
 
         node_mod.kill_process(handle["proc"])
+
+    def node_alive(self, handle: Any) -> bool:
+        return handle["proc"].poll() is None
 
 
 @dataclass
@@ -116,14 +139,23 @@ class SliceNodeProvider(LocalNodeProvider):
 @dataclass
 class AutoscalingConfig:
     """Reference: autoscaler config (max_workers, idle timeout,
-    upscaling_speed)."""
+    upscaling_speed). Defaults come from the `autoscaler_*` config flags
+    so a cluster-wide override reaches every constructed autoscaler."""
 
     min_workers: int = 0
-    max_workers: int = 2
+    max_workers: int = field(
+        default_factory=lambda: GLOBAL_CONFIG.get("autoscaler_max_workers"))
     worker_resources: Dict[str, float] = field(
         default_factory=lambda: {"CPU": 2.0})
-    idle_timeout_s: float = 10.0
-    poll_period_s: float = 1.0
+    idle_timeout_s: float = field(
+        default_factory=lambda: GLOBAL_CONFIG.get("autoscaler_idle_timeout_s"))
+    poll_period_s: float = field(
+        default_factory=lambda: GLOBAL_CONFIG.get("autoscaler_poll_period_s"))
+    # demand-driven mode folds job-plane queue demand and pushed
+    # report_demand shapes into scale-up; False = liveness-reactive
+    # baseline (heartbeat lease shapes only) — the bench's A/B lever
+    demand_driven: bool = field(
+        default_factory=lambda: GLOBAL_CONFIG.get("autoscaler_demand_driven"))
     # slice-aware scale-up: pod type -> node-group shape; infeasible
     # TPU-{type}-head demand (pending slice placement groups) provisions
     # whole slices through SliceNodeProvider.create_slice
@@ -134,9 +166,16 @@ class AutoscalingConfig:
 class Autoscaler:
     """Reconciler loop (reference: v2/autoscaler.py:51 update())."""
 
-    def __init__(self, provider: NodeProvider, config: AutoscalingConfig):
+    def __init__(self, provider: NodeProvider, config: AutoscalingConfig,
+                 control_address: Optional[str] = None):
         self.provider = provider
         self.config = config
+        # standalone mode: own RPC client to this control address instead
+        # of riding a driver's core-worker connection
+        self.control_address = control_address
+        self._client = None
+        self._client_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._client_thread: Optional[threading.Thread] = None
         self.workers: List[dict] = []  # provider handles for launched nodes
         self.slices: List[dict] = []   # provider handles for launched slices
         self._idle_since: Dict[str, float] = {}
@@ -149,7 +188,65 @@ class Autoscaler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    # -- control-plane transport ----------------------------------------
+
+    def _ensure_client(self):
+        from ray_tpu.runtime.rpc import RpcClient
+
+        if self._client is not None:
+            return
+        self._client_loop = asyncio.new_event_loop()
+        self._client_thread = threading.Thread(
+            target=self._client_loop.run_forever,
+            name="autoscaler-rpc", daemon=True)
+        self._client_thread.start()
+
+        async def mk():
+            c = RpcClient(self.control_address, name="autoscaler->cs")
+            await c.connect()
+            return c
+
+        self._client = asyncio.run_coroutine_threadsafe(
+            mk(), self._client_loop).result(30)
+
+    def _control_call(self, method: str, payload: dict,
+                      timeout: float = 30.0) -> dict:
+        if self.control_address is not None:
+            self._ensure_client()
+            return asyncio.run_coroutine_threadsafe(
+                self._client.call(method, payload, timeout=timeout),
+                self._client_loop).result(timeout + 5)
+        from ray_tpu._private.core_worker import get_core_worker
+
+        cw = get_core_worker()
+        return cw.run_sync(cw.control.call(method, payload), timeout)
+
+    def _close_client(self):
+        if self._client is None:
+            return
+        client, loop = self._client, self._client_loop
+        self._client = None
+        try:
+            asyncio.run_coroutine_threadsafe(client.close(), loop).result(5)
+        except Exception:  # noqa: BLE001 — tearing down anyway
+            pass
+        loop.call_soon_threadsafe(loop.stop)
+        self._client_thread.join(timeout=5)
+
     # -- one reconciliation step (unit-testable) ------------------------
+
+    def _demand_shapes(self, load: dict) -> List[dict]:
+        """Every pending-demand wire shape scale-up should consider. The
+        liveness-reactive baseline sees only what daemons already hold
+        (heartbeat lease shapes); demand-driven mode adds demand that has
+        NOT reached a daemon yet — queued/pending job requests and pushed
+        report_demand entries (elastic-train target width) — so capacity
+        starts provisioning before the work lands."""
+        shapes = list(load.get("pending_resources", ()))
+        if self.config.demand_driven:
+            shapes += load.get("pending_job_resources", ())
+            shapes += load.get("reported_demand", ())
+        return shapes
 
     def _unmet_worker_need(self, load: dict) -> int:
         """Bin-pack pending lease shapes against existing free capacity plus
@@ -158,7 +255,7 @@ class Autoscaler:
         from ray_tpu._private.protocol import ResourceSet
 
         demand = [
-            ResourceSet.from_wire(w) for w in load["pending_resources"]
+            ResourceSet.from_wire(w) for w in self._demand_shapes(load)
         ]
         if not demand and load["pending_total"] > 0:
             # shapes got capped out of the heartbeat: assume one worker's
@@ -201,7 +298,7 @@ class Autoscaler:
         must not hold idle nodes alive forever."""
         from ray_tpu._private.protocol import ResourceSet
 
-        shapes = [ResourceSet.from_wire(w) for w in load["pending_resources"]]
+        shapes = [ResourceSet.from_wire(w) for w in self._demand_shapes(load)]
         bin_cap = ResourceSet(self.config.worker_resources)
         # DRAINING nodes count as capacity here: demand only they can host
         # must keep gating scale-down so the undrain path can rescue them —
@@ -238,7 +335,10 @@ class Autoscaler:
             if any(t in self.config.slice_types for t in head_types) and \
                     len(self.slices) < self.config.max_slices:
                 pg_hostable += 1
-        return (hostable + max(0, load["pending_total"] - len(shapes))
+        # the heartbeat tail is measured against the heartbeat shape list
+        # alone — job/report shapes ship uncapped, they have no tail
+        heartbeat_shapes = len(load.get("pending_resources", ()))
+        return (hostable + max(0, load["pending_total"] - heartbeat_shapes)
                 + pg_hostable)
 
     def _slice_need(self, load: dict) -> Dict[str, int]:
@@ -273,23 +373,20 @@ class Autoscaler:
                     need[t] = need.get(t, 0) + 1
         return need
 
-    def _report_event(self, cw, etype: str, message: str, **meta):
+    def _report_event(self, etype: str, message: str, **meta):
         """Push a structured autoscaler event into the cluster stream
         (reference: autoscaler events in the export pipeline)."""
         try:
-            cw.run_sync(cw.control.call("report_event", {
+            self._control_call("report_event", {
                 "source": "autoscaler", "type": etype,
                 "message": message, "meta": meta,
-            }), 10)
+            }, 10)
         except Exception:  # noqa: BLE001 — events must never break scaling
             pass
 
     def reconcile_once(self) -> Dict[str, int]:
-        from ray_tpu._private.core_worker import get_core_worker
-
-        cw = get_core_worker()
-        load = cw.run_sync(cw.control.call(
-            "get_cluster_load", {"cursor": self._load_cursor}), 30)
+        load = self._control_call(
+            "get_cluster_load", {"cursor": self._load_cursor}, 30)
         if load.get("delta"):
             for n in load["nodes"]:
                 self._load_rows[n["node_id"]] = n
@@ -308,11 +405,11 @@ class Autoscaler:
         alive_ids = {n["node_id"] for n in load["nodes"]}
         self.workers = [
             w for w in self.workers
-            if w["proc"].poll() is None or w["node_id"] in alive_ids
+            if self.provider.node_alive(w) or w["node_id"] in alive_ids
         ]
         self.slices = [
             sl for sl in self.slices
-            if any(n["proc"].poll() is None or n["node_id"] in alive_ids
+            if any(self.provider.node_alive(n) or n["node_id"] in alive_ids
                    for n in sl["nodes"])
         ]
 
@@ -340,8 +437,8 @@ class Autoscaler:
         undrained = 0
         for nid in to_undrain:
             try:
-                cw.run_sync(cw.control.call(
-                    "undrain_node", {"node_id": bytes.fromhex(nid)}), 10)
+                self._control_call(
+                    "undrain_node", {"node_id": bytes.fromhex(nid)}, 10)
             except Exception:  # noqa: BLE001 — retry next poll
                 continue
             self._draining.pop(nid, None)
@@ -367,21 +464,37 @@ class Autoscaler:
                     logger.info("autoscaler provisioned slice %s (%d hosts)",
                                 handle["slice_name"], len(handle["nodes"]))
                     self._report_event(
-                        cw, "SLICE_PROVISIONED", handle["slice_name"],
+                        "SLICE_PROVISIONED", handle["slice_name"],
                         pod_type=pod_type, hosts=len(handle["nodes"]))
 
         # scale up: only for demand existing+starting capacity can't absorb.
         # An undrain this pass returns capacity the load snapshot couldn't
         # see; re-evaluate next poll instead of double-provisioning.
         need = 0 if undrained else self._unmet_worker_need(load)
-        to_add = min(need, self.config.max_workers - len(self.workers))
-        for _ in range(max(0, to_add)):
-            handle = self.provider.create_node(self.config.worker_resources)
-            self.workers.append(handle)
-            launched += 1
-            logger.info("autoscaler launched node %s",
-                        handle["node_id"][:12])
-            self._report_event(cw, "NODE_LAUNCHED", handle["node_id"][:12])
+        # the min_workers floor is provisioned proactively, demand or not
+        need = max(need, self.config.min_workers - len(self.workers))
+        to_add = max(0, min(need, self.config.max_workers - len(self.workers)))
+        if to_add > 1 and hasattr(self.provider, "create_nodes"):
+            # storm path: a provider with a batched launch surface brings
+            # up the whole tranche concurrently instead of one blocking
+            # create per node (a 500-node scale-up storm in one pass)
+            handles = self.provider.create_nodes(
+                self.config.worker_resources, to_add)
+            self.workers.extend(handles)
+            launched += len(handles)
+            logger.info("autoscaler launched %d nodes (batched)",
+                        len(handles))
+            self._report_event("NODE_LAUNCHED", f"batch of {len(handles)}",
+                               count=len(handles))
+        else:
+            for _ in range(to_add):
+                handle = self.provider.create_node(
+                    self.config.worker_resources)
+                self.workers.append(handle)
+                launched += 1
+                logger.info("autoscaler launched node %s",
+                            handle["node_id"][:12])
+                self._report_event("NODE_LAUNCHED", handle["node_id"][:12])
 
         # scale down in two phases (reference: DrainRaylet then terminate):
         # idle past the timeout -> DRAIN (store stops routing to it);
@@ -401,11 +514,11 @@ class Autoscaler:
                             # planned removal: the death record must say so
                             # (expected termination — owners fail over, no
                             # lineage storm)
-                            cw.run_sync(cw.control.call(
+                            self._control_call(
                                 "unregister_node",
                                 {"node_id": bytes.fromhex(nid),
                                  "expected": True,
-                                 "reason": "autoscaler scale-in"}), 10)
+                                 "reason": "autoscaler scale-in"}, 10)
                         except Exception:  # noqa: BLE001 — dead already
                             pass
                         self.provider.terminate_node(w)
@@ -423,10 +536,10 @@ class Autoscaler:
                         # reversible idle-drain (no deadline): the daemon
                         # gates leases but keeps running so a later poll can
                         # undrain it if demand returns
-                        cw.run_sync(cw.control.call(
+                        self._control_call(
                             "drain_node",
                             {"node_id": bytes.fromhex(nid),
-                             "reason": "autoscaler"}), 10)
+                             "reason": "autoscaler"}, 10)
                         self._draining[nid] = now
                         logger.info("autoscaler draining idle node %s",
                                     nid[:12])
@@ -457,22 +570,16 @@ class Autoscaler:
         their deaths are recorded as EXPECTED (reference: the autoscaler
         drains before it terminates — teardown must not look like a mass
         node failure to any driver still attached)."""
-        from ray_tpu._private.core_worker import get_core_worker
-
-        try:
-            cw = get_core_worker()
-        except Exception:  # noqa: BLE001 — no driver attached; nothing to
-            return         # protect from a recovery storm
         for nid in node_ids:
             try:
-                cw.run_sync(cw.control.call(
+                self._control_call(
                     "drain_node",
                     {"node_id": bytes.fromhex(nid),
-                     "reason": "autoscaler"}), 5)
-                cw.run_sync(cw.control.call(
+                     "reason": "autoscaler"}, 5)
+                self._control_call(
                     "unregister_node",
                     {"node_id": bytes.fromhex(nid), "expected": True,
-                     "reason": "autoscaler cluster teardown"}), 5)
+                     "reason": "autoscaler cluster teardown"}, 5)
             except Exception:  # noqa: BLE001 — control store may be gone
                 pass
 
@@ -496,6 +603,7 @@ class Autoscaler:
                 except Exception:  # noqa: BLE001
                     pass
             self.slices.clear()
+        self._close_client()
 
 
 __all__ = [

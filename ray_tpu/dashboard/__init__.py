@@ -45,6 +45,7 @@ async function refresh() {
     ["nodes?limit=1000", "actors", "jobs", "task_summary"].map(
       p => fetch("/api/" + p).then(r => r.json())));
   nodes = nodes.nodes || nodes;
+  jobs = jobs.jobs || jobs;
   const esc = (s) => String(s).replace(/[&<>"']/g,
     ch => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
   const table = (rows) => {
@@ -117,6 +118,7 @@ class DashboardActor:
         app.router.add_get("/api/nodes", self._nodes)
         app.router.add_get("/api/actors", self._actors)
         app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/api/driver_jobs", self._driver_jobs)
         app.router.add_get("/api/tasks", self._tasks)
         app.router.add_get("/api/task_summary", self._task_summary)
         app.router.add_get("/api/placement_groups", self._pgs)
@@ -322,6 +324,45 @@ class DashboardActor:
         ])
 
     async def _jobs(self, request):
+        """Paginated SUBMITTED-job listing from the durable job table
+        (`?offset=&limit=&tenant=&status=`, default limit 100) — the job
+        plane's table, not the internal driver-job registry (that one
+        lives at /api/driver_jobs)."""
+        from aiohttp import web
+
+        try:
+            offset = max(0, int(request.query.get("offset", 0)))
+            limit = max(1, min(1000, int(request.query.get("limit", 100))))
+        except ValueError:
+            return web.json_response({"error": "bad offset/limit"},
+                                     status=400)
+        payload = {"offset": offset, "limit": limit}
+        if request.query.get("tenant"):
+            payload["tenant"] = request.query["tenant"]
+        if request.query.get("status"):
+            payload["status"] = request.query["status"]
+        reply = await self._control("job_list", payload)
+        return web.json_response({
+            "total": reply.get("total", 0),
+            "offset": offset,
+            "limit": limit,
+            "jobs": [
+                {
+                    "submission_id": j["submission_id"],
+                    "status": j.get("status", ""),
+                    "tenant": j.get("tenant", ""),
+                    "entrypoint": j.get("entrypoint", ""),
+                    "message": j.get("message", ""),
+                    "submit_time": j.get("submit_time"),
+                    "start_time": j.get("start_time"),
+                    "end_time": j.get("end_time"),
+                }
+                for j in reply.get("jobs", [])
+            ],
+        })
+
+    async def _driver_jobs(self, request):
+        """Internal driver-job registry (one row per attached driver)."""
         from aiohttp import web
 
         reply = await self._control("get_all_jobs")

@@ -1,5 +1,5 @@
 """Job submission: run driver scripts on the cluster with status/log
-tracking.
+tracking, multi-tenant quotas, and weighted fair-share admission.
 
 Reference surface: python/ray/dashboard/modules/job/ — JobSubmissionClient
 (sdk.py), JobManager (job_manager.py:57), JobSupervisor (job_supervisor.py:57
@@ -7,197 +7,42 @@ Reference surface: python/ray/dashboard/modules/job/ — JobSubmissionClient
 fate-shares), JobStatus lifecycle. Submission travels over the actor plane
 instead of REST; the CLI (`ray_tpu.scripts job ...`) wraps this client the
 way `ray job submit` wraps the REST SDK.
+
+Durability: the job table lives in the control store's persisted
+`submitted_jobs` table (WAL/snapshot, replayed by the HA standby), so
+status reads go straight to the store and records survive both manager
+restarts and a control-store kill+takeover. The manager actor holds only
+soft state (supervisor handles, the admission queue) and rebuilds it from
+the table on restart.
 """
 
 from __future__ import annotations
 
-import base64
 import io
 import os
 import time
 import uuid
 import zipfile
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import ray_tpu
-
-JOB_MANAGER_NAME = "job-manager"
-JOBS_NAMESPACE = "_jobs"
-
-# JobStatus (reference: job/common.py JobStatus)
-PENDING = "PENDING"
-RUNNING = "RUNNING"
-SUCCEEDED = "SUCCEEDED"
-FAILED = "FAILED"
-STOPPED = "STOPPED"
-
-
-@ray_tpu.remote
-class JobSupervisor:
-    """Runs one job's entrypoint as a child process (reference:
-    job_supervisor.py:57 — the supervisor actor fate-shares with the job)."""
-
-    def __init__(self, submission_id: str, entrypoint: str,
-                 env_vars: Dict[str, str],
-                 working_dir_zip: Optional[bytes] = None):
-        import subprocess
-        import tempfile
-
-        self.submission_id = submission_id
-        self.entrypoint = entrypoint
-        self._status = RUNNING
-        self._message = ""
-        workdir = None
-        if working_dir_zip:
-            workdir = tempfile.mkdtemp(prefix=f"job_{submission_id}_")
-            zipfile.ZipFile(io.BytesIO(working_dir_zip)).extractall(workdir)
-        self._log_path = os.path.join(
-            tempfile.gettempdir(), f"rt_job_{submission_id}.log")
-        env = dict(os.environ)
-        env.update(env_vars)
-        # the job's driver joins THIS cluster
-        env["RT_ADDRESS"] = os.environ.get("RT_CONTROL_ADDR", "")
-        log = open(self._log_path, "ab")
-        self._proc = subprocess.Popen(
-            entrypoint, shell=True, env=env, cwd=workdir,
-            stdout=log, stderr=subprocess.STDOUT, start_new_session=True,
-        )
-        log.close()
-
-    def poll(self) -> dict:
-        rc = self._proc.poll()
-        if self._status == RUNNING and rc is not None:
-            self._status = SUCCEEDED if rc == 0 else FAILED
-            self._message = f"exit code {rc}"
-        return {"status": self._status, "message": self._message}
-
-    def logs(self, offset: int = 0) -> str:
-        try:
-            with open(self._log_path, "rb") as f:
-                if offset:
-                    f.seek(offset)
-                return f.read().decode("utf-8", "replace")
-        except OSError:
-            return ""
-
-    def stop(self) -> bool:
-        self.poll()
-        if self._status in (SUCCEEDED, FAILED):
-            return False  # terminal states never transition (reference: JobStatus)
-        if self._proc.poll() is None:
-            import signal
-
-            try:
-                os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                pass
-            deadline = time.time() + 5
-            while time.time() < deadline and self._proc.poll() is None:
-                time.sleep(0.1)
-            if self._proc.poll() is None:
-                try:
-                    os.killpg(os.getpgid(self._proc.pid), signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    pass
-        self._status = STOPPED
-        return True
-
-
-@ray_tpu.remote
-class JobManager:
-    """Tracks all jobs (reference: job_manager.py:57)."""
-
-    def __init__(self):
-        self.jobs: Dict[str, dict] = {}
-
-    def submit(self, submission_id: str, entrypoint: str,
-               env_vars: Dict[str, str],
-               working_dir_zip: Optional[bytes],
-               metadata: Dict[str, str]) -> str:
-        if submission_id in self.jobs:
-            raise ValueError(f"job {submission_id!r} already exists")
-        supervisor = JobSupervisor.options(
-            name=f"job-supervisor:{submission_id}", namespace=JOBS_NAMESPACE,
-            lifetime="detached",
-        ).remote(submission_id, entrypoint, env_vars, working_dir_zip)
-        self.jobs[submission_id] = {
-            "submission_id": submission_id,
-            "entrypoint": entrypoint,
-            "metadata": metadata,
-            "start_time": time.time(),
-            "supervisor": supervisor,
-            "final": None,
-        }
-        return submission_id
-
-    def status(self, submission_id: str) -> dict:
-        job = self._get(submission_id)
-        if job["final"] is not None:
-            return job["final"]
-        try:
-            st = ray_tpu.get(job["supervisor"].poll.remote(), timeout=30)
-        except Exception as e:  # noqa: BLE001 — supervisor died = job failed
-            st = {"status": FAILED, "message": f"supervisor died: {e}"}
-        if st["status"] in (SUCCEEDED, FAILED, STOPPED):
-            job["final"] = st
-        return st
-
-    def logs(self, submission_id: str, offset: int = 0) -> str:
-        job = self._get(submission_id)
-        try:
-            return ray_tpu.get(
-                job["supervisor"].logs.remote(offset), timeout=30)
-        except Exception:  # noqa: BLE001
-            return ""
-
-    def stop(self, submission_id: str) -> bool:
-        job = self._get(submission_id)
-        current = self.status(submission_id)
-        if current["status"] in (SUCCEEDED, FAILED):
-            return False  # terminal states never transition
-        try:
-            ray_tpu.get(job["supervisor"].stop.remote(), timeout=30)
-        except Exception:  # noqa: BLE001
-            pass
-        job["final"] = {"status": STOPPED, "message": "stopped by user"}
-        return True
-
-    def list(self) -> List[dict]:
-        # poll every not-yet-final supervisor CONCURRENTLY: one dead
-        # supervisor must not serialize 30 s stalls across the listing
-        pending = {
-            sid: job["supervisor"].poll.remote()
-            for sid, job in self.jobs.items() if job["final"] is None
-        }
-        if pending:
-            ray_tpu.wait(list(pending.values()),
-                         num_returns=len(pending), timeout=10)
-        out = []
-        for sid, job in self.jobs.items():
-            if job["final"] is not None:
-                st = job["final"]
-            else:
-                try:
-                    st = ray_tpu.get(pending[sid], timeout=1)
-                except Exception as e:  # noqa: BLE001 — dead/unresponsive
-                    st = {"status": FAILED, "message": f"supervisor died: {e}"}
-                if st["status"] in (SUCCEEDED, FAILED, STOPPED):
-                    job["final"] = st
-            out.append({
-                "submission_id": sid,
-                "entrypoint": job["entrypoint"],
-                "status": st["status"],
-                "message": st.get("message", ""),
-                "start_time": job["start_time"],
-                "metadata": job["metadata"],
-            })
-        return out
-
-    def _get(self, submission_id: str) -> dict:
-        job = self.jobs.get(submission_id)
-        if job is None:
-            raise ValueError(f"no job {submission_id!r}")
-        return job
+from ray_tpu.job_submission._manager import (
+    JOB_MANAGER_NAME,
+    JOBS_NAMESPACE,
+    FairShareQueue,
+    JobManager,
+    job_cost,
+)
+from ray_tpu.job_submission._supervisor import (
+    FAILED,
+    PENDING,
+    QUEUED,
+    RUNNING,
+    STOPPED,
+    SUCCEEDED,
+    TERMINAL,
+    JobSupervisor,
+)
 
 
 def _zip_dir(path: str) -> bytes:
@@ -210,9 +55,19 @@ def _zip_dir(path: str) -> bytes:
     return buf.getvalue()
 
 
+def _store_call(method: str, payload: dict, timeout: float = 15.0) -> dict:
+    from ray_tpu._private.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    return cw.run_sync(cw.control.call(method, payload), timeout)
+
+
 class JobSubmissionClient:
     """Reference: python/ray/dashboard/modules/job/sdk.py
-    JobSubmissionClient — same surface, actor-plane transport."""
+    JobSubmissionClient — same surface, actor-plane transport. Status and
+    listing reads come straight from the durable store table (no manager
+    round-trip); logs/stop go through the manager, which owns the
+    supervisors."""
 
     def __init__(self, address: Optional[str] = None):
         if not ray_tpu.is_initialized():
@@ -239,27 +94,41 @@ class JobSubmissionClient:
     def submit_job(self, *, entrypoint: str,
                    runtime_env: Optional[dict] = None,
                    submission_id: Optional[str] = None,
-                   metadata: Optional[Dict[str, str]] = None) -> str:
+                   metadata: Optional[Dict[str, str]] = None,
+                   tenant: Optional[str] = None,
+                   resources: Optional[Dict[str, float]] = None,
+                   max_retries: int = 0) -> str:
+        """Submit an entrypoint. `tenant` keys quota/fair-share accounting;
+        `resources` is the job's cluster footprint (drives both admission
+        quotas and autoscaler demand); `max_retries` allows resubmission
+        after supervisor loss."""
         runtime_env = runtime_env or {}
         sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
         wd = runtime_env.get("working_dir")
         wd_zip = _zip_dir(wd) if wd else None
+        rec = {
+            "submission_id": sid,
+            "entrypoint": entrypoint,
+            "env_vars": dict(runtime_env.get("env_vars", {})),
+            "metadata": dict(metadata or {}),
+            "max_retries": int(max_retries),
+        }
+        if tenant is not None:
+            rec["tenant"] = tenant
+        if resources is not None:
+            rec["resources"] = dict(resources)
         return ray_tpu.get(
-            self._manager.submit.remote(
-                sid, entrypoint, dict(runtime_env.get("env_vars", {})),
-                wd_zip, dict(metadata or {}),
-            ),
-            timeout=120,
-        )
-
-    def get_job_status(self, submission_id: str) -> str:
-        return ray_tpu.get(
-            self._manager.status.remote(submission_id), timeout=60
-        )["status"]
+            self._manager.submit.remote(rec, wd_zip), timeout=120)
 
     def get_job_info(self, submission_id: str) -> dict:
-        return ray_tpu.get(
-            self._manager.status.remote(submission_id), timeout=60)
+        reply = _store_call("job_get", {"submission_id": submission_id})
+        rec = reply.get("job")
+        if rec is None:
+            raise ValueError(f"no job {submission_id!r}")
+        return rec
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id)["status"]
 
     def get_job_logs(self, submission_id: str, offset: int = 0) -> str:
         return ray_tpu.get(
@@ -269,8 +138,28 @@ class JobSubmissionClient:
         return ray_tpu.get(
             self._manager.stop.remote(submission_id), timeout=60)
 
-    def list_jobs(self) -> List[dict]:
-        return ray_tpu.get(self._manager.list.remote(), timeout=60)
+    def list_jobs(self, offset: int = 0, limit: int = 100,
+                  tenant: Optional[str] = None,
+                  status: Optional[str] = None) -> List[dict]:
+        payload = {"offset": offset, "limit": limit}
+        if tenant is not None:
+            payload["tenant"] = tenant
+        if status is not None:
+            payload["status"] = status
+        return _store_call("job_list", payload).get("jobs", [])
+
+    def set_tenant(self, tenant: str, weight: Optional[float] = None,
+                   max_running: Optional[int] = None,
+                   max_resources: Optional[Dict[str, float]] = None) -> dict:
+        """Configure a tenant's fair-share weight and quota caps."""
+        return ray_tpu.get(
+            self._manager.set_tenant.remote(
+                tenant, weight, max_running, max_resources),
+            timeout=60)
+
+    def fair_share_stats(self) -> dict:
+        return ray_tpu.get(
+            self._manager.fair_share_stats.remote(), timeout=60)
 
     def tail_job_logs(self, submission_id: str, poll_s: float = 1.0):
         """Generator of log increments until the job finishes. Each poll
@@ -282,9 +171,7 @@ class JobSubmissionClient:
             if chunk:
                 yield chunk
                 seen += len(chunk.encode("utf-8", "replace"))
-            if self.get_job_status(submission_id) in (
-                SUCCEEDED, FAILED, STOPPED,
-            ):
+            if self.get_job_status(submission_id) in TERMINAL:
                 chunk = self.get_job_logs(submission_id, offset=seen)
                 if chunk:
                     yield chunk
@@ -294,9 +181,15 @@ class JobSubmissionClient:
 
 __all__ = [
     "FAILED",
+    "FairShareQueue",
+    "JobManager",
     "JobSubmissionClient",
+    "JobSupervisor",
     "PENDING",
+    "QUEUED",
     "RUNNING",
     "STOPPED",
     "SUCCEEDED",
+    "TERMINAL",
+    "job_cost",
 ]

@@ -145,7 +145,11 @@ def cmd_job(args) -> int:
             if argv and argv[0] == "--":
                 argv = argv[1:]
             sid = client.submit_job(
-                entrypoint=shlex.join(argv), runtime_env=runtime_env)
+                entrypoint=shlex.join(argv), runtime_env=runtime_env,
+                tenant=args.tenant,
+                resources=json.loads(args.resources)
+                if args.resources else None,
+                max_retries=args.max_retries)
             print(f"submitted job {sid}")
             if not args.no_wait:
                 for chunk in client.tail_job_logs(sid):
@@ -162,9 +166,10 @@ def cmd_job(args) -> int:
             client.stop_job(args.id)
             print(f"stopped {args.id}")
         elif args.job_cmd == "list":
-            for j in client.list_jobs():
+            for j in client.list_jobs(offset=args.offset, limit=args.limit,
+                                      tenant=args.tenant):
                 print(f"{j['submission_id']}  {j['status']:10s} "
-                      f"{j['entrypoint']}")
+                      f"{j.get('tenant', ''):12s} {j['entrypoint']}")
     finally:
         ray_tpu.shutdown()
     return 0
@@ -313,11 +318,20 @@ def main(argv=None) -> int:
     js.add_argument("--working-dir", default="")
     js.add_argument("--env-vars", default="")
     js.add_argument("--no-wait", action="store_true")
+    js.add_argument("--tenant", default=None,
+                    help="tenant key for quota/fair-share accounting")
+    js.add_argument("--resources", default="",
+                    help='job resource request as JSON, e.g. \'{"CPU": 2}\'')
+    js.add_argument("--max-retries", type=int, default=0,
+                    help="resubmissions allowed after supervisor loss")
     js.add_argument("entrypoint", nargs=argparse.REMAINDER)
     for name in ("status", "logs", "stop"):
         j = jsub.add_parser(name)
         j.add_argument("id")
-    jsub.add_parser("list")
+    jl = jsub.add_parser("list")
+    jl.add_argument("--offset", type=int, default=0)
+    jl.add_argument("--limit", type=int, default=100)
+    jl.add_argument("--tenant", default=None)
     sp.set_defaults(fn=cmd_job)
 
     sp = sub.add_parser(

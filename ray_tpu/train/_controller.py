@@ -285,12 +285,36 @@ class TrainController:
         decision = self.scaling_policy.target_size(
             usable_cluster_resources(nodes, fresh_s),
             self.resources_per_worker)
+        self._report_train_demand(decision.num_workers)
         add = decision.num_workers - group.num_workers
         if add > 0:
             self._next_regrow = now + float(
                 GLOBAL_CONFIG.get("train_regrow_cooldown_s"))
             return ("grow", list(range(len(group.workers))), add)
         return None
+
+    def _report_train_demand(self, target_now: int):
+        """Elastic-train autoscaler hook: when the policy's max exceeds
+        what usable capacity can host, push the missing width into the
+        control store's demand aggregate (report_demand, TTL'd) so the
+        demand-driven autoscaler provisions toward the run's ceiling
+        instead of waiting for lease pileups. Empty shapes withdraw the
+        entry once capacity catches up."""
+        ceiling = int(getattr(self.scaling_policy, "max_workers", 0) or 0)
+        missing = max(0, ceiling - target_now)
+        try:
+            from ray_tpu._private.core_worker import get_core_worker
+
+            cw = get_core_worker()
+            ttl = 3.0 * float(
+                GLOBAL_CONFIG.get("train_node_watch_period_s"))
+            cw.run_sync(cw.control.call("report_demand", {
+                "key": f"elastic_train:{self.run_name}",
+                "shapes": [dict(self.resources_per_worker)] * missing,
+                "ttl_s": ttl,
+            }), 5)
+        except Exception:  # noqa: BLE001 — demand hints must never
+            pass           # perturb training
 
     def _try_live_resize(self, group: WorkerGroup, trigger) -> str:
         kind, keep, add = trigger

@@ -1,0 +1,71 @@
+"""Tier-1 smoke of the multi-tenant trial-fleet harness (bench_jobs.py):
+a small fleet (10 simnodes, 24 jobs, 3 tenants) runs both autoscaler
+modes end to end — storm up, drain the backlog, scale back down — with
+ZERO protocol errors. The committed full-size A/B (BENCH_JOBS_r16.json,
+520 simnodes, 600 jobs) asserts the actual wins; the slow-marked test
+below re-runs it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench_jobs.py"), *args],
+        text=True, capture_output=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(line) for line in proc.stdout.splitlines()
+            if line.startswith("{")]
+    return {(r["bench"], r["mode"]): r for r in rows}
+
+
+def test_bench_jobs_quick_smoke():
+    """Both modes at quick scale: every trial completes for every tenant,
+    the fleet drains back to the min_workers floor, fair-share error stays
+    bounded, and no simnode records a protocol error."""
+    by = _run(["--quick"], timeout=420)
+    for mode in ("demand", "reactive"):
+        fleet = by[("trial_fleet", mode)]
+        assert not fleet["timed_out"], fleet
+        assert fleet["protocol_errors"] == 0, fleet
+        # all 24 jobs finish: the flood tenant's 20 plus 2 per small team
+        assert sum(fleet["completed"].values()) == 24, fleet
+        assert min(fleet["completed"].values()) >= 2, fleet
+        # while all three tenants are backlogged, admission shares stay
+        # within one slot of equal
+        assert fleet["fair_share_err"] <= 1.0 / 3.0, fleet
+        samples = by[("nodes_over_time", mode)]["samples"]
+        assert samples and samples[-1]["queued"] == 0, samples[-3:]
+        drain = by[("scale_down_drain", mode)]
+        assert drain["converged"], drain
+        assert drain["final_nodes"] <= 1, drain
+        assert drain["protocol_errors"] == 0, drain
+    # the demand-driven plane sees the whole queued-job backlog at once;
+    # the reactive plane only ever sees what live heartbeats report, so
+    # its fleet must not out-peak the demand-driven one
+    assert (by[("trial_fleet", "reactive")]["peak_nodes"]
+            <= by[("trial_fleet", "demand")]["peak_nodes"])
+
+
+@pytest.mark.slow
+def test_bench_jobs_full_ab():
+    """The committed-artifact configuration: 520 simnodes, 600 trials,
+    demand-driven vs liveness-reactive. Demand mode must reach a strictly
+    higher peak fleet and start its first trial no later."""
+    by = _run(["--nodes", "520", "--jobs", "600"], timeout=1200)
+    demand = by[("trial_fleet", "demand")]
+    reactive = by[("trial_fleet", "reactive")]
+    for row in (demand, reactive):
+        assert not row["timed_out"], row
+        assert row["protocol_errors"] == 0, row
+        assert sum(row["completed"].values()) == 600, row
+    assert demand["peak_nodes"] > reactive["peak_nodes"]
+    assert demand["time_to_first_trial_s"] <= reactive["time_to_first_trial_s"]
+    for mode in ("demand", "reactive"):
+        assert by[("scale_down_drain", mode)]["converged"]

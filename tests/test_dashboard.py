@@ -53,8 +53,13 @@ def test_dashboard_endpoints(ray_init):
     actors = httpx.get(f"{url}/api/actors", timeout=30).json()
     assert any(x["name"] == "dash-actor" for x in actors)
 
+    # /api/jobs is the paginated submitted-job table (empty here — nothing
+    # submitted); the internal driver-job registry moved to /api/driver_jobs
     jobs = httpx.get(f"{url}/api/jobs", timeout=30).json()
-    assert len(jobs) >= 1
+    assert jobs["total"] == 0 and jobs["jobs"] == []
+    assert httpx.get(f"{url}/api/jobs?offset=x", timeout=30).status_code == 400
+    driver_jobs = httpx.get(f"{url}/api/driver_jobs", timeout=30).json()
+    assert len(driver_jobs) >= 1
 
     deadline = time.time() + 15
     while time.time() < deadline:
