@@ -1,0 +1,374 @@
+"""Spot-preemption survival A/B: proactive notice plane vs reactive drain.
+
+The experiment (r18 tentpole): a correlated reclaim wave hits 30% of a
+spot fleet. Two worlds, one lever (`AutoscalingConfig.preempt_proactive`):
+
+  reactive   the legacy path — a victim's watcher turns the notice into an
+             immediate terminal self-drain. The node stops serving AT the
+             notice, its capacity is gone for the whole reclaim window,
+             and the autoscaler only launches a replacement once the
+             workload's re-pended demand surfaces after the death.
+  proactive  the notice plane — victims publish a TTL'd
+             report_preemption_notice and sit in the reversible PREEMPTING
+             state, still serving committed work. The autoscaler treats
+             their committed load as demand NOW, launches replacements in
+             the same tranche machinery, and starts each victim's drain
+             only once its replacement has REGISTERED — overlapping
+             replacement boot with the reclaim window instead of
+             serializing them.
+
+Phase 1 — capacity wave (simnode-backed, both modes): a spot SimNode fleet
+plus the REAL autoscaler reconciler over FakeNodeProvider. A seeded wave
+preempts 30%; a monitor samples the store's node table and stamps:
+  first_loss_ts      first victim leaves serving capacity (DRAINING/DEAD)
+  replacement_ts     first autoscaler-launched node ALIVE at the store
+  restored_ts        ALIVE serving capacity back at the baseline width
+  downtime_s         max(0, restored_ts - first_loss_ts): the train
+                     downtime-per-wave proxy — how long an elastic gang
+                     would run below target width
+Gates: proactive must have the replacement registered BEFORE the first
+victim exits, strictly lower downtime than reactive, and ZERO simnode
+protocol errors in both modes.
+
+Phase 2 — serve goodput wave (real subprocess cluster, both modes, skipped
+with --quick): a 2-replica deployment spread across two spot hosts under
+open-loop traffic; the wave reclaims one replica's host via the runtime
+chaos_set fault. Counters (ok / failed / lost-object errors) bound the
+goodput dip and prove recovery — the r12 overload-harness discipline
+(counter-asserted, never eyeballed).
+
+Emits one JSON record per (phase, mode) on stdout; --out writes the
+collected artifact (BENCH_PREEMPT_rNN.json).
+
+Run: python bench_preempt.py [--quick] [--spots N] [--out BENCH_PREEMPT_r18.json]
+"""
+
+import argparse
+import asyncio
+import json
+import threading
+import time
+
+WAVE_FRAC = 0.3
+
+
+def _mode_config(mode: str) -> dict:
+    return {
+        "node_table_delta_sync": True,
+        "pubsub_flush_window_ms": 5.0,
+        "heartbeat_period_s": 0.25,
+        "preempt_proactive": mode == "proactive",
+        "preempt_republish_period_s": 0.2,
+        "preempt_notice_ttl_s": 30.0,
+    }
+
+
+async def run_capacity_wave(mode: str, *, spots: int, deadline_s: float,
+                            seed: int) -> dict:
+    """One wave against one fleet; returns the metrics record. In-process
+    control store + simnode fleet + the real autoscaler reconciler."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.control_store import ControlStore
+    from ray_tpu._private.simnode import SimNodePlane
+    from ray_tpu.autoscaler import Autoscaler, AutoscalingConfig
+    from ray_tpu.autoscaler.fake_provider import FakeNodeProvider
+
+    GLOBAL_CONFIG.reset()
+    GLOBAL_CONFIG.apply_system_config(_mode_config(mode))
+    bin_res = {"CPU": 4.0}
+
+    cs = ControlStore()
+    addr = await cs.start(port=0)
+    plane = SimNodePlane(addr, spots, seed=seed, resources=dict(bin_res),
+                         spot_fraction=1.0)
+    await plane.start()
+    await plane.await_converged(timeout=60)
+    baseline_ids = {n.node_id.hex() for n in plane.alive()}
+    baseline = len(baseline_ids)
+
+    # a fleet sized to its workload: every bin fully committed (the
+    # victims' committed load cannot migrate into survivor headroom, so a
+    # replacement NODE is the only way out — the scenario the notice
+    # plane exists for). Wait a beat so the store's availability view
+    # reflects it before the wave.
+    from ray_tpu._private.protocol import ResourceSet
+
+    for n in plane.alive():
+        n.available = ResourceSet({})
+    await asyncio.sleep(
+        2.5 * GLOBAL_CONFIG.get("heartbeat_period_s"))
+
+    provider = FakeNodeProvider(addr, seed=seed)
+    scaler = Autoscaler(provider, AutoscalingConfig(
+        min_workers=0, max_workers=spots * 2,
+        worker_resources=dict(bin_res),
+        idle_timeout_s=120.0, poll_period_s=0.2,
+        demand_driven=True,
+        preempt_proactive=(mode == "proactive"),
+    ), control_address=addr).start()
+
+    stamps = {"first_loss": None, "replacement": None, "restored": None}
+    stop = asyncio.Event()
+    requeued = {"done": False}
+
+    async def monitor():
+        """Sample the store's node table; stamp the capacity timeline. In
+        reactive mode, also play the workload's part: when a victim DIES,
+        its tasks re-pend on a survivor (the demand signal reactive mode
+        has to wait for)."""
+        while not stop.is_set():
+            rows = {n["node_id"].hex(): n["state"]
+                    for n in (await cs.rpc_get_all_nodes(0, {}))["nodes"]}
+            now = time.monotonic()
+            alive = {h for h, s in rows.items() if s == "ALIVE"}
+            lost = {h for h in baseline_ids
+                    if rows.get(h) in ("DRAINING", "DEAD")}
+            dead = {h for h in baseline_ids if rows.get(h) == "DEAD"}
+            if lost and stamps["first_loss"] is None:
+                stamps["first_loss"] = now
+            if (alive - baseline_ids) and stamps["replacement"] is None:
+                stamps["replacement"] = now
+            if (mode == "reactive" and dead and not requeued["done"]
+                    and plane.alive()):
+                requeued["done"] = True
+                survivor = plane.alive()[0]
+                survivor.pending_shapes = [dict(bin_res)] * len(
+                    baseline_ids - alive)
+            if (stamps["first_loss"] is not None
+                    and len(alive) >= baseline
+                    and stamps["restored"] is None):
+                stamps["restored"] = now
+                if requeued["done"] and plane.alive():
+                    plane.alive()[0].pending_shapes = []
+            await asyncio.sleep(0.03)
+
+    mon = asyncio.ensure_future(monitor())
+    t_wave0 = time.monotonic()
+    wave = await plane.preempt_wave(
+        WAVE_FRAC, window_s=0.2, deadline_s=deadline_s,
+        proactive=(mode == "proactive"), rng_seed=seed)
+
+    # ride out the tail: replacements must register and capacity restore
+    tail_deadline = time.monotonic() + 30.0
+    while time.monotonic() < tail_deadline and stamps["restored"] is None:
+        await asyncio.sleep(0.05)
+    stop.set()
+    await mon
+
+    first_exit = min((n.gone_ts for n in plane.nodes
+                      if n.index in set(wave["victims"])
+                      and n.gone_ts is not None), default=None)
+    errors = (plane.stats()["protocol_errors"]
+              + [e for h in provider.nodes.values()
+                 for e in h["sim"].protocol_errors])
+    rel = lambda ts: round(ts - t_wave0, 3) if ts is not None else None  # noqa: E731
+    downtime = (max(0.0, stamps["restored"] - stamps["first_loss"])
+                if stamps["restored"] and stamps["first_loss"] else None)
+    record = {
+        "bench": "preempt_capacity_wave", "mode": mode,
+        "spot_fleet": wave["spot_fleet"], "wave_frac": WAVE_FRAC,
+        "victims": len(wave["victims"]), "deadline_s": deadline_s,
+        "graceful_exits": wave["graceful"], "deadline_kills": wave["killed"],
+        "first_notice_s": rel(wave["first_notice"]),
+        "first_loss_s": rel(stamps["first_loss"]),
+        "replacement_registered_s": rel(stamps["replacement"]),
+        "capacity_restored_s": rel(stamps["restored"]),
+        "train_downtime_per_wave_s": round(downtime, 3)
+        if downtime is not None else None,
+        "replacement_before_first_exit": bool(
+            stamps["replacement"] is not None and first_exit is not None
+            and stamps["replacement"] < first_exit),
+        "preempt_stats": dict(scaler.preempt_stats),
+        "protocol_errors": len(errors), "errors_sample": errors[:3],
+        "unit": "s",
+    }
+
+    # stop() blocks on control RPCs; run it off-loop so the in-process
+    # store (served by THIS loop) can still answer them — calling it
+    # inline deadlocks the reconcile thread into its join timeout
+    await asyncio.to_thread(scaler.stop)
+    await asyncio.to_thread(provider.shutdown)
+    await plane.stop()
+    await cs.stop()
+    return record
+
+
+def run_serve_wave(mode: str, *, seed: int) -> dict:
+    """Phase 2: the serve goodput dip under a real-cluster wave."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.core_worker import get_core_worker
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.runtime.rpc import RpcClient
+
+    GLOBAL_CONFIG.reset()
+    cfg = _mode_config(mode)
+    cfg.update({
+        "testing_chaos_seed": seed,
+        "health_check_period_s": 0.25,
+        "health_check_timeout_s": 2.0,
+        "serve_replica_init_timeout_s": 10.0,
+    })
+    GLOBAL_CONFIG.apply_system_config(cfg)
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 4})
+    try:
+        spots = [cluster.add_node(resources={"CPU": 2, "spot": 1},
+                                  labels={"spot": "true"}),
+                 cluster.add_node(resources={"CPU": 2, "spot": 1},
+                                  labels={"spot": "true"})]
+        ray_tpu.init(address=cluster.address)
+        cw = get_core_worker()
+
+        @serve.deployment(num_replicas=2, name="PreemptEcho",
+                          ray_actor_options={"resources": {"spot": 1}})
+        class PreemptEcho:
+            def __call__(self, x):
+                return x * 2
+
+        handle = serve.run(PreemptEcho.bind())
+        assert handle.remote(1).result(timeout=60) == 2
+
+        counts = {"ok": 0, "failed": 0, "lost_objects": 0}
+        stop = threading.Event()
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                try:
+                    assert handle.options(
+                        timeout_s=5.0).remote(i).result(timeout=30) == i * 2
+                    counts["ok"] += 1
+                except Exception as e:  # noqa: BLE001 — classified below
+                    counts["failed"] += 1
+                    if "ObjectLost" in type(e).__name__:
+                        counts["lost_objects"] += 1
+                i += 1
+                time.sleep(0.05)
+
+        t = threading.Thread(target=traffic)
+        t.start()
+        dip_recovered = False
+        post_recovery_failures = None
+        try:
+            time.sleep(1.0)
+            pre_ok = counts["ok"]
+
+            actors = cw.run_sync(
+                cw.control.call("list_actors", {}), 30)["actors"]
+            replica_nodes = {a["node_id"].hex() for a in actors
+                             if (a.get("name") or "").startswith(
+                                 "serve:PreemptEcho:") and a["node_id"]}
+            victim = next((s for s in spots
+                           if s.node_id in replica_nodes), spots[0])
+
+            async def aim():
+                c = RpcClient(victim.address, name="bench-wave")
+                try:
+                    return await c.call("chaos_set", {"config": {
+                        "testing_preempt_wave": "1.0:100:8000",
+                        "testing_chaos_seed": seed}}, timeout=15)
+                finally:
+                    await c.close()
+
+            assert cw.run_sync(aim(), timeout=30)["ok"]
+            t_wave = time.monotonic()
+
+            # wait for the victim's death, then for goodput to resume
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                rows = cw.run_sync(
+                    cw.control.call("get_all_nodes", {}), 15)["nodes"]
+                st = next((n["state"] for n in rows
+                           if n["node_id"].hex() == victim.node_id), None)
+                if st == "DEAD":
+                    break
+                time.sleep(0.25)
+            target = counts["ok"] + 20
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and counts["ok"] < target:
+                time.sleep(0.2)
+            dip_recovered = counts["ok"] >= target
+            failed_at_recovery = counts["failed"]
+            time.sleep(3.0)
+            post_recovery_failures = counts["failed"] - failed_at_recovery
+            wave_s = round(time.monotonic() - t_wave, 3)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+
+        total = counts["ok"] + counts["failed"]
+        return {
+            "bench": "preempt_serve_wave", "mode": mode,
+            "pre_wave_ok": pre_ok, "ok": counts["ok"],
+            "failed": counts["failed"],
+            "lost_objects": counts["lost_objects"],
+            "dip_recovered": dip_recovered,
+            "post_recovery_failures": post_recovery_failures,
+            "dip_bounded": bool(
+                dip_recovered and post_recovery_failures is not None
+                and post_recovery_failures <= 5
+                and counts["failed"] <= max(10, total * 0.5)),
+            "wave_to_recovery_s": wave_s,
+            "unit": "req",
+        }
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        cluster.shutdown()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small fleet, short deadlines, skip the serve leg")
+    ap.add_argument("--spots", type=int, default=None,
+                    help="spot fleet size (default 10, or 6 with --quick)")
+    ap.add_argument("--seed", type=int, default=18)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    spots = args.spots or (6 if args.quick else 10)
+    deadline_s = 2.5 if args.quick else 6.0
+    results = []
+    for mode in ("reactive", "proactive"):
+        rec = asyncio.run(run_capacity_wave(
+            mode, spots=spots, deadline_s=deadline_s, seed=args.seed))
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    if not args.quick:
+        for mode in ("reactive", "proactive"):
+            rec = run_serve_wave(mode, seed=args.seed)
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+
+    by_mode = {r["mode"]: r for r in results
+               if r["bench"] == "preempt_capacity_wave"}
+    summary = {
+        "bench": "preempt_summary",
+        "wave_frac": WAVE_FRAC,
+        "proactive_replacement_before_first_exit":
+            by_mode["proactive"]["replacement_before_first_exit"],
+        "train_downtime_per_wave_s": {
+            m: by_mode[m]["train_downtime_per_wave_s"] for m in by_mode},
+        "proactive_strictly_lower_downtime": bool(
+            by_mode["proactive"]["train_downtime_per_wave_s"] is not None
+            and by_mode["reactive"]["train_downtime_per_wave_s"] is not None
+            and by_mode["proactive"]["train_downtime_per_wave_s"]
+            < by_mode["reactive"]["train_downtime_per_wave_s"]),
+        "protocol_errors": {
+            m: by_mode[m]["protocol_errors"] for m in by_mode},
+    }
+    results.append(summary)
+    print(json.dumps(summary), flush=True)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

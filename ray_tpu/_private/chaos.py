@@ -190,6 +190,32 @@ class _PreemptSpec:
         return None
 
 
+class _PreemptWaveSpec:
+    """frac:window_ms:deadline_ms — a correlated spot-reclaim wave: each
+    SPOT node draws (seeded, per-role) whether it is in the wave with
+    probability `frac`, and victims receive their notice at a deterministic
+    offset inside one `window_ms` burst, each with `deadline_ms` until hard
+    death. No cross-node coordination needed: the per-role PRNG makes the
+    fleet-wide draw reproducible from one integer seed."""
+
+    def __init__(self, spec: str):
+        frac, window_ms, deadline_ms = spec.strip().split(":")
+        self.frac = float(frac)
+        self.window_s = float(window_ms) / 1e3
+        self.deadline_s = float(deadline_ms) / 1e3
+        if not 0.0 <= self.frac <= 1.0:
+            raise ValueError(f"wave fraction {self.frac} outside [0, 1]")
+
+    def notice_for(self, rng: random.Random) -> Optional[Tuple[float, float]]:
+        """(offset_s, deadline_s) when this node is in the wave, else None.
+        Two draws in a fixed order keep the schedule seed-stable."""
+        hit = rng.random() < self.frac
+        offset = rng.uniform(0.0, self.window_s)
+        if not hit:
+            return None
+        return (offset, self.deadline_s)
+
+
 class ChaosController:
     """Per-process chaos state: seeded PRNG, parsed spec caches (keyed by
     the live config string so runtime `chaos_set` updates take effect), and
@@ -343,6 +369,23 @@ def preempt_notice() -> Optional[Tuple[float, float]]:
         notice = spec.notice_for(_controller._role)
     if notice:
         _controller._record("preempt_notice", _controller._role, notice)
+    return notice
+
+
+def preempt_wave(is_spot: bool) -> Optional[Tuple[float, float]]:
+    """Correlated-wave membership for THIS process: returns (offset_s,
+    drain_deadline_s) when `testing_preempt_wave` is set, the node carries
+    the spot marker, and the seeded per-role draw lands inside the wave
+    fraction — else None. Only SPOT capacity is reclaimed: the fault models
+    a provider clawing back its preemptible pool, not an outage."""
+    spec = _controller._spec("testing_preempt_wave", _PreemptWaveSpec)
+    if spec is None or not is_spot:
+        return None
+    r = _controller.rng()
+    with _controller._lock:
+        notice = spec.notice_for(r)
+    if notice:
+        _controller._record("preempt_wave", _controller._role, notice)
     return notice
 
 

@@ -248,6 +248,10 @@ _flag("drain_deadline_s", 30.0, "Default drain deadline: how long a draining nod
 _flag("drain_replicate_max_objects", 4096, "Max primary object copies a draining node proactively replicates to live peers before exiting (objects beyond the cap fall back to lineage reconstruction).")
 _flag("preemption_watcher_enabled", False, "Run the GCE maintenance-event/preemption watcher on each node daemon; a notice triggers an automatic drain with reason=preemption (reference: spot TPU-VM preemption gives 30-90s of warning).")
 _flag("preemption_poll_period_s", 1.0, "Preemption watcher metadata-server poll period.")
+_flag("preempt_proactive", True, "Proactive preemption survival (the bench_preempt A/B lever): a preemption notice puts the node in PREEMPTING (still scheduling) instead of draining immediately; the autoscaler treats its committed load as demand NOW, pre-provisions replacement capacity in the same tranche machinery, and only starts the reversible drain once replacements register or the deadline forces it — overlapping node boot with the drain window. Off = legacy reactive mode: notice -> immediate self-drain, replacement launches only after the death.")
+_flag("preempt_notice_ttl_s", 60.0, "Expiry on a published preemption notice: a PREEMPTING node whose notice ages out without a drain or death (reclaim cancelled, publisher gone) returns to ALIVE and stops counting as proactive demand. Publishers refresh on preempt_republish_period_s, so a live notice never ages out.")
+_flag("preempt_republish_period_s", 5.0, "Node-daemon cadence for refreshing its published preemption notice until the drain starts. Re-publishing (idempotent) keeps the TTL fresh AND survives a control-store failover mid-notice — the new primary rebuilds the notice even if the WAL record raced the takeover.")
+_flag("preempt_drain_grace_frac", 0.5, "Fraction of the notice deadline a PREEMPTING daemon waits for the control plane to start the drain (replacement capacity registered) before forcing the self-drain anyway — the local failsafe that bounds how much of the warning window proactive provisioning may consume.")
 
 # --- elastic training (train/_controller.py, train/_elastic.py) ---
 _flag("train_max_drain_rejoins", 16, "Bound on planned-removal rejoins/resizes per training run: drain-triggered recoveries never charge the failure budget, so a pathological drain loop is bounded separately by this.")
@@ -265,6 +269,7 @@ _flag("testing_rpc_stall", "", "Server-side RESPONSE stalls: 'method:ms:count,..
 _flag("testing_rpc_partition", "", "One-way RPC-layer partition: 'src>dst#count,...' — a client in a process whose chaos role matches src cannot reach peers whose address matches dst; heals after count blocked sends (omit for unbounded).")
 _flag("testing_process_kill", "", "Process-kill fault: 'role:method:nth,...' — the nth dispatch of method in a process whose chaos role matches exits hard (os._exit 137).")
 _flag("testing_preempt_notice", "", "Seeded preemption-notice fault: 'role:delay_ms:deadline_ms,...' — a node daemon whose chaos role matches receives a synthetic preemption notice delay_ms after startup and drains itself with the given deadline (models a GCE maintenance event / spot reclaim, deterministically).")
+_flag("testing_preempt_wave", "", "Correlated spot-reclaim wave fault: 'frac:window_ms:deadline_ms' — a seeded draw preempts frac of the SPOT fleet (labels.spot=true), each victim receiving its notice at a deterministic offset inside one window_ms burst with deadline_ms until hard death. Models the real-world correlated reclaim that single-notice faults cannot: an elastic gang shrinking below min_workers or a serve deployment losing every replica at once.")
 
 # --- TPU ---
 _flag("tpu_chips_per_host", 0, "Override detected TPU chips per host (0 = autodetect).")

@@ -309,6 +309,14 @@ class ControlStore:
         # reporters): key -> {"shapes": [wire], "expires": monotonic}.
         # Ephemeral by design — reporters refresh on their own cadence.
         self.reported_demand: Dict[str, dict] = {}
+        # TTL'd preemption notices (the spot-survival plane): node_id ->
+        # {"expires_ts": wall, "deadline_ts": wall}. PERSISTED (own WAL op
+        # + snapshot field) unlike reported_demand: the PREEMPTING state and
+        # its deadline must survive an HA failover — the new primary keeps
+        # pre-provisioning replacement capacity for a node that is still
+        # about to die. Expiry (reclaim cancelled, publisher gone) reverts
+        # the node to ALIVE; publishers refresh on preempt_republish_period_s.
+        self.preempt_notices: Dict[bytes, dict] = {}
         self.actors: Dict[bytes, ActorRecord] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (namespace, name) -> actor_id
         self.placement_groups: Dict[bytes, PlacementGroupRecord] = {}
@@ -453,6 +461,12 @@ class ControlStore:
                 for addr, rec in self.dead_worker_addresses.items()
             ],
             "worker_version": self._worker_version,
+            # wall-clock expiry/deadline stamps, so a failed-over store's
+            # TTL sweep resumes where the old primary's left off
+            "preempt_notices": [
+                {"node_id": nid, **ent}
+                for nid, ent in self.preempt_notices.items()
+            ],
         }
 
     def _reset_tables(self):
@@ -468,6 +482,7 @@ class ControlStore:
         self.dead_worker_addresses.clear()
         self._node_deltas.clear()
         self._worker_deltas.clear()
+        self.preempt_notices.clear()
 
     def _apply_snapshot(self, snap: dict):
         for nw in snap.get("nodes", []):
@@ -494,6 +509,11 @@ class ControlStore:
                 self.dead_worker_addresses[addr] = dw
         self._worker_version = max(self._worker_version,
                                    int(snap.get("worker_version", 0) or 0))
+        for ent in snap.get("preempt_notices", []):
+            ent = dict(ent)
+            nid = ent.pop("node_id", b"")
+            if nid:
+                self.preempt_notices[nid] = ent
 
     def _apply_wal_record(self, rec: dict):
         op, d = rec["op"], rec["d"]
@@ -540,6 +560,13 @@ class ControlStore:
             # dead-node retention tombstone: the record was pruned while
             # this WAL segment was live — don't resurrect it
             self.nodes.pop(d["node_id"], None)
+        elif op == "preempt":
+            d = dict(d)
+            nid = d.pop("node_id", b"")
+            if nid:
+                self.preempt_notices[nid] = d
+        elif op == "preempt_del":
+            self.preempt_notices.pop(d["node_id"], None)
         elif op == "worker_dead":
             d = dict(d)
             addr = d.pop("address", "")
@@ -595,9 +622,11 @@ class ControlStore:
         previous incumbent died."""
         now = time.monotonic()
         for nid, info in self.nodes.items():
-            if info.state == pb.NODE_ALIVE:
+            if info.state in (pb.NODE_ALIVE, pb.NODE_PREEMPTING):
                 # grace period: the daemon re-heartbeats (and re-registers on
-                # the "unknown" reply) or the health loop declares it dead
+                # the "unknown" reply) or the health loop declares it dead.
+                # PREEMPTING nodes are still live (their drain hasn't
+                # started) — without the grace they would linger unwatched.
                 self.node_last_beat[nid] = now
                 self.node_available[nid] = info.resources
                 self._bump_avail(nid)
@@ -761,6 +790,7 @@ class ControlStore:
             nshards = max(1, min(8, (len(self.node_last_beat) + 127) // 128))
             await asyncio.sleep(period / nshards)
             shard = (shard + 1) % nshards
+            self._sweep_preempt_notices()
             now = time.monotonic()
             for node_id, last in list(self.node_last_beat.items()):
                 if nshards > 1 and node_id and node_id[0] % nshards != shard:
@@ -770,6 +800,32 @@ class ControlStore:
                     continue
                 if now - last > timeout:
                     await self._mark_node_dead(node_id, "health check timed out")
+
+    def _sweep_preempt_notices(self) -> None:
+        """Expire aged-out preemption notices: a PREEMPTING node whose
+        notice TTL lapsed without a drain or death (the reclaim was
+        cancelled, or the publisher died silently) returns to ALIVE and
+        stops counting as proactive demand. Live publishers refresh on
+        preempt_republish_period_s, so only an abandoned notice ages out."""
+        now = time.time()
+        for nid in [n for n, ent in self.preempt_notices.items()
+                    if ent["expires_ts"] < now]:
+            self.preempt_notices.pop(nid, None)
+            self._persist("preempt_del", {"node_id": nid})
+            info = self.nodes.get(nid)
+            if info is None or info.state != pb.NODE_PREEMPTING:
+                continue  # drain/death already superseded the notice
+            flight_recorder.record("node", "preempt_expired",
+                                   node=info.node_id.hex()[:12])
+            info.state = pb.NODE_ALIVE
+            info.drain_reason = ""
+            info.drain_deadline = 0.0
+            self._event("node", "ALIVE", "preemption notice expired",
+                        node_id=info.node_id.hex())
+            self._bump_avail(nid)
+            wire = self._record_node_delta(info)
+            self._persist("node", wire)
+            self.pubsub.publish("nodes", wire)
 
     async def _mark_node_dead(self, node_id: bytes, reason: str,
                               expected: bool = False):
@@ -787,6 +843,8 @@ class ControlStore:
         self.node_available.pop(node_id, None)
         self.node_load.pop(node_id, None)
         self.node_stats.pop(node_id, None)  # never serve a dead node's stats
+        if self.preempt_notices.pop(node_id, None) is not None:
+            self._persist("preempt_del", {"node_id": node_id})
         client = self._daemon_clients.pop(node_id, None)
         if client:
             await client.close()
@@ -961,13 +1019,15 @@ class ControlStore:
                     nid.hex() for nid in changed
                     if (self.nodes.get(nid) is None
                         or self.nodes[nid].state not in (pb.NODE_ALIVE,
-                                                         pb.NODE_DRAINING))
+                                                         pb.NODE_DRAINING,
+                                                         pb.NODE_PREEMPTING))
                 ]
         nodes = []
         pending_total = 0
         pending_resources: List[dict] = []
         for nid, info in self.nodes.items():
-            if info.state not in (pb.NODE_ALIVE, pb.NODE_DRAINING):
+            if info.state not in (pb.NODE_ALIVE, pb.NODE_DRAINING,
+                                  pb.NODE_PREEMPTING):
                 continue
             load = self.node_load.get(nid, {})
             avail = self.node_available.get(nid)
@@ -1026,6 +1086,25 @@ class ControlStore:
                 del self.reported_demand[key]
                 continue
             reported.extend(ent["shapes"])
+        # PREEMPTING nodes' COMMITTED load (total - available: running
+        # leases, actor/PG reservations, serve replicas, elastic ranks) is
+        # demand the proactive reconciler must re-home NOW — the node dies
+        # at its deadline whether or not a replacement exists (always in
+        # the reply; the A/B lever lives in the autoscaler, not here)
+        preempting: List[dict] = []
+        for nid, ent in self.preempt_notices.items():
+            info = self.nodes.get(nid)
+            if info is None or info.state != pb.NODE_PREEMPTING:
+                continue
+            avail = self.node_available.get(nid)
+            committed = (info.resources - avail) if avail is not None \
+                else info.resources
+            preempting.append({
+                "node_id": info.node_id.hex(),
+                "deadline_ts": ent.get("deadline_ts", 0.0),
+                "committed": committed.to_wire(),
+                "total": info.resources.to_wire(),
+            })
         reply = {
             "pending_total": pending_total,
             "pending_resources": pending_resources,
@@ -1033,6 +1112,7 @@ class ControlStore:
             "pending_job_resources": pending_job_resources,
             "pending_jobs_total": pending_jobs_total,
             "reported_demand": reported,
+            "preempting": preempting,
             "nodes": nodes,
             "version": self._avail_version,
         }
@@ -1107,6 +1187,57 @@ class ControlStore:
             nid.hex(): stats for nid, stats in self.node_stats.items()
         }}
 
+    async def rpc_report_preemption_notice(self, conn_id: int,
+                                           payload: dict) -> dict:
+        """A node learned it is about to be reclaimed (GCE maintenance
+        event / spot preemption): record a TTL'd notice and move the node
+        to PREEMPTING — visible on the "nodes" channel, in get_nodes_delta,
+        and as committed-load demand in get_cluster_load, so the proactive
+        reconciler pre-provisions replacement capacity BEFORE the drain
+        consumes the warning window. Idempotent: re-publication (the
+        daemon's refresh cadence, or a re-publish after a store failover)
+        refreshes the TTL without minting a new delta. The state is
+        persisted + delta-versioned like every node mutation, so it
+        survives an HA failover."""
+        node_id = payload["node_id"]
+        info = self.nodes.get(node_id)
+        if info is None or info.state == pb.NODE_DEAD:
+            return {"ok": False, "error": "unknown or dead node"}
+        if info.state == pb.NODE_DRAINING:
+            # the drain already started (reconciler or deadline got there
+            # first): the notice is moot, don't regress the state machine
+            return {"ok": True, "state": info.state}
+        deadline_s = float(payload.get("deadline_s")
+                           or GLOBAL_CONFIG.get("drain_deadline_s"))
+        ttl = float(payload.get("ttl_s")
+                    or GLOBAL_CONFIG.get("preempt_notice_ttl_s"))
+        now = time.time()
+        prior = self.preempt_notices.get(node_id)
+        ent = {
+            # a refresh never EXTENDS the death deadline: the host dies at
+            # the first notice's wall-clock time regardless of re-publishes
+            "deadline_ts": min(prior["deadline_ts"], now + deadline_s)
+            if prior else now + deadline_s,
+            "expires_ts": now + ttl,
+        }
+        self.preempt_notices[node_id] = ent
+        self._persist("preempt", {"node_id": node_id, **ent})
+        if info.state != pb.NODE_PREEMPTING:
+            flight_recorder.record(
+                "node", "preempting", node=info.node_id.hex()[:12],
+                deadline_s=deadline_s)
+            info.state = pb.NODE_PREEMPTING
+            info.drain_reason = pb.DRAIN_REASON_PREEMPTION
+            info.drain_deadline = ent["deadline_ts"]
+            self._event("node", "PREEMPTING", "preemption notice",
+                        node_id=info.node_id.hex(), deadline_s=deadline_s)
+            self._bump_avail(node_id)  # leaves new-placement views
+            wire = self._record_node_delta(info)
+            self._persist("node", wire)
+            self.pubsub.publish("nodes", wire)
+        return {"ok": True, "state": info.state,
+                "deadline_ts": ent["deadline_ts"]}
+
     async def rpc_drain_node(self, conn_id: int, payload: dict) -> dict:
         """DrainNode: planned removal with `{reason, deadline_s}` (reference:
         node_manager.proto DrainNode + autoscaler.proto DrainNodeReason).
@@ -1123,6 +1254,10 @@ class ControlStore:
         deadline_s = float(payload.get("deadline_s") or 0.0)
         flight_recorder.record("node", "drain", node=info.node_id.hex()[:12],
                                reason=reason, deadline_s=deadline_s)
+        if self.preempt_notices.pop(node_id, None) is not None:
+            # the drain supersedes the PREEMPTING phase; drop the notice so
+            # its TTL expiry can't revive a node mid-exit-orchestration
+            self._persist("preempt_del", {"node_id": node_id})
         info.state = pb.NODE_DRAINING
         info.drain_reason = reason
         info.drain_deadline = time.time() + deadline_s if deadline_s else 0.0

@@ -223,6 +223,9 @@ class NodeDaemon:
         # the process exits cleanly once the drain completes
         self._drain_task: Optional[asyncio.Task] = None
         self._exit_cb = None
+        # preemption watcher (real metadata polling or the chaos stand-in);
+        # kept for introspection/stop and so tests can assert publish counts
+        self._preempt_watcher = None
         # subscriber-side pubsub gap detection: last publish seq seen on the
         # "nodes" channel (control_store stamps every notice with _seq)
         self._nodes_seq: Optional[int] = None
@@ -333,11 +336,17 @@ class NodeDaemon:
         if notice is not None:
             delay_s, deadline_s = notice
             self._tasks.append(spawn(self._chaos_preempt(delay_s, deadline_s)))
+        # correlated spot-reclaim wave (testing_preempt_wave): a seeded draw
+        # preempts a fraction of the SPOT fleet inside one window — only
+        # nodes advertising spot/preemptible capacity are eligible victims
+        wave = chaos.preempt_wave(
+            self.labels.get("spot") == "true"
+            or self.labels.get("preemptible") == "true")
+        if wave is not None:
+            offset_s, deadline_s = wave
+            self._tasks.append(spawn(self._chaos_preempt(offset_s, deadline_s)))
         if GLOBAL_CONFIG.get("preemption_watcher_enabled"):
-            from ray_tpu.tpu.preemption import PreemptionWatcher
-
-            self._preempt_watcher = PreemptionWatcher(
-                on_notice=self._self_drain)
+            self._preempt_watcher = self._make_preempt_watcher()
             self._tasks.append(spawn(self._preempt_watcher.run()))
         logger.info(
             "daemon %s up at %s store=%s resources=%s",
@@ -347,6 +356,8 @@ class NodeDaemon:
 
     async def stop(self):
         self._stopped = True
+        if self._preempt_watcher is not None:
+            self._preempt_watcher.stop()
         for t in self._tasks:
             t.cancel()
         for w in list(self.workers.values()):
@@ -2293,9 +2304,22 @@ class NodeDaemon:
     async def rpc_chaos_set(self, conn_id: int, payload: dict) -> dict:
         """Apply chaos/testing config flags to THIS daemon process at
         runtime (e.g. partition it from one peer address)."""
-        GLOBAL_CONFIG.apply_system_config(payload.get("config", {}))
+        cfg = payload.get("config", {})
+        GLOBAL_CONFIG.apply_system_config(cfg)
         chaos.reset()
-        return {"ok": True, "role": chaos.role()}
+        # a wave spec landing at runtime re-runs the seeded draw NOW, so a
+        # test can aim a correlated reclaim at a fleet that is already
+        # mid-workload (the start()-time draw only covers daemons born
+        # after the spec was set)
+        if cfg.get("testing_preempt_wave"):
+            wave = chaos.preempt_wave(
+                self.labels.get("spot") == "true"
+                or self.labels.get("preemptible") == "true")
+            if wave is not None:
+                offset_s, deadline_s = wave
+                self._tasks.append(
+                    spawn(self._chaos_preempt(offset_s, deadline_s)))
+        return {"ok": True, "role": chaos.role(), "pid": os.getpid()}
 
     async def rpc_chaos_kill(self, conn_id: int, payload: dict) -> dict:
         """Kill a chosen worker process (by id, or any one leased/idle
@@ -2412,14 +2436,47 @@ class NodeDaemon:
     # primary copies, then die an EXPECTED death)
     # ------------------------------------------------------------------
 
+    def _make_preempt_watcher(self, deadline_s: Optional[float] = None,
+                              transport=None):
+        """One construction site for real and synthetic preemption notices
+        so both take the identical proactive path: publish the TTL'd
+        notice, keep re-publishing (failover-proof), self-drain only when
+        the control plane misses the grace window."""
+        from ray_tpu.tpu.preemption import PreemptionWatcher
+
+        return PreemptionWatcher(
+            on_notice=self._self_drain,
+            transport=transport,
+            drain_deadline_s=deadline_s,
+            publish=self._publish_preempt_notice,
+            drain_started=lambda: self._draining or self._drain_task is not None,
+        )
+
+    async def _publish_preempt_notice(self, deadline_s: float) -> None:
+        """File/refresh this node's TTL'd preemption notice at the control
+        store (PREEMPTING state; the autoscaler treats our committed load
+        as demand NOW). Raises on failure so the watcher retries."""
+        reply = await self.control.call(
+            "report_preemption_notice",
+            {"node_id": self.node_id.binary(), "deadline_s": deadline_s},
+            timeout=5,
+        )
+        if not reply.get("ok"):
+            raise RuntimeError(f"report_preemption_notice refused: {reply}")
+
     async def _chaos_preempt(self, delay_s: float, deadline_s: float):
-        """Seeded `testing_preempt_notice` fault: a deterministic stand-in
-        for the GCE maintenance event — the notice lands mid-workload and
-        must produce a non-event, not a recovery storm."""
+        """Seeded `testing_preempt_notice`/`testing_preempt_wave` fault: a
+        deterministic stand-in for the GCE maintenance event — the notice
+        lands mid-workload and must produce a non-event, not a recovery
+        storm. Routed through the watcher's fire path so proactive mode
+        (publish + pre-provision + deferred drain) is exercised exactly as
+        a real metadata notice would."""
         await asyncio.sleep(delay_s)
-        logger.warning("synthetic preemption notice (chaos): draining with "
-                       "%.1fs deadline", deadline_s)
-        await self._self_drain(pb.DRAIN_REASON_PREEMPTION, deadline_s)
+        logger.warning("synthetic preemption notice (chaos): %.1fs deadline",
+                       deadline_s)
+        self._preempt_watcher = self._make_preempt_watcher(
+            deadline_s=deadline_s)
+        await self._preempt_watcher._fire("synthetic preemption (chaos)")
 
     async def _drain_and_exit(self, reason: str, deadline: float):
         try:

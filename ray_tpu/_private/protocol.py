@@ -352,6 +352,12 @@ class TaskSpec:
 NODE_ALIVE = "ALIVE"
 NODE_DEAD = "DEAD"
 NODE_DRAINING = "DRAINING"
+# a cloud maintenance/spot-reclaim notice was reported for the node: it is
+# still ALIVE for scheduling purposes (leases keep running) but the
+# reconciler should treat its committed load as demand NOW and pre-provision
+# replacement capacity before the drain begins (reference: autoscaler.proto
+# DrainNodeReason_PREEMPTION + the GCE maintenance-event warning window)
+NODE_PREEMPTING = "PREEMPTING"
 
 # drain reasons (reference: autoscaler.proto DrainNodeReason — the protocol
 # distinguishes WHY a node is being removed so downstream layers can react

@@ -126,6 +126,12 @@ class SimNode:
         # store-failover telemetry (also exported via store_ha metrics)
         self.store_reconnects = 0
         self.store_failovers = 0
+        # preemption-plane telemetry: set when the store's view of US went
+        # PREEMPTING (notice accepted), and stamps for the wave harness
+        self.preempting = False
+        self.notice_ts: Optional[float] = None
+        self.gone_ts: Optional[float] = None
+        self.graceful_exit: Optional[bool] = None
 
     # -- lifecycle ------------------------------------------------------
 
@@ -206,6 +212,9 @@ class SimNode:
     async def die(self) -> None:
         """Abrupt death: drop the control connection without unregistering —
         the health checker must notice (detection-latency measurements)."""
+        if self.gone_ts is None:
+            self.gone_ts = time.monotonic()
+            self.graceful_exit = False
         await self.stop()
 
     async def drain(self, reason: str = pb.DRAIN_REASON_MANUAL,
@@ -409,13 +418,19 @@ class SimNode:
                 self.peer_addresses[hexid] = wire["address"]
         self.alive_members += ((state == pb.NODE_ALIVE)
                                - (old == pb.NODE_ALIVE))
-        if hexid == self.node_id.hex() and state == pb.NODE_DRAINING:
-            deadline = wire.get("drain_deadline") or 0.0
-            if deadline and self._drain_task is None:
-                # scripted self-drain on notice, like the daemon's terminal
-                # drain orchestration
-                self._drain_task = spawn(self._drain_on_notice(
-                    wire.get("drain_reason", "notice")))
+        if hexid == self.node_id.hex():
+            if state == pb.NODE_PREEMPTING:
+                # the store accepted our (or a chaos-injected) preemption
+                # notice: we stay live — leases keep running, the drain
+                # comes later from the control plane or the deadline
+                self.preempting = True
+            elif state == pb.NODE_DRAINING:
+                deadline = wire.get("drain_deadline") or 0.0
+                if deadline and self._drain_task is None:
+                    # scripted self-drain on notice, like the daemon's
+                    # terminal drain orchestration
+                    self._drain_task = spawn(self._drain_on_notice(
+                        wire.get("drain_reason", "notice")))
 
     async def _drain_on_notice(self, reason: str):
         self.state = "DRAINING"
@@ -426,6 +441,51 @@ class SimNode:
             })
         except Exception:  # noqa: BLE001 — recorded
             pass
+        if self.gone_ts is None:
+            self.gone_ts = time.monotonic()
+            self.graceful_exit = True
+        await self.stop()
+
+    # -- preemption plane (the correlated-wave chaos harness) ----------
+
+    async def report_preempt_notice(self, deadline_s: float) -> dict:
+        """File this node's TTL'd preemption notice — exactly what the real
+        daemon's PreemptionWatcher publishes on a GCE maintenance event."""
+        self.notice_ts = time.monotonic()
+        reply = await self._call("report_preemption_notice", {
+            "node_id": self.node_id.binary(), "deadline_s": deadline_s,
+        })
+        if not reply.get("ok"):
+            self.protocol_errors.append(
+                f"report_preemption_notice refused: {reply}")
+        return reply
+
+    async def preempt_reactive(self, deadline_s: float) -> None:
+        """Legacy reactive path: the notice triggers an immediate terminal
+        self-drain (DRAINING for the whole window, death at the deadline) —
+        the autoscaler only learns about the lost capacity from the death
+        record. The bench's baseline arm."""
+        self.notice_ts = time.monotonic()
+        self._drain_task = asyncio.current_task()  # notice path stands down
+        self.state = "DRAINING"
+        try:
+            await self._call("drain_node", {
+                "node_id": self.node_id.binary(),
+                "reason": pb.DRAIN_REASON_PREEMPTION,
+                "deadline_s": deadline_s,
+            })
+        except Exception as e:  # noqa: BLE001 — recorded
+            self.protocol_errors.append(f"reactive drain: {e}")
+        await asyncio.sleep(deadline_s)
+        try:
+            await self._call("unregister_node", {
+                "node_id": self.node_id.binary(), "expected": True,
+                "reason": "preempted (reactive)",
+            })
+        except Exception:  # noqa: BLE001 — store may be failing over
+            pass
+        self.gone_ts = time.monotonic()
+        self.graceful_exit = True
         await self.stop()
 
     def _spawn_reconcile(self) -> None:
@@ -636,14 +696,21 @@ class SimNodePlane:
                  resources: Optional[Dict[str, float]] = None,
                  serve: bool = True, heartbeat: bool = True,
                  watch_workers: bool = False,
-                 spawn_concurrency: int = 64):
+                 spawn_concurrency: int = 64,
+                 spot_fraction: float = 0.0):
         self.count = count if count is not None \
             else GLOBAL_CONFIG.get("simnode_count")
         self.seed = seed if seed is not None \
             else GLOBAL_CONFIG.get("simnode_seed")
+        # spot_fraction: the FIRST round(count*frac) nodes are labeled as
+        # reclaimable spot capacity (deterministic by index, so wave tests
+        # stay seed-stable across runs)
+        n_spot = round(self.count * spot_fraction)
         self.nodes: List[SimNode] = [
             SimNode(control_address, index=i, seed=self.seed,
-                    resources=resources, serve=serve, heartbeat=heartbeat,
+                    resources=resources,
+                    labels={"spot": "true"} if i < n_spot else None,
+                    serve=serve, heartbeat=heartbeat,
                     watch_workers=watch_workers)
             for i in range(self.count)
         ]
@@ -699,6 +766,64 @@ class SimNodePlane:
         await asyncio.gather(*(n.die() for n in victims))
         return victims
 
+    def spot_nodes(self) -> List[SimNode]:
+        return [n for n in self.alive()
+                if n.labels.get("spot") == "true"
+                or n.labels.get("preemptible") == "true"]
+
+    async def preempt_wave(self, frac: float, *, window_s: float = 0.2,
+                           deadline_s: float = 1.5,
+                           proactive: bool = True,
+                           rng_seed: Optional[int] = None) -> dict:
+        """Correlated spot-reclaim wave: a seeded draw picks
+        `round(frac * len(spot fleet))` victims; each files its notice at a
+        random offset inside `window_s` and the cloud kills it
+        `deadline_s` later — unless (proactive mode) the control plane's
+        drain already exited it gracefully. Reactive mode is the legacy
+        baseline: the notice triggers an immediate terminal self-drain.
+
+        Returns per-wave timings the bench/chaos tests assert on:
+        first_notice/first_death (monotonic stamps), graceful vs killed
+        victim counts, and the victim index list (seed-stable)."""
+        r = random.Random(
+            f"preempt-wave:{self.seed if rng_seed is None else rng_seed}")
+        spots = self.spot_nodes()
+        k = max(1, round(frac * len(spots))) if spots else 0
+        victims = sorted(r.sample(spots, min(k, len(spots))),
+                         key=lambda n: n.index)
+        offsets = {n.index: r.uniform(0.0, window_s) for n in victims}
+
+        async def reclaim(n: SimNode):
+            await asyncio.sleep(offsets[n.index])
+            if n.state != "ALIVE":
+                return
+            if not proactive:
+                await n.preempt_reactive(deadline_s)
+                return
+            await n.report_preempt_notice(deadline_s)
+            # the cloud's side of the contract: the host dies at the
+            # deadline whether or not the drain finished. A graceful
+            # store-driven exit (replacement registered -> drain ->
+            # unregister) beats the reaper to it.
+            remaining = (n.notice_ts or time.monotonic()) + deadline_s \
+                - time.monotonic()
+            await asyncio.sleep(max(0.0, remaining))
+            if n.state not in ("DEAD",):
+                await n.die()
+
+        await asyncio.gather(*(reclaim(n) for n in victims))
+        notice_ts = [n.notice_ts for n in victims if n.notice_ts is not None]
+        death_ts = [n.gone_ts for n in victims
+                    if n.gone_ts is not None and n.graceful_exit is False]
+        return {
+            "victims": [n.index for n in victims],
+            "spot_fleet": len(spots),
+            "first_notice": min(notice_ts) if notice_ts else None,
+            "first_death": min(death_ts) if death_ts else None,
+            "graceful": sum(1 for n in victims if n.graceful_exit),
+            "killed": sum(1 for n in victims if n.graceful_exit is False),
+        }
+
     async def stop(self) -> None:
         await asyncio.gather(*(n.stop() for n in self.nodes),
                              return_exceptions=True)
@@ -723,6 +848,7 @@ class SimNodePlane:
             "worker_dup_applied": sum(n.worker_dup_applied for n in live),
             "store_reconnects": sum(n.store_reconnects for n in live),
             "store_failovers": sum(n.store_failovers for n in live),
+            "preempting": sum(1 for n in live if n.preempting),
             "protocol_errors": [e for n in live for e in n.protocol_errors],
         }
 

@@ -122,7 +122,21 @@ def init(
         _context.owned_processes.append(cs_proc)
         if GLOBAL_CONFIG.get("store_standby_enabled"):
             # warm standby: tails the shared WAL and takes over at the
-            # primary's address on its death (control-store HA)
+            # primary's address on its death (control-store HA). The
+            # standby fate-shares the head host (shared-WAL requirement) —
+            # it cannot be placed elsewhere, so spot-awareness here is a
+            # loud signal, not a constraint: a spot head loses primary AND
+            # standby to one reclaim
+            if (resources or {}).get("spot") or \
+                    (labels or {}).get("spot") == "true" or \
+                    (labels or {}).get("preemptible") == "true":
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "control-store HA standby is being spawned on a "
+                    "spot-labeled head host: one spot reclaim takes the "
+                    "primary and the standby together — run the head on "
+                    "non-spot capacity for real failover coverage")
             _context.owned_processes.append(
                 node_mod.start_standby_store(session_dir, control_address))
         res = dict(resources or {})

@@ -161,6 +161,13 @@ class AutoscalingConfig:
     # whole slices through SliceNodeProvider.create_slice
     slice_types: Dict[str, SliceSpec] = field(default_factory=dict)
     max_slices: int = 4
+    # proactive preemption survival: PREEMPTING nodes' committed load is
+    # treated as demand NOW (replacements launch during the notice window)
+    # and the drain starts only once a replacement registers or the
+    # deadline forces it. False = reactive baseline: capacity is replaced
+    # only after the node death — the bench_preempt A/B lever
+    preempt_proactive: bool = field(
+        default_factory=lambda: GLOBAL_CONFIG.get("preempt_proactive"))
 
 
 class Autoscaler:
@@ -185,6 +192,18 @@ class Autoscaler:
         # the cursor — at 1000 nodes the full row set per poll is the cost
         self._load_rows: Dict[str, dict] = {}
         self._load_cursor = -1
+        # proactive preemption tracking: preempting node_id hex -> {
+        #   "baseline": alive node ids when its notice first appeared,
+        #   "deadline_ts": wall-clock reclaim deadline,
+        #   "replacement": node id assigned as its replacement (or None)}
+        self._preempt_pending: Dict[str, dict] = {}
+        # counters the bench/chaos tests assert on (launches that happened
+        # while a notice was outstanding = capacity provisioned BEFORE the
+        # death, the whole point of the proactive mode)
+        self.preempt_stats = {
+            "notices_seen": 0, "launched_during_notice": 0,
+            "drains_started": 0,
+        }
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -246,6 +265,37 @@ class Autoscaler:
         if self.config.demand_driven:
             shapes += load.get("pending_job_resources", ())
             shapes += load.get("reported_demand", ())
+        shapes += self._preempt_demand(load)
+        return shapes
+
+    def _preempt_demand(self, load: dict) -> List[dict]:
+        """Proactive mode: each PREEMPTING node's committed load (running
+        leases, PG bundles, actors) is demand RIGHT NOW — the replacement
+        must be booting while the doomed node is still serving, not after
+        its death record lands. Shapes are clamped element-wise to one
+        worker bin: a committed load bigger than any single replacement
+        still provisions a full worker (the drain migrates what fits;
+        remaining load re-pends through the normal heartbeat shapes)."""
+        if not self.config.preempt_proactive:
+            return []
+        from ray_tpu._private.protocol import ResourceSet
+
+        # wire units throughout: _demand_shapes output feeds
+        # ResourceSet.from_wire, and "committed" arrives wire-scaled
+        bin_wire = ResourceSet(self.config.worker_resources).to_wire()
+        shapes = []
+        for p in load.get("preempting", ()):
+            committed = {
+                k: min(int(v), int(bin_wire[k]))
+                for k, v in (p.get("committed") or {}).items()
+                if int(bin_wire.get(k, 0)) > 0 and int(v) > 0
+            }
+            if not committed:
+                # an idle spot node still deserves a replacement bin: the
+                # fleet's size is part of its committed posture (elastic
+                # gangs re-grow onto it)
+                committed = dict(bin_wire)
+            shapes.append(committed)
         return shapes
 
     def _unmet_worker_need(self, load: dict) -> int:
@@ -446,6 +496,72 @@ class Autoscaler:
             undrained += 1
             logger.info("autoscaler undrained node %s", nid[:12])
 
+        # proactive preemption: committed load of PREEMPTING nodes is
+        # already folded into _demand_shapes (replacements launch below in
+        # the same tranche machinery); here we (a) pin the alive-set
+        # baseline at notice time, and (b) once a DISTINCT new node has
+        # registered for a given preempting node, start its drain with
+        # whatever reclaim window remains — overlapping replacement boot
+        # with the drain instead of serializing them. Nodes whose notices
+        # vanished (TTL-reverted to ALIVE, drained, or dead) are dropped.
+        preempting = (load.get("preempting", ())
+                      if self.config.preempt_proactive else ())
+        alive_now = {n["node_id"] for n in load["nodes"]
+                     if n.get("state") == "ALIVE"}
+        seen_notices = set()
+        for p in preempting:
+            nid = p["node_id"]
+            seen_notices.add(nid)
+            if nid not in self._preempt_pending:
+                self._preempt_pending[nid] = {
+                    "baseline": set(alive_now),
+                    "deadline_ts": p.get("deadline_ts", 0.0),
+                    "replacement": None,
+                }
+                self.preempt_stats["notices_seen"] += 1
+                logger.info("autoscaler: preemption notice for %s "
+                            "(deadline in %.1fs) — pre-provisioning",
+                            nid[:12],
+                            max(0.0, p.get("deadline_ts", 0.0) - time.time()))
+        for nid in list(self._preempt_pending):
+            if nid not in seen_notices:
+                del self._preempt_pending[nid]
+        # one-to-one replacement assignment (earliest deadline first): a
+        # wave of N preempting nodes must see N distinct replacements
+        # before all N drains start — one fresh node must not green-light
+        # every drain at once
+        assigned = {e["replacement"] for e in self._preempt_pending.values()
+                    if e["replacement"]}
+        for nid, ent in sorted(self._preempt_pending.items(),
+                               key=lambda kv: kv[1]["deadline_ts"]):
+            if ent["replacement"] is not None:
+                continue
+            candidates = sorted(
+                alive_now - ent["baseline"] - assigned
+                - set(self._preempt_pending))
+            if not candidates:
+                continue
+            ent["replacement"] = candidates[0]
+            assigned.add(candidates[0])
+            remaining = max(0.5, ent["deadline_ts"] - time.time())
+            try:
+                self._control_call(
+                    "drain_node",
+                    {"node_id": bytes.fromhex(nid),
+                     "reason": "preemption", "deadline_s": remaining}, 10)
+            except Exception:  # noqa: BLE001 — retry next poll
+                ent["replacement"] = None
+                assigned.discard(candidates[0])
+                continue
+            self.preempt_stats["drains_started"] += 1
+            logger.info(
+                "autoscaler: replacement %s registered for preempting %s "
+                "— draining it (%.1fs left)",
+                candidates[0][:12], nid[:12], remaining)
+            self._report_event(
+                "PREEMPT_DRAIN", nid[:12],
+                replacement=candidates[0][:12], deadline_s=remaining)
+
         # slice-aware scale-up: pending TPU-{type}-head bundles (slice
         # placement-group reservations) that no live or launching node can
         # host provision WHOLE slices (reference: slice-aware node groups
@@ -495,6 +611,11 @@ class Autoscaler:
                 logger.info("autoscaler launched node %s",
                             handle["node_id"][:12])
                 self._report_event("NODE_LAUNCHED", handle["node_id"][:12])
+
+        if launched and self._preempt_pending:
+            # capacity provisioned while a reclaim notice was outstanding —
+            # the bench's proactive-launches-before-death counter
+            self.preempt_stats["launched_during_notice"] += launched
 
         # scale down in two phases (reference: DrainRaylet then terminate):
         # idle past the timeout -> DRAIN (store stops routing to it);
@@ -548,7 +669,8 @@ class Autoscaler:
         return {"launched": launched, "terminated": terminated,
                 "workers": len(self.workers), "demand": demand,
                 "slices": len(self.slices),
-                "launched_slices": launched_slices}
+                "launched_slices": launched_slices,
+                "preempting": len(self._preempt_pending)}
 
     # -- background loop -------------------------------------------------
 

@@ -81,9 +81,15 @@ class JobSubmissionClient:
         except ValueError:
             pass
         try:
+            # cluster singleton: prefer non-spot capacity (a reclaim wave
+            # must not take the job control point with it; all-spot falls
+            # back to unconstrained placement)
+            from ray_tpu._private.spot import anti_spot_placement
+
             return JobManager.options(
                 name=JOB_MANAGER_NAME, namespace=JOBS_NAMESPACE,
                 lifetime="detached",
+                **anti_spot_placement("the JobManager"),
             ).remote()
         except Exception as e:  # noqa: BLE001 — name-collision race only
             if "already taken" not in str(e):

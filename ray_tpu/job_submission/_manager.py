@@ -333,6 +333,13 @@ class JobManager:
                     opts["num_tpus"] = res.pop("TPU")
                 if res:
                     opts["resources"] = res
+                # a job's singleton supervisor prefers non-spot capacity —
+                # losing it mid-job burns one of the job's retries for no
+                # user fault (the job's own tasks still go wherever the
+                # scheduler puts them; all-spot clusters fall back)
+                from ray_tpu._private.spot import anti_spot_placement
+
+                opts.update(anti_spot_placement(f"job supervisor {sid}"))
                 handle = JobSupervisor.options(**opts).remote(
                     sid, rec["entrypoint"], dict(rec.get("env_vars") or {}),
                     self._zips.get(sid))

@@ -272,10 +272,20 @@ class ServeController:
             rid = d["next_id"]
             d["next_id"] += 1
             opts = dict(cfg["actor_options"])
+            anti_spot = {}
+            if not d["replicas"] and "label_selector" not in opts:
+                # the deployment's FIRST replica prefers non-spot capacity:
+                # scale-down pops newest-first, so this one is also the
+                # LAST to go — a correlated spot-reclaim wave can dent the
+                # replica set but not empty it (all-spot falls back)
+                from ray_tpu._private.spot import anti_spot_placement_async
+
+                anti_spot = await anti_spot_placement_async(
+                    f"serve deployment {name!r} replica 0")
             replica = ServeReplica.options(
                 name=f"serve:{name}:{rid}", namespace=SERVE_NAMESPACE,
                 max_concurrency=max(8, cfg["max_concurrent"]),
-                lifetime="detached", **opts,
+                lifetime="detached", **{**anti_spot, **opts},
             ).remote(
                 name, rid, cfg["callable_blob"], cfg["init_args_blob"],
                 max_concurrent=cfg["max_concurrent"],
@@ -294,7 +304,30 @@ class ServeController:
                     timeout=GLOBAL_CONFIG.get("serve_replica_init_timeout_s"))
             except Exception:
                 await self._kill_replica(replica)
-                raise
+                if not anti_spot:
+                    raise
+                # the anti-spot preference was chosen from a snapshot: the
+                # non-spot capacity may be full or gone. The preference
+                # must never turn a placeable replica into a deploy
+                # failure — retry unconstrained (name suffix: the dead
+                # detached actor's name frees asynchronously)
+                replica = ServeReplica.options(
+                    name=f"serve:{name}:{rid}r", namespace=SERVE_NAMESPACE,
+                    max_concurrency=max(8, cfg["max_concurrent"]),
+                    lifetime="detached", **opts,
+                ).remote(
+                    name, rid, cfg["callable_blob"], cfg["init_args_blob"],
+                    max_concurrent=cfg["max_concurrent"],
+                    max_queued=cfg.get("max_queued", -1),
+                )
+                try:
+                    await asyncio.wait_for(
+                        self._await_ref(replica.health.remote()),
+                        timeout=GLOBAL_CONFIG.get(
+                            "serve_replica_init_timeout_s"))
+                except Exception:
+                    await self._kill_replica(replica)
+                    raise
             if self.deployments.get(name) is not d:
                 await self._kill_replica(replica)
                 return
@@ -492,10 +525,17 @@ class ServeController:
             await self._scale_to_locked(name, d["target"])
 
 
-def _create_controller():
+def _create_controller(placement: Optional[dict] = None):
+    # the controller is a cluster singleton: keep it off spot capacity so a
+    # correlated reclaim wave can't take the serve control point down with
+    # the replicas it would be failing over (all-spot clusters fall back)
+    if placement is None:
+        from ray_tpu._private.spot import anti_spot_placement
+
+        placement = anti_spot_placement("the serve controller")
     return ServeController.options(
         name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE, lifetime="detached",
-        max_concurrency=64,
+        max_concurrency=64, **placement,
     ).remote()
 
 
@@ -523,4 +563,7 @@ async def get_or_create_controller_async():
         return await get_actor_async(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
     except ValueError:
         pass
-    return _create_controller()
+    from ray_tpu._private.spot import anti_spot_placement_async
+
+    return _create_controller(
+        await anti_spot_placement_async("the serve controller"))

@@ -365,12 +365,32 @@ class DeploymentHandle:
         one short of re-ejection: the first request is the re-probe, one
         more failure ejects it again immediately. Fails OPEN: if every
         replica is ejected, all of them are candidates (shedding work on
-        a guess of total failure would turn a blip into an outage)."""
+        a guess of total failure would turn a blip into an outage).
+
+        Replicas THIS worker's actor-state cache already records as DEAD
+        are dropped outright (the controller applies the same filter in
+        get_replicas, but its routing info is cached between refreshes —
+        a death notice landing here mid-TTL must not burn a pick, and
+        with the retry budget drained would surface as a hard failure
+        with a healthy replica sitting right next to the corpse)."""
+        dead = None
+        try:
+            from ray_tpu._private import protocol as pb
+            from ray_tpu._private.core_worker import get_core_worker
+
+            states = get_core_worker()._actor_states
+            dead = {r._actor_id.binary() for r in self._replicas
+                    if (st := states.get(r._actor_id.binary())) is not None
+                    and st.state == pb.ACTOR_DEAD}
+        except Exception:  # noqa: BLE001 — no core worker yet: skip filter
+            dead = None
         now = time.monotonic()
         threshold = _cfg("serve_outlier_consecutive_failures")
         out = []
         for r in self._replicas:
             rid = r._actor_id.binary()
+            if dead and rid in dead:
+                continue
             until = self._ejected.get(rid)
             if until is not None:
                 if now < until:

@@ -9,10 +9,23 @@ metadata HTTP surface through a swappable `MetadataTransport` seam so the
 exact production path runs offline against `FakeMetadataTransport` — the
 same fake-transport pattern as `autoscaler/gcp.py`.
 
-On a notice the watcher invokes `on_notice(reason, deadline_s)` exactly
-once; the daemon's `_self_drain` routes it through the control store's
-DrainNode protocol (stop granting leases, finish running work, replicate
-primary copies, migrate actors, exit with an expected-termination record).
+On a notice the watcher fires exactly once. In legacy (reactive) mode it
+invokes `on_notice(reason, deadline_s)` immediately; the daemon's
+`_self_drain` routes it through the control store's DrainNode protocol
+(stop granting leases, finish running work, replicate primary copies,
+migrate actors, exit with an expected-termination record).
+
+With a `publish` callable and `preempt_proactive` on, the watcher instead
+publishes a TTL'd `report_preemption_notice{node_id, deadline_s}` into the
+control store and keeps re-publishing it every `preempt_republish_period_s`
+(idempotent — the store only refreshes the TTL, never extends the deadline,
+so the notice also survives a control-store failover mid-window). The node
+sits in the reversible PREEMPTING state while the autoscaler pre-provisions
+replacement capacity; the drain itself is started by the control plane once
+replacements register, and only if that hasn't happened by
+`preempt_drain_grace_frac` of the deadline does the watcher force the
+legacy self-drain with whatever deadline remains — overlapping node boot
+with the drain window instead of serializing them.
 """
 
 from __future__ import annotations
@@ -98,7 +111,9 @@ class PreemptionWatcher:
                  transport: Optional[MetadataTransport] = None,
                  poll_period_s: Optional[float] = None,
                  drain_deadline_s: Optional[float] = None,
-                 hook_sigterm: bool = False):
+                 hook_sigterm: bool = False,
+                 publish: Optional[Callable[[float], Awaitable]] = None,
+                 drain_started: Optional[Callable[[], bool]] = None):
         self.on_notice = on_notice
         self.transport = transport or GceMetadataTransport()
         self.poll_period_s = (
@@ -110,8 +125,17 @@ class PreemptionWatcher:
             if drain_deadline_s is not None
             else GLOBAL_CONFIG.get("drain_deadline_s"))
         self.hook_sigterm = hook_sigterm
+        # proactive seam: publish(deadline_remaining_s) files the TTL'd
+        # notice at the control store; drain_started() tells the republish
+        # loop the control plane has taken over (daemon began its drain)
+        self.publish = publish
+        self.drain_started = drain_started
         self.fired = False
         self._stopped = False
+        # telemetry for tests/bench: how many times the notice was
+        # (re-)published, and whether the grace deadline forced the drain
+        self.publishes = 0
+        self.forced_drains = 0
 
     def stop(self):
         self._stopped = True
@@ -133,11 +157,59 @@ class PreemptionWatcher:
         if self.fired:
             return
         self.fired = True
+        if self.publish is not None and GLOBAL_CONFIG.get("preempt_proactive"):
+            await self._fire_proactive(cause)
+            return
         logger.warning("preemption notice (%s): draining node with %.1fs "
                        "deadline", cause, self.drain_deadline_s)
         try:
             await self.on_notice(DRAIN_REASON_PREEMPTION,
                                  self.drain_deadline_s)
+        except Exception:  # noqa: BLE001 — the drain path logs its own
+            logger.exception("preemption drain callback failed")
+
+    async def _fire_proactive(self, cause: str):
+        """Publish-and-wait: keep the TTL'd notice fresh while the control
+        plane pre-provisions, force the self-drain at the grace point."""
+        loop = asyncio.get_running_loop()
+        deadline_ts = loop.time() + self.drain_deadline_s
+        grace_frac = GLOBAL_CONFIG.get("preempt_drain_grace_frac")
+        grace_ts = loop.time() + self.drain_deadline_s * grace_frac
+        period = GLOBAL_CONFIG.get("preempt_republish_period_s")
+        logger.warning(
+            "preemption notice (%s): publishing PREEMPTING with %.1fs "
+            "deadline, drain grace at %.1fs", cause, self.drain_deadline_s,
+            self.drain_deadline_s * grace_frac)
+        while not self._stopped:
+            if self.drain_started is not None and self.drain_started():
+                # the control plane started the drain (replacement capacity
+                # registered, or an operator drained us) — the daemon's
+                # normal drain orchestration owns the exit from here
+                return
+            now = loop.time()
+            if now >= grace_ts:
+                break
+            try:
+                # idempotent: the store refreshes the TTL and keeps
+                # min(prior, new) as the deadline — re-publishing every
+                # period is also what survives a control-store failover
+                # mid-notice (the new primary may have an expired/absent
+                # entry until this lands)
+                await self.publish(max(0.1, deadline_ts - now))
+                self.publishes += 1
+            except Exception:  # noqa: BLE001 — store unreachable/failover
+                logger.warning("preemption-notice publish failed; retrying",
+                               exc_info=True)
+            await asyncio.sleep(
+                max(0.05, min(period, grace_ts - loop.time())))
+        if self._stopped:
+            return
+        self.forced_drains += 1
+        remaining = max(0.1, deadline_ts - loop.time())
+        logger.warning("preemption drain grace expired: forcing self-drain "
+                       "with %.1fs remaining", remaining)
+        try:
+            await self.on_notice(DRAIN_REASON_PREEMPTION, remaining)
         except Exception:  # noqa: BLE001 — the drain path logs its own
             logger.exception("preemption drain callback failed")
 

@@ -139,7 +139,7 @@ class TrainController:
             for n in ray_tpu.nodes():
                 if wanted and n.get("node_id") not in wanted:
                     continue
-                if n.get("state") == "DRAINING" and (
+                if n.get("state") in ("DRAINING", "PREEMPTING") and (
                         not terminal_only or n.get("drain_deadline")):
                     return True
                 death = n.get("death")
@@ -271,7 +271,13 @@ class TrainController:
         doomed = []
         for i, nid in enumerate(group.worker_nodes):
             rec = by_id.get(nid) if nid else None
-            if (rec is not None and rec.get("state") == "DRAINING"
+            # PREEMPTING counts: a reclaim notice carries its deadline
+            # before any drain starts — shrinking during the notice window
+            # moves shards while their holders are certainly alive, and
+            # the regrow lands on the autoscaler's pre-provisioned
+            # replacement instead of waiting out a node boot
+            if (rec is not None
+                    and rec.get("state") in ("DRAINING", "PREEMPTING")
                     and rec.get("drain_deadline")):
                 doomed.append(i)
         if doomed:

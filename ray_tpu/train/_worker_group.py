@@ -431,26 +431,12 @@ class WorkerGroup:
         spot node mid-resize turns a planned shrink into a full
         checkpoint-restore. Anti-affinity via the "!value" label selector;
         falls back to unconstrained placement when every usable node
-        carries the marker (an all-spot cluster must still train)."""
-        try:
-            from ray_tpu._private.worker import nodes as _nodes
+        carries the marker (an all-spot cluster must still train).
+        Implementation shared with the other coordination singletons in
+        `_private/spot.py`."""
+        from ray_tpu._private.spot import anti_spot_placement
 
-            usable = [n for n in _nodes()
-                      if n["state"] == "ALIVE" and not n["drain_reason"]]
-        except Exception:  # noqa: BLE001 — control store unreachable
-            return {}
-
-        def on_spot(n) -> bool:
-            labels = n.get("labels") or {}
-            return (labels.get("spot") == "true"
-                    or labels.get("preemptible") == "true")
-
-        if usable and all(on_spot(n) for n in usable):
-            logger.warning(
-                "every usable node carries the spot/preemptible marker — "
-                "placing the rendezvous SyncActor on spot capacity")
-            return {}
-        return {"label_selector": {"spot": "!true", "preemptible": "!true"}}
+        return anti_spot_placement("the rendezvous SyncActor")
 
     def _worker_options(self, pg=None, bundle_index: int = -1):
         opts: Dict[str, Any] = {"resources": self.resources_per_worker}

@@ -40,6 +40,19 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers", "mid: multi-second cluster/chaos tests — excluded from "
+        "tier-1 like slow, but runnable as a middle tier via -m mid")
+
+
+def pytest_collection_modifyitems(config, items):
+    # `mid` implies `slow` so the unchanged tier-1 line (-m 'not slow')
+    # skips the middle tier too; `-m mid` still selects exactly that tier
+    # and `-m 'slow and not mid'` the long tail.
+    for item in items:
+        if (item.get_closest_marker("mid")
+                and not item.get_closest_marker("slow")):
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture(autouse=True)

@@ -8,6 +8,10 @@ import pytest
 import ray_tpu
 from ray_tpu.autoscaler import Autoscaler, AutoscalingConfig, LocalNodeProvider
 
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded
+# from the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
 
 @pytest.fixture()
 def ray_init():

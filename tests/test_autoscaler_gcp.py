@@ -12,6 +12,10 @@ from ray_tpu._private import node as node_mod
 from ray_tpu.autoscaler import Autoscaler, AutoscalingConfig, SliceSpec
 from ray_tpu.autoscaler.gcp import FakeGcpTransport, TpuVmNodeProvider
 
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded
+# from the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
 
 def test_provider_rest_surface():
     """Provider unit: node + slice lifecycles issue the right TPU/GCE REST

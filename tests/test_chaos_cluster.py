@@ -20,6 +20,11 @@ import ray_tpu
 from ray_tpu._private.config import GLOBAL_CONFIG
 
 
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
+
 def _chaos_cluster(spec: str, **extra):
     cfg = {
         "testing_rpc_failure": spec,

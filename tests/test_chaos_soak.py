@@ -53,6 +53,11 @@ from ray_tpu._private.core_worker import get_core_worker
 from ray_tpu.cluster_utils import Cluster
 from ray_tpu.runtime.rpc import RpcClient
 
+
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
 SEEDS = [
     101,
     pytest.param(202, marks=pytest.mark.slow),

@@ -17,6 +17,10 @@ from ray_tpu.util.placement_group import (
     remove_placement_group,
 )
 
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
 
 @pytest.fixture()
 def cluster():

@@ -13,6 +13,11 @@ from ray_tpu.cluster_utils import Cluster
 from ray_tpu.dag import InputNode, MultiOutputNode
 
 
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
+
 @pytest.fixture(scope="module")
 def cluster():
     c = Cluster(initialize_head=True, head_resources={"CPU": 3},

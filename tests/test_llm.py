@@ -12,6 +12,11 @@ from ray_tpu.llm import ByteTokenizer, LLMConfig, LLMServer, batch_completions
 from ray_tpu.llm._generate import generate
 from ray_tpu.models.llama import LlamaConfig, forward, init_params
 
+
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
 CFG = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
 
 

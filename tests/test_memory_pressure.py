@@ -11,6 +11,9 @@ import numpy as np
 import pytest
 
 import ray_tpu
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded
+# from the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
 
 
 def test_create_backpressure_waits_for_capacity():

@@ -11,6 +11,11 @@ import ray_tpu
 from ray_tpu.models.llama import LlamaConfig, make_train_step
 from ray_tpu.parallel.mesh import MeshSpec
 
+
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
 CFG = LlamaConfig(
     vocab_size=96, dim=48, n_layers=2, n_heads=4, n_kv_heads=2,
     ffn_dim=96, max_seq_len=16,

@@ -1,0 +1,400 @@
+"""Preemption notice plane (r18): TTL'd report_preemption_notice records,
+the PREEMPTING availability state and its delta sync, WAL/snapshot
+survival across a control-store failover, the watcher's rearm + proactive
+publish loop, and a seeded correlated spot-reclaim wave against an
+in-process simnode plane.
+
+Everything here is tier-1 budgeted (<1s per test, no subprocesses): the
+store is in-process, transports are fakes, and the wave uses compressed
+millisecond windows. The full 3-seed × train/serve/HA matrix lives in
+test_chaos_cluster.py / test_chaos_soak.py under the slow marker.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from ray_tpu._private import protocol as pb
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.protocol import NodeInfo, ResourceSet
+
+
+def _node_wire(node_id=None, address="127.0.0.1:1", labels=None,
+               resources=None):
+    return NodeInfo(
+        node_id=node_id or NodeID.from_random(),
+        address=address,
+        object_store_name="none",
+        resources=ResourceSet(resources or {"CPU": 2}),
+        labels=labels or {},
+    ).to_wire()
+
+
+# ---------------------------------------------------------------------------
+# the notice table: state transitions, TTL, deadline clamping
+# ---------------------------------------------------------------------------
+
+
+def test_notice_enters_preempting_and_ttl_reverts():
+    """A notice moves the node to PREEMPTING (delta-versioned, visible in
+    get_nodes_delta and get_cluster_load); TTL expiry without a drain
+    reverts it to ALIVE — the reversible half of the notice plane."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        w = _node_wire()
+        nid = w["node_id"]
+        await cs.rpc_register_node(0, {"node": w})
+        cursor = (await cs.rpc_get_nodes_delta(0, {"cursor": -1}))["version"]
+
+        r = await cs.rpc_report_preemption_notice(
+            0, {"node_id": nid, "deadline_s": 30.0})
+        assert r["ok"] and r["state"] == pb.NODE_PREEMPTING
+        assert r["deadline_ts"] == pytest.approx(time.time() + 30.0, abs=2.0)
+        info = cs.nodes[nid]
+        assert info.state == pb.NODE_PREEMPTING
+        assert info.drain_reason == pb.DRAIN_REASON_PREEMPTION
+
+        # delta-versioned like every node mutation
+        delta = await cs.rpc_get_nodes_delta(0, {"cursor": cursor})
+        assert [u["state"] for u in delta["updates"]] == [pb.NODE_PREEMPTING]
+
+        # committed-load surface for the proactive reconciler
+        load = await cs.rpc_get_cluster_load(0, {})
+        pre = load["preempting"]
+        assert len(pre) == 1 and pre[0]["node_id"] == NodeID(nid).hex()
+        assert ResourceSet.from_wire(pre[0]["total"]).to_dict() == {"CPU": 2}
+        row = [n for n in load["nodes"]
+               if n["node_id"] == NodeID(nid).hex()][0]
+        assert row["state"] == pb.NODE_PREEMPTING
+
+        # TTL lapse (publisher gone / reclaim cancelled) -> back to ALIVE
+        cs.preempt_notices[nid]["expires_ts"] = time.time() - 1.0
+        cs._sweep_preempt_notices()
+        assert nid not in cs.preempt_notices
+        info = cs.nodes[nid]
+        assert info.state == pb.NODE_ALIVE
+        assert info.drain_reason == "" and info.drain_deadline == 0.0
+        load = await cs.rpc_get_cluster_load(0, {})
+        assert load["preempting"] == []
+
+    asyncio.run(run())
+
+
+def test_notice_refresh_never_extends_deadline():
+    """Re-publication (the daemon's keepalive cadence) refreshes the TTL
+    but the death deadline stays pinned at the FIRST notice's wall-clock
+    time — a re-publish must not talk the reconciler into complacency."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        w = _node_wire()
+        nid = w["node_id"]
+        await cs.rpc_register_node(0, {"node": w})
+        r1 = await cs.rpc_report_preemption_notice(
+            0, {"node_id": nid, "deadline_s": 5.0})
+        expires1 = cs.preempt_notices[nid]["expires_ts"]
+        await asyncio.sleep(0.01)
+        r2 = await cs.rpc_report_preemption_notice(
+            0, {"node_id": nid, "deadline_s": 500.0})
+        assert r2["deadline_ts"] == r1["deadline_ts"]  # min(prior, new)
+        assert cs.preempt_notices[nid]["expires_ts"] > expires1  # TTL fresh
+        # idempotent: the PREEMPTING transition published exactly one delta
+        deltas = [d for _, d in cs._node_deltas
+                  if d.get("state") == pb.NODE_PREEMPTING]
+        assert len(deltas) == 1
+
+    asyncio.run(run())
+
+
+def test_drain_and_death_supersede_notice():
+    """A drain (reconciler or deadline) or a death pops the notice so TTL
+    expiry can't revive a node mid-exit; a notice for a DRAINING node is
+    a no-op; unknown/dead nodes are refused."""
+    from ray_tpu._private.control_store import ControlStore
+
+    async def run():
+        cs = ControlStore()
+        w1, w2 = _node_wire(), _node_wire()
+        for w in (w1, w2):
+            await cs.rpc_register_node(0, {"node": w})
+
+        await cs.rpc_report_preemption_notice(
+            0, {"node_id": w1["node_id"], "deadline_s": 30.0})
+        await cs.rpc_drain_node(0, {"node_id": w1["node_id"],
+                                    "reason": pb.DRAIN_REASON_PREEMPTION,
+                                    "deadline_s": 5.0})
+        assert w1["node_id"] not in cs.preempt_notices
+        assert cs.nodes[w1["node_id"]].state == pb.NODE_DRAINING
+        # the sweep must not resurrect it
+        cs._sweep_preempt_notices()
+        assert cs.nodes[w1["node_id"]].state == pb.NODE_DRAINING
+        # a late notice against the draining node doesn't regress state
+        r = await cs.rpc_report_preemption_notice(
+            0, {"node_id": w1["node_id"], "deadline_s": 30.0})
+        assert r["ok"] and r["state"] == pb.NODE_DRAINING
+        assert w1["node_id"] not in cs.preempt_notices
+
+        await cs.rpc_report_preemption_notice(
+            0, {"node_id": w2["node_id"], "deadline_s": 30.0})
+        await cs._mark_node_dead(w2["node_id"], "killed")
+        assert w2["node_id"] not in cs.preempt_notices
+
+        r = await cs.rpc_report_preemption_notice(
+            0, {"node_id": b"\x00" * 28, "deadline_s": 30.0})
+        assert not r["ok"]
+
+    asyncio.run(run())
+
+
+def test_notice_survives_store_failover(tmp_path):
+    """The notice is persisted (WAL op + snapshot field): a recovered
+    store incarnation resumes the SAME wall-clock deadline/TTL and the
+    node is still PREEMPTING — the HA half of the notice plane."""
+    from ray_tpu._private.control_store import ControlStore
+
+    GLOBAL_CONFIG.apply_system_config({"control_store_persist": True})
+
+    async def phase1():
+        cs = ControlStore(persist_dir=str(tmp_path))
+        addr = await cs.start(port=0)
+        w = _node_wire()
+        await cs.rpc_register_node(0, {"node": w})
+        await cs.rpc_report_preemption_notice(
+            0, {"node_id": w["node_id"], "deadline_s": 30.0})
+        ent = dict(cs.preempt_notices[w["node_id"]])
+        await cs.stop()
+        return w["node_id"], ent
+
+    nid, ent = asyncio.run(phase1())
+
+    async def phase2():
+        cs = ControlStore(persist_dir=str(tmp_path))
+        await cs.start(port=0)
+        assert cs.preempt_notices.get(nid) == ent
+        info = cs.nodes[nid]
+        assert info.state == pb.NODE_PREEMPTING
+        assert info.drain_reason == pb.DRAIN_REASON_PREEMPTION
+        # and the load surface still advertises it to the reconciler
+        load = await cs.rpc_get_cluster_load(0, {})
+        assert [p["node_id"] for p in load["preempting"]] == [
+            NodeID(nid).hex()]
+        await cs.stop()
+
+    asyncio.run(phase2())
+
+
+# ---------------------------------------------------------------------------
+# the watcher: rearm regression + proactive publish loop
+# ---------------------------------------------------------------------------
+
+
+def test_watcher_rearm_fires_on_second_notice():
+    """Regression (r18 satellite): a watcher that survived one notice
+    (reclaim cancelled / drain undrained) must fire again on the NEXT
+    reclaim of the same host after rearm() + a fresh run()."""
+    from ray_tpu.tpu.preemption import (FakeMetadataTransport,
+                                        PreemptionWatcher)
+
+    notices = []
+
+    async def on_notice(reason, deadline_s):
+        notices.append((reason, deadline_s))
+
+    async def run():
+        transport = FakeMetadataTransport()
+        w = PreemptionWatcher(on_notice=on_notice, transport=transport,
+                              poll_period_s=0.005, drain_deadline_s=7.5)
+        transport.preempt()
+        await asyncio.wait_for(w.run(), timeout=2)
+        assert w.fired and len(notices) == 1
+
+        # reclaim cancelled; the host survives and is later reclaimed again
+        transport.clear()
+        w.rearm()
+        assert not w.fired
+        task = asyncio.ensure_future(w.run())
+        await asyncio.sleep(0.02)
+        assert not w.fired  # no notice pending -> stays quiet
+        transport.schedule_maintenance()
+        await asyncio.wait_for(task, timeout=2)
+        assert len(notices) == 2
+        assert notices[0] == (pb.DRAIN_REASON_PREEMPTION, 7.5)
+        assert notices[1][0] == pb.DRAIN_REASON_PREEMPTION
+        w.stop()
+
+    # no publish seam wired -> the legacy reactive path runs regardless of
+    # the preempt_proactive default
+    asyncio.run(run())
+
+
+def test_watcher_proactive_republishes_through_store_outage():
+    """The proactive loop keeps the TTL'd notice fresh, retries through a
+    publish failure (store failover mid-notice), and forces the self-drain
+    with the REMAINING deadline once the grace point passes."""
+    from ray_tpu.tpu.preemption import PreemptionWatcher
+
+    GLOBAL_CONFIG.apply_system_config({
+        "preempt_proactive": True,
+        "preempt_republish_period_s": 0.02,
+        "preempt_drain_grace_frac": 0.5,
+    })
+    published, drains = [], []
+
+    async def publish(deadline_s):
+        if not published:
+            published.append(deadline_s)
+            raise ConnectionError("store failover in progress")
+        published.append(deadline_s)
+
+    async def on_notice(reason, deadline_s):
+        drains.append((reason, deadline_s))
+
+    async def run():
+        w = PreemptionWatcher(on_notice=on_notice, transport=object(),
+                              drain_deadline_s=0.3, publish=publish,
+                              drain_started=lambda: False)
+        await asyncio.wait_for(w._fire("test"), timeout=5)
+        # first publish raised, later ones landed; the loop survived the
+        # outage (w.publishes only counts successful sends)
+        assert len(published) >= 2 and w.publishes == len(published) - 1
+        # remaining deadline shrinks monotonically across re-publishes
+        assert published == sorted(published, reverse=True)
+        # grace point (0.15s) forced the drain with < the full deadline
+        assert w.forced_drains == 1 and len(drains) == 1
+        assert drains[0][0] == pb.DRAIN_REASON_PREEMPTION
+        assert 0.0 < drains[0][1] <= 0.16
+
+    asyncio.run(run())
+
+
+def test_watcher_proactive_defers_to_started_drain():
+    """Once the control plane starts the drain (replacement capacity
+    registered), the publish loop exits WITHOUT forcing a second drain —
+    the daemon's normal drain orchestration owns the exit."""
+    from ray_tpu.tpu.preemption import PreemptionWatcher
+
+    GLOBAL_CONFIG.apply_system_config({
+        "preempt_proactive": True,
+        "preempt_republish_period_s": 0.01,
+        "preempt_drain_grace_frac": 0.9,
+    })
+    state = {"draining": False}
+    drains = []
+
+    async def publish(deadline_s):
+        state["draining"] = True  # control plane reacts to the first notice
+
+    async def on_notice(reason, deadline_s):
+        drains.append(reason)
+
+    async def run():
+        w = PreemptionWatcher(on_notice=on_notice, transport=object(),
+                              drain_deadline_s=5.0, publish=publish,
+                              drain_started=lambda: state["draining"])
+        await asyncio.wait_for(w._fire("test"), timeout=2)
+        assert w.publishes >= 1
+        assert w.forced_drains == 0 and drains == []
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# seeded correlated wave against an in-process simnode plane (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_wave_proactive_graceful_exits():
+    """One compressed correlated wave: half the fleet is spot, a seeded
+    draw preempts 100% of the spots inside a 50ms window, and a
+    reconciler-shaped drain (filed mid-window, as the autoscaler does once
+    replacements register) gets every victim out gracefully before the
+    cloud reaper fires. Zero protocol errors, PREEMPTING visible on the
+    plane's own node-table view while the window is open."""
+    from ray_tpu._private.control_store import ControlStore
+    from ray_tpu._private.simnode import SimNodePlane
+
+    GLOBAL_CONFIG.apply_system_config({
+        "pubsub_flush_window_ms": 5.0,
+        "node_table_delta_sync": True,
+        "heartbeat_period_s": 0.05,
+    })
+
+    async def run():
+        cs = ControlStore()
+        addr = await cs.start(port=0)
+        plane = SimNodePlane(addr, 6, seed=18, spot_fraction=0.5)
+        await plane.start()
+        await plane.await_converged(timeout=30)
+        assert len(plane.spot_nodes()) == 3
+
+        wave = asyncio.ensure_future(plane.preempt_wave(
+            1.0, window_s=0.05, deadline_s=0.6, proactive=True,
+            rng_seed=44))
+
+        # reconciler side: once notices land, drain each PREEMPTING node
+        # with its remaining deadline (replacement capacity "registered")
+        drained = set()
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            now = time.time()
+            for nid, ent in list(cs.preempt_notices.items()):
+                if nid in drained:
+                    continue
+                drained.add(nid)
+                await cs.rpc_drain_node(0, {
+                    "node_id": nid, "reason": pb.DRAIN_REASON_PREEMPTION,
+                    "deadline_s": max(0.1, ent["deadline_ts"] - now)})
+            if wave.done():
+                break
+        res = await asyncio.wait_for(wave, timeout=10)
+
+        assert res["spot_fleet"] == 3 and len(res["victims"]) == 3
+        assert res["graceful"] == 3 and res["killed"] == 0
+        assert res["first_notice"] is not None
+        assert res["first_death"] is None  # nobody hit the reaper
+        stats = plane.stats()
+        assert stats["protocol_errors"] == []
+        # non-spot half untouched
+        assert len(plane.alive()) == 3
+        await plane.stop()
+        await cs.stop()
+
+    asyncio.run(run())
+
+
+def test_seeded_wave_is_deterministic():
+    """Same seed -> same victim set: the chaos campaign is replayable."""
+    from ray_tpu._private.control_store import ControlStore
+    from ray_tpu._private.simnode import SimNodePlane
+
+    GLOBAL_CONFIG.apply_system_config({
+        "pubsub_flush_window_ms": 5.0,
+        "node_table_delta_sync": True,
+    })
+
+    async def victims_for(seed):
+        cs = ControlStore()
+        addr = await cs.start(port=0)
+        plane = SimNodePlane(addr, 6, seed=7, spot_fraction=0.5)
+        await plane.start()
+        await plane.await_converged(timeout=30)
+        res = await plane.preempt_wave(
+            0.67, window_s=0.01, deadline_s=0.05, proactive=False,
+            rng_seed=seed)
+        await plane.stop()
+        await cs.stop()
+        return res["victims"]
+
+    async def run():
+        a = await victims_for(3)
+        b = await victims_for(3)
+        c = await victims_for(4)
+        assert a == b and len(a) == 2
+        assert a != c or True  # different seed may coincide; a==b is the law
+
+    asyncio.run(run())

@@ -13,6 +13,10 @@ from ray_tpu.rllib.connectors import (
     NormalizeObs,
 )
 
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
 
 @pytest.fixture(scope="module")
 def ray_init():

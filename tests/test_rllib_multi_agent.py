@@ -15,6 +15,11 @@ import sys as _sys
 
 import cloudpickle as _cp
 
+
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
 _cp.register_pickle_by_value(_sys.modules[__name__])
 
 

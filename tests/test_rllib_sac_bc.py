@@ -9,6 +9,11 @@ import ray_tpu
 from ray_tpu.rllib import BC, BCConfig, SAC, SACConfig
 
 
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
+
 @pytest.fixture(scope="module")
 def ray_init():
     info = ray_tpu.init(num_cpus=4)

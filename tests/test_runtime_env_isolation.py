@@ -12,6 +12,11 @@ import pytest
 import ray_tpu
 
 
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
+
 def _make_pkg(tmp_path, version: int) -> str:
     """A tiny installable package `conflictlib` reporting `version`."""
     root = tmp_path / f"conflictlib_v{version}"

@@ -82,7 +82,7 @@ def test_bounded_queue_sheds_with_typed_error(ray_init):
 
 
 def test_ingress_shed_before_replica_rpc(ray_init):
-    """Once a queue rejection pins the probed-load cache at capacity, the
+    """Once the probed-load cache reads the replica at capacity, the
     handle sheds at ingress — no replica RPC, counted handle-side."""
     _no_retries()
 
@@ -96,18 +96,19 @@ def test_ingress_shed_before_replica_rpc(ray_init):
     handle = serve.run(Busy.bind())
     first = handle.remote(0)  # occupies the only slot
     time.sleep(0.1)
-    shed_replica = shed_ingress = 0
+    rejections = 0
     for i in range(4):
         try:
             handle.remote(i).result(timeout=10)
         except BackpressureError:
-            if handle.overload_stats["shed_ingress"] > shed_ingress:
-                shed_ingress = handle.overload_stats["shed_ingress"]
-            else:
-                shed_replica += 1
-    assert shed_replica >= 1, "first rejection must come from the replica"
-    assert shed_ingress >= 1, (
-        "later rejections must shed at ingress off the pinned load cache")
+            rejections += 1
+    assert rejections == 4
+    # the FIRST rejection may be replica-side (cold cache: the queue-full
+    # answer pins the load cache via _note_saturated) or already an
+    # ingress shed (a background qlen probe read the busy replica first —
+    # the usual case in a warm process) — but once pinned, every later
+    # rejection must shed at ingress without spending a replica RPC
+    assert handle.overload_stats["shed_ingress"] >= 3, handle.overload_stats
     assert first.result(timeout=30) == "ok"
 
 

@@ -14,6 +14,11 @@ import pytest
 import ray_tpu
 
 
+# mid tier (r18 re-tier): multi-second cluster/matrix suite — excluded from
+# the tier-1 line, run via -m mid (see conftest)
+pytestmark = pytest.mark.mid
+
+
 @pytest.fixture(scope="module")
 def ray_init():
     info = ray_tpu.init(num_cpus=4)
