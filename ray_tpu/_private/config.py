@@ -243,6 +243,19 @@ _flag("serve_refresh_timeout_s", 5.0, "Deadline on one handle->controller routin
 _flag("serve_health_probe_timeout_s", 10.0, "Serve controller reconcile-loop replica health/stats probe deadline; a probe that expires marks the replica unhealthy (wedged replicas are killed and replaced instead of freezing the deployment's reconcile forever).")
 _flag("serve_replica_init_timeout_s", 60.0, "Deadline on a new replica's construction gate (first health probe); a replica wedged in __init__ is reaped instead of holding the controller's scale lock forever.")
 
+# --- serve autoscaling plane (serve/_autoscaling.py; reference: Serve AutoscalingStateManager) ---
+_flag("serve_autoscale_target_ongoing_requests", 2.0, "Default per-replica load target for the replica autoscaler: desired replicas = total load (ongoing + queued, peak-of-window) / this. Per-deployment override via @serve.deployment(autoscaling_config={'target_ongoing_requests': ...}).")
+_flag("serve_autoscale_upscale_delay_s", 0.0, "How long demand must exceed the current replica count before scaling UP. 0 = immediate (spikes pull replicas on the next reconcile tick); raise to ride out sub-second blips at the cost of spike latency.")
+_flag("serve_autoscale_downscale_delay_s", 10.0, "Scale-down cooldown: the autoscaler only sheds replicas after demand has stayed below the current count for this long, and sizes to the PEAK demand seen inside the window — hysteresis so a sawtooth load doesn't thrash replica churn.")
+_flag("serve_autoscale_demand_report", True, "Publish pending (unplaceable) replica resource shapes through the report_demand plane so the node autoscaler launches capacity for replicas that don't fit anywhere — spike -> replicas -> nodes in one reconcile pass. Off = replicas above current cluster capacity wait for unrelated capacity to appear.")
+
+# --- LLM prefix cache (llm/_prefix_cache.py; reference: vLLM automatic prefix caching / ray.llm kv_aware routing) ---
+_flag("llm_prefix_cache_enabled", True, "Block-granular prompt-prefix KV reuse in PagedEngine: full prompt blocks are content-hashed and refcounted across requests, so a shared-prefix request prefills only its suffix (the bench_llm A/B lever). Off = every request prefills from scratch.")
+_flag("llm_prefix_cache_max_entries", 4096, "Cap on cached prefix-block entries per engine (refcounted blocks in active use are never evicted; zero-ref LRU subtrees go first). Bounds host-side cache bookkeeping, not device KV memory — the paged pool itself is the real limit.")
+
+# --- serve ingress (proxy fleet; reference: Serve proxy_location) ---
+_flag("serve_proxy_location", "head", "Where serve.start() places HTTP ingress proxies when the caller passes none: 'head' = one proxy on the driver (one CPython event loop is the single-ingress SSE ceiling), 'every_node' = one 0-CPU proxy pinned per serving node (the bench_llm proxy-fleet lever: the fleet splits ingress dispatch across nodes).")
+
 # --- graceful drain & preemption (reference: DrainNode protocol, NodeDeathInfo) ---
 _flag("drain_deadline_s", 30.0, "Default drain deadline: how long a draining node lets running work finish before it replicates primaries, migrates actors, and exits with an expected-termination record.")
 _flag("drain_replicate_max_objects", 4096, "Max primary object copies a draining node proactively replicates to live peers before exiting (objects beyond the cap fall back to lineage reconstruction).")

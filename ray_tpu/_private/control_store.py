@@ -1826,6 +1826,13 @@ class ControlStore:
                     if avail is not None:
                         self.node_available[node_id] = avail - rec.spec.resources
                         deducted = True
+                        # the deduction must hit the availability change log
+                        # too: the daemon's next heartbeat reports the SAME
+                        # post-placement value, so the equality check there
+                        # never bumps — cursor readers (the autoscaler's
+                        # delta poll) would keep the pre-placement row and
+                        # bin-pack demand into phantom free capacity
+                        self._bump_avail(node_id)
                 daemon = await self._daemon(node_id)
                 reply = None
                 while True:
@@ -1861,6 +1868,7 @@ class ControlStore:
                         self.node_available[node_id] = (
                             self.node_available[node_id] + rec.spec.resources
                         )
+                        self._bump_avail(node_id)
                     rejected.add(node_id)
                     attempt += 1
                     continue
@@ -1873,6 +1881,7 @@ class ControlStore:
                     self.node_available[node_id] = (
                         self.node_available[node_id] + rec.spec.resources
                     )
+                    self._bump_avail(node_id)
                 if (
                     not reply.get("permanent")
                     and "insufficient resources" in str(reply.get("error", ""))

@@ -74,6 +74,21 @@ class LLMServer:
         self.config = config
         self.tokenizer = ByteTokenizer()
         self.cfg, self.params = config.build_model()
+        import collections
+
+        # rolling latency/throughput signals for the serve autoscaler
+        # (the replica's stats() probe forwards autoscaling_stats())
+        self._tps = collections.deque(maxlen=32)
+        self._ttfts = collections.deque(maxlen=64)
+
+    def autoscaling_stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self._ttfts:
+            s = sorted(self._ttfts)
+            out["ttft_p50_s"] = s[len(s) // 2]
+        if self._tps:
+            out["tokens_per_s"] = sum(self._tps) / len(self._tps)
+        return out
 
     def __call__(self, payload: Dict[str, Any]) -> Any:
         if isinstance(payload, dict) and payload.get("stream"):
@@ -97,6 +112,9 @@ class LLMServer:
             seed=self.config.seed, eos_id=EOS,
         )
         elapsed = time.monotonic() - t0
+        total = sum(len(t) for t in outs)
+        if total:
+            self._tps.append(total / max(elapsed, 1e-9))
         choices = [
             {"index": i, "text": self.tokenizer.decode(toks),
              "finish_reason": "stop" if len(toks) < max_new else "length"}
@@ -124,6 +142,7 @@ class LLMServer:
         temperature = float(
             payload.get("temperature", self.config.temperature))
         cid = f"cmpl-{int(time.monotonic() * 1000)}"
+        t0 = time.monotonic()
         n = 0
         # byte-level tokens: decode incrementally so multi-byte UTF-8
         # characters flush only at valid boundaries (a per-token decode
@@ -136,6 +155,8 @@ class LLMServer:
                 max_new_tokens=max_new, temperature=temperature,
                 seed=self.config.seed, eos_id=EOS):
             n += 1
+            if n == 1:
+                self._ttfts.append(time.monotonic() - t0)
             text = dec.decode(bytes([tok])) if tok < 256 else ""
             if not text:
                 continue  # mid-character: fold into the next chunk
@@ -242,6 +263,10 @@ class LLMEngine:
                       1e-9)
         s["tokens_per_s"] = round(s["tokens_out"] / elapsed, 2)
         return s
+
+    async def autoscaling_stats(self) -> Dict[str, Any]:
+        s = await self.stats()
+        return {k: s[k] for k in ("ttft_p50_s", "tokens_per_s") if k in s}
 
 
 def engine_actor_class():

@@ -45,6 +45,9 @@ class EngineConfig:
     kv_block_size: int = 16        # tokens per KV block
     num_kv_blocks: int = 64        # pool size (excl. the trash block)
     max_model_len: int = 256       # prompt + generation cap per sequence
+    # None = follow the llm_prefix_cache_enabled config flag (the bench
+    # A/B lever passes an explicit bool)
+    prefix_cache: Optional[bool] = None
 
 
 # ---------------------------------------------------------------------------
@@ -205,6 +208,88 @@ def _make_prefill(cfg: LlamaConfig, ecfg: EngineConfig):
     return prefill
 
 
+def _make_suffix_prefill(cfg: LlamaConfig, ecfg: EngineConfig):
+    """Jitted prefill of a prompt SUFFIX over a cached prefix: the first
+    ``cached_len`` tokens' KV already sit in the request's table blocks
+    (spliced in from the prefix cache), so only the suffix runs through
+    the model. Suffix K/V scatter at their absolute positions into the
+    request's fresh blocks; attention gathers the WHOLE table (decode's
+    paged-gather pattern) so suffix queries see the cached prefix keys.
+    Jits per pow-2 SUFFIX-length bucket — a long shared system prompt
+    costs one short-bucket compile, not a long-bucket one."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    bs = ecfg.kv_block_size
+    max_blocks = -(-ecfg.max_model_len // bs)
+    Lmax = max_blocks * bs
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+    def prefill_suffix(S, params, kc, vc, table, suffix, cached_len, slen):
+        """suffix [S] right-padded tokens at absolute positions
+        cached_len..cached_len+slen; table [max_blocks] the FULL row
+        (cached prefix blocks + this request's fresh blocks)."""
+        dt = cfg.dtype
+        hd = cfg.head_dim
+        h = params["tok_emb"].astype(dt)[suffix][None]   # [1,S,D]
+        qidx = jnp.arange(S, dtype=jnp.int32)
+        qpos = cached_len + qidx                          # absolute
+        cos, sin = rope_tables(cfg, qpos[None])
+        in_range = qidx < slen
+        # padded suffix positions scatter into the trash block 0
+        phys = jnp.where(in_range, table[jnp.clip(qpos // bs, 0,
+                                                  max_blocks - 1)], 0)
+        off = (qpos % bs).astype(jnp.int32)
+        kidx = jnp.arange(Lmax)
+        # query x key validity: causal over ABSOLUTE positions — cached
+        # prefix keys (kidx < cached_len) are visible to every live query;
+        # anything past the prompt (stale pool contents) is masked out
+        valid = (kidx[None, None, :] <= qpos[None, :, None]) \
+            & in_range[None, :, None]                     # [1,S,Lmax]
+
+        def layer(carry, xs):
+            h = carry
+            p, kcl, vcl = xs
+            x = rms_norm(h, p["ln1"], cfg.norm_eps)
+            q = (x @ p["wq"].astype(dt)).reshape(1, S, cfg.n_heads, hd)
+            k = (x @ p["wk"].astype(dt)).reshape(1, S, cfg.n_kv_heads, hd)
+            v = (x @ p["wv"].astype(dt)).reshape(1, S, cfg.n_kv_heads, hd)
+            q = _apply_rope_q(q, cos, sin).astype(dt)
+            k = _apply_rope_q(k, cos, sin).astype(dt)
+            kcl = kcl.at[phys, off].set(k[0])
+            vcl = vcl.at[phys, off].set(v[0])
+            # paged gather AFTER the scatter: suffix keys join the cached
+            # prefix keys already resident in the table's blocks
+            k_all = kcl[table].reshape(Lmax, cfg.n_kv_heads, hd)[None]
+            v_all = vcl[table].reshape(Lmax, cfg.n_kv_heads, hd)[None]
+            if cfg.n_kv_heads != cfg.n_heads:
+                rep = cfg.n_heads // cfg.n_kv_heads
+                k_all = jnp.repeat(k_all, rep, axis=2)
+                v_all = jnp.repeat(v_all, rep, axis=2)
+            scale = 1.0 / math.sqrt(hd)
+            lg = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
+                            preferred_element_type=jnp.float32) * scale
+            lg = jnp.where(valid[:, None], lg, -1e30)
+            probs = jax.nn.softmax(lg, axis=-1).astype(dt)
+            o = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+            h = h + o.reshape(1, S, -1) @ p["wo"].astype(dt)
+            x2 = rms_norm(h, p["ln2"], cfg.norm_eps)
+            gate = jax.nn.silu(x2 @ p["w1"].astype(dt))
+            up = x2 @ p["w3"].astype(dt)
+            h = h + (gate * up) @ p["w2"].astype(dt)
+            return h, (kcl, vcl)
+
+        h, (kc, vc) = jax.lax.scan(layer, h, (params["layers"], kc, vc))
+        h = rms_norm(h, params["norm"], cfg.norm_eps)
+        last = h[0, jnp.clip(slen - 1, 0, S - 1)]
+        logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+        return logits, kc, vc
+
+    return prefill_suffix
+
+
 @dataclass
 class _Request:
     rid: int
@@ -216,6 +301,11 @@ class _Request:
     slot: int = -1
     produced: int = 0
     admitted_mid_decode: bool = False
+    # consumer walked away (client disconnect / stream cancel): the engine
+    # loop drops it from the waiting queue or releases its slot + blocks
+    # at the next step boundary instead of decoding for nobody
+    aborted: bool = False
+    t_start: float = 0.0  # monotonic enqueue time (TTFT signal)
     # disaggregated serving: prefill ran on ANOTHER worker; admission
     # injects the transferred KV blocks instead of running _prefill
     # (reference: serving_patterns/prefill_decode — KV transfer between
@@ -248,9 +338,21 @@ class PagedEngine:
         self.last_tok = np.zeros((B,), np.int32)
         self.temps = np.zeros((B,), np.float32)
         self.slot_req: List[Optional[_Request]] = [None] * B
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        enabled = e.prefix_cache
+        if enabled is None:
+            enabled = GLOBAL_CONFIG.get("llm_prefix_cache_enabled")
+        self._prefix_cache = None
+        if enabled:
+            from ray_tpu.llm._prefix_cache import PrefixCache
+
+            self._prefix_cache = PrefixCache(
+                self.bs, GLOBAL_CONFIG.get("llm_prefix_cache_max_entries"))
         self._alloc_device_state()
         self._decode = _make_decode_step(cfg, e)
         self._prefill = _make_prefill(cfg, e)
+        self._suffix_prefill = _make_suffix_prefill(cfg, e)
         self._pending: "asyncio.Queue[_Request]" = None  # type: ignore
         self._inject = None  # lazy jitted donated KV scatter (P/D admission)
         self._loop_task = None
@@ -259,6 +361,9 @@ class PagedEngine:
         self.steps = 0
         self.tokens_out = 0
         self.mid_decode_admissions = 0
+        import collections
+
+        self._ttfts = collections.deque(maxlen=256)
 
     # -- device-state recovery -----------------------------------------
 
@@ -296,6 +401,10 @@ class PagedEngine:
         self.last_tok[:] = 0
         self.temps[:] = 0.0
         self.slot_req = [None] * self.ecfg.max_num_seqs
+        if self._prefix_cache is not None:
+            # cached blocks pointed into the old (destroyed) pool
+            self._prefix_cache.clear()
+        self._publish_metrics()
 
     # -- admission ------------------------------------------------------
 
@@ -303,31 +412,69 @@ class PagedEngine:
         total = min(len(req.prompt) + req.max_tokens, self.ecfg.max_model_len)
         return -(-total // self.bs)
 
+    def _free_with_eviction(self, want: int) -> bool:
+        """True if the free list holds ``want`` blocks, evicting zero-ref
+        prefix-cache blocks (LRU) to get there — cached-but-unused blocks
+        are capacity, never a reason to refuse admission."""
+        short = want - len(self.free_blocks)
+        if short > 0 and self._prefix_cache is not None:
+            self.free_blocks.extend(self._prefix_cache.evict(short))
+        return len(self.free_blocks) >= want
+
     def _try_admit(self, req: _Request) -> bool:
         need = self._blocks_needed(req)
-        if len(self.free_blocks) < need:
-            return False
         try:
             slot = next(i for i, r in enumerate(self.slot_req) if r is None)
         except StopIteration:
             return False
         if req.prefilled is not None:
+            if not self._free_with_eviction(need):
+                return False
             return self._admit_prefilled(req, slot, need)
-        blocks = [self.free_blocks.pop() for _ in range(need)]
+        cache = self._prefix_cache
+        plen = len(req.prompt)
+        hits: List[int] = []
+        keys: List[bytes] = []
+        if cache is not None:
+            from ray_tpu.llm._prefix_cache import chain_keys
+
+            keys = chain_keys(req.prompt, self.bs)
+            # reuse is capped one token short of the prompt: the LAST
+            # prompt token must run through prefill locally or there are
+            # no logits to sample the first generated token from
+            hits = cache.match(keys[: (plen - 1) // self.bs])
+        need_new = need - len(hits)
+        if not self._free_with_eviction(need_new):
+            if cache is not None:
+                cache.cancel_match(hits)
+            return False
+        blocks = [self.free_blocks.pop() for _ in range(need_new)]
+        row_blocks = hits + blocks
         try:
             row = np.zeros((self.max_blocks,), np.int32)
-            row[: len(blocks)] = blocks
+            row[: len(row_blocks)] = row_blocks
             self.tables[slot] = row
-            plen = len(req.prompt)
-            S = max(8, 1 << (plen - 1).bit_length())  # pow-2 bucket
             import jax
             import jax.numpy as jnp
 
-            prompt = np.zeros((S,), np.int32)
-            prompt[:plen] = req.prompt
-            logits, self.kc, self.vc = self._prefill(
-                S, self.params, self.kc, self.vc, jnp.asarray(row),
-                jnp.asarray(prompt), jnp.int32(plen))
+            cached_len = len(hits) * self.bs
+            if cached_len:
+                # prefill ONLY the suffix over the cached prefix blocks
+                slen = plen - cached_len
+                S = max(8, 1 << (slen - 1).bit_length())  # pow-2 bucket
+                suffix = np.zeros((S,), np.int32)
+                suffix[:slen] = req.prompt[cached_len:]
+                logits, self.kc, self.vc = self._suffix_prefill(
+                    S, self.params, self.kc, self.vc, jnp.asarray(row),
+                    jnp.asarray(suffix), jnp.int32(cached_len),
+                    jnp.int32(slen))
+            else:
+                S = max(8, 1 << (plen - 1).bit_length())  # pow-2 bucket
+                prompt = np.zeros((S,), np.int32)
+                prompt[:plen] = req.prompt
+                logits, self.kc, self.vc = self._prefill(
+                    S, self.params, self.kc, self.vc, jnp.asarray(row),
+                    jnp.asarray(prompt), jnp.int32(plen))
             tok = self._sample_first(req, slot, logits)
         except BaseException:
             # any failure between the block pop and slot activation (prefill
@@ -336,14 +483,33 @@ class PagedEngine:
             # deadlocks; the donated-invalid case is rebuilt by the caller
             # via _reset_device_state, which recreates free_blocks anyway
             self.free_blocks.extend(blocks)
+            if cache is not None:
+                cache.cancel_match(hits)
             self.tables[slot] = 0
             raise
+        if cache is not None and keys:
+            # every FULL prompt block (matched prefix + freshly prefilled)
+            # is now cacheable; this request holds one ref on each until
+            # release. Cap-evicted zero-ref blocks return to the pool.
+            full = plen // self.bs
+            self.free_blocks.extend(
+                cache.register(keys[:full], row_blocks[:full]))
+            if hits:
+                from ray_tpu.util.metrics import Counter
+
+                Counter("rt_llm_prefix_hits_total",
+                        "KV blocks reused from the prompt-prefix cache "
+                        "instead of re-prefilled.").inc(len(hits))
         self._activate_slot(req, slot, tok)
         return True
 
     def _emit(self, req: _Request, tok: int):
         req.produced += 1
         self.tokens_out += 1
+        if req.produced == 1 and req.t_start:
+            import time
+
+            self._ttfts.append(time.monotonic() - req.t_start)
         done = (
             (self.eos_id is not None and tok == self.eos_id)
             or req.produced >= req.max_tokens
@@ -361,12 +527,19 @@ class PagedEngine:
     def _release(self, req: _Request):
         slot = req.slot
         need = self._blocks_needed(req)
-        self.free_blocks.extend(
-            int(b) for b in self.tables[slot][:need] if b != 0)
+        cache = self._prefix_cache
+        for b in self.tables[slot][:need]:
+            b = int(b)
+            if b == 0:
+                continue
+            if cache is not None and cache.decref_block(b):
+                continue  # cache-owned: stays resident, evictable at 0 refs
+            self.free_blocks.append(b)
         self.tables[slot] = 0
         self.active[slot] = False
         self.slot_req[slot] = None
         req.slot = -1
+        self._publish_metrics()
 
     def _sample_first(self, req: _Request, slot: int, logits):
         """Sample the first generated token + seed the slot's decode RNG —
@@ -394,6 +567,7 @@ class PagedEngine:
         self.active[slot] = True
         self.last_tok[slot] = tok
         self.temps[slot] = req.temperature
+        self._publish_metrics()
         self._emit(req, tok)
 
     def _admit_prefilled(self, req: _Request, slot: int, need: int) -> bool:
@@ -461,11 +635,21 @@ class PagedEngine:
             mid_decode = bool(self.active.any())
             while not self._pending.empty():
                 waiting.append(self._pending.get_nowait())
+            # disconnect sweep: a consumer that walked away (client abort,
+            # SSE timeout) releases its slot + KV blocks at this step
+            # boundary — BEFORE admission, so the freed blocks admit the
+            # waiting head this same tick instead of leaking until OOM
+            for r in list(self.slot_req):
+                if r is not None and r.aborted and r.slot >= 0:
+                    self._release(r)
             # admit in arrival order while slots + blocks allow — requests
             # landing here while slots decode are the "admitted mid-decode"
             # continuous-batching case
             while waiting:
                 req = waiting[0]
+                if req.aborted:
+                    waiting.popleft()  # consumer gone before admission
+                    continue
                 if self._blocks_needed(req) > self.ecfg.num_kv_blocks:
                     # can never fit even a drained pool: surface an ERROR,
                     # not a silently empty completion
@@ -551,24 +735,63 @@ class PagedEngine:
                 f"prompt of {len(prompt_ids)} tokens exceeds "
                 f"max_model_len={self.ecfg.max_model_len}")
         await self._ensure_loop()
+        import time
+
         self._rid += 1
         req = _Request(self._rid, list(prompt_ids), int(max_tokens),
                        float(temperature), int(seed),
-                       queue=asyncio.Queue(), prefilled=prefilled)
+                       queue=asyncio.Queue(), prefilled=prefilled,
+                       t_start=time.monotonic())
         self._pending.put_nowait(req)
-        while True:
-            tok = await req.queue.get()
-            if tok is None:
-                return
-            if isinstance(tok, Exception):
-                raise tok
-            yield tok
+        try:
+            while True:
+                tok = await req.queue.get()
+                if tok is None:
+                    return
+                if isinstance(tok, Exception):
+                    raise tok
+                yield tok
+        finally:
+            # consumer gone — clean finish, exception, OR an abandoned
+            # generator (client disconnect cancels the SSE stream and the
+            # async generator is aclose()d). The engine loop releases the
+            # slot + blocks at its next step boundary; without this flag a
+            # cancelled stream leaked its KV blocks until pool exhaustion.
+            req.aborted = True
+
+    def _publish_metrics(self):
+        """Engine telemetry on the metrics plane (constructors are
+        idempotent — re-construction returns the registered instrument)."""
+        from ray_tpu.util.metrics import Gauge
+
+        e = self.ecfg
+        evictable = (self._prefix_cache.evictable_blocks()
+                     if self._prefix_cache is not None else 0)
+        in_use = e.num_kv_blocks - len(self.free_blocks) - evictable
+        Gauge("rt_llm_kv_blocks_in_use",
+              "KV pool blocks held by in-flight sequences (zero-ref "
+              "prefix-cache blocks count as free capacity).").set(in_use)
+        Gauge("rt_llm_batch_occupancy",
+              "Fraction of decode batch slots active.").set(
+            float(self.active.sum()) / max(1, e.max_num_seqs))
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        cache = self._prefix_cache
+        evictable = cache.evictable_blocks() if cache is not None else 0
+        ttfts = sorted(self._ttfts)
+        out = {
             "steps": self.steps,
             "tokens_out": self.tokens_out,
-            "free_blocks": len(self.free_blocks),
+            # free = immediately allocatable + reclaimable-by-eviction:
+            # zero-ref cached blocks are capacity, and callers sizing
+            # admission against free_blocks must see them as such
+            "free_blocks": len(self.free_blocks) + evictable,
+            "blocks_in_use": (self.ecfg.num_kv_blocks
+                              - len(self.free_blocks) - evictable),
             "active_slots": int(self.active.sum()),
             "mid_decode_admissions": self.mid_decode_admissions,
+            "prefix_cache": cache.stats() if cache is not None else None,
         }
+        if ttfts:
+            out["ttft_p50_s"] = ttfts[len(ttfts) // 2]
+        return out

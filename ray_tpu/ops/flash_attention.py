@@ -40,6 +40,11 @@ _INTERPRET = False  # set True to debug kernels on CPU interpreter
 NEG_INF = -1e30
 
 
+def _compiler_params_cls(pltpu):
+    # jax >= 0.8 spells it CompilerParams; the 0.4.x era TPUCompilerParams
+    return getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
 # ---------------------------------------------------------------------------
 # XLA fallback (CPU tests / unsupported shapes)
 # ---------------------------------------------------------------------------
@@ -249,7 +254,7 @@ def _flash_fwd_tpu(q, k, v, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ),
         scratch_shapes=kv_scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -440,7 +445,7 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k,
         out_specs=pl.BlockSpec((1, 1, block_q, hd),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         scratch_shapes=kv_scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -479,7 +484,7 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
         ),
         scratch_shapes=qdo_scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -616,7 +621,7 @@ def _flash_chunk_tpu(q, k, v, o, m, l, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ),
         scratch_shapes=kv_scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -749,7 +754,7 @@ def _hop_bwd_tpu(q, k, v, g, lse, delta, causal, block_q, block_k,
         out_specs=pl.BlockSpec((1, 1, block_q, hd),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         scratch_shapes=kv_scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_INTERPRET,
@@ -782,7 +787,7 @@ def _hop_bwd_tpu(q, k, v, g, lse, delta, causal, block_q, block_k,
             pl.BlockSpec((1, 1, dkv_block_k, hd), lambda bi, hi, ki: (bi, hi, ki, 0)),
         ),
         scratch_shapes=qdo_scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_INTERPRET,

@@ -131,7 +131,12 @@ def to_varying(x, axes):
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
         return pcast(x, tuple(axes), to="varying")
-    return jax.lax.pvary(x, tuple(axes))
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, tuple(axes))
+    # check_rep-era jax has no varying-axis system at all — the mark is
+    # meaningless there, and identity is exactly what pvary lowers to
+    return x
 
 
 def host_local_mesh_info(mesh: Mesh) -> dict:

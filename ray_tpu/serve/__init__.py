@@ -162,17 +162,26 @@ def start_grpc(grpc_host: str = "127.0.0.1", grpc_port: int = 9000) -> str:
 
 
 def start(http_host: str = "127.0.0.1", http_port: int = 8000,
-          proxy_location: str = "head") -> str:
+          proxy_location: Optional[str] = None) -> str:
     """Start the HTTP ingress; returns a base URL (reference:
     serve.start(http_options=..., proxy_location=...)).
 
-    proxy_location="head" (default): one proxy on this node, fixed port —
+    proxy_location=None resolves from the `serve_proxy_location` config
+    flag. "head" (flag default): one proxy on this node, fixed port —
     the dev mode. "every_node": the controller maintains one proxy PER
     ALIVE node (reference: proxy.py one-proxy-per-node + proxy_state.py),
     healing the fleet as nodes come and go; requests can enter through any
     node (front them with any TCP load balancer). With http_port=0 each
     fleet proxy binds an ephemeral port (required when several daemons
     share one test host); see serve.proxy_urls() for the full map."""
+    if proxy_location is None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        proxy_location = GLOBAL_CONFIG.get("serve_proxy_location")
+    if proxy_location not in ("head", "every_node"):
+        raise ValueError(
+            f"proxy_location must be 'head' or 'every_node', "
+            f"got {proxy_location!r}")
     if proxy_location == "every_node":
         controller = get_or_create_controller()
         urls = ray_tpu.get(

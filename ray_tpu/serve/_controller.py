@@ -9,9 +9,7 @@ autoscales on the replicas' reported in-flight request counts.
 from __future__ import annotations
 
 import asyncio
-import math
-import time
-from typing import Any, Dict, List, Optional
+from typing import Dict, Optional
 
 import ray_tpu
 
@@ -509,20 +507,85 @@ class ServeController:
                 except Exception:  # noqa: BLE001
                     return None
 
-            ongoing = 0
-            for st in await asyncio.gather(
-                    *[stats_of(r) for r in d["replicas"]]):
-                if st is not None:
-                    ongoing += max(st["ongoing"], st.get("peak_ongoing", 0))
+            stats = [st for st in await asyncio.gather(
+                *[stats_of(r) for r in d["replicas"]]) if st is not None]
             if self.deployments.get(name) is not d:
                 return
-            target_per = max(1, auto.get("target_ongoing_requests", 2))
-            desired = math.ceil(ongoing / target_per) if ongoing else auto.get("min_replicas", 1)
-            desired = min(max(desired, auto.get("min_replicas", 1)),
-                          auto.get("max_replicas", 8))
-            if desired != d["target"]:
-                d["target"] = desired
-            await self._scale_to_locked(name, d["target"])
+            from ray_tpu.serve._autoscaling import AutoscalingPolicy
+
+            policy = d.get("policy")
+            if policy is None or policy.config != dict(auto):
+                policy = AutoscalingPolicy(auto)
+                d["policy"] = policy
+            raw = policy.desired_from_stats(stats, len(d["replicas"]))
+            d["target"] = policy.update(raw, d["target"])
+            from ray_tpu.util.metrics import Gauge
+
+            Gauge("rt_serve_target_replicas",
+                  "Autoscaler target replica count per serve deployment.",
+                  ("deployment",)).set(d["target"], {"deployment": name})
+            running = len(d["replicas"])
+            if d["target"] > running:
+                # scale up only to what the cluster can PLACE right now;
+                # the shortfall rides the report_demand plane so the node
+                # autoscaler launches capacity for it, and a later tick
+                # (d["target"] unchanged) places the rest when nodes land.
+                # A blocking actor-create for an unplaceable replica would
+                # instead pin _scale_lock for the 60s init timeout.
+                placeable, unplaceable = await self._placeability(
+                    d, d["target"] - running)
+                await self._report_replica_demand(name, d, unplaceable)
+                await self._scale_to_locked(name, running + placeable)
+            else:
+                await self._report_replica_demand(name, d, 0)
+                await self._scale_to_locked(name, d["target"])
+
+    async def _placeability(self, d: dict, pending: int):
+        """(placeable, unplaceable) split of `pending` new replicas against
+        the cluster's current free capacity. Load-read failure degrades to
+        all-placeable: _scale_to_locked's own init timeout remains the
+        backstop, and demand under-report only delays node launch."""
+        from ray_tpu.serve._autoscaling import count_placeable, replica_shape
+
+        shape = replica_shape(d["config"].get("actor_options") or {})
+        try:
+            from ray_tpu._private.core_worker import get_core_worker
+
+            cw = get_core_worker()
+            reply = await cw.control.call("get_cluster_load", {}, timeout=5)
+            nodes = reply.get("nodes") or []
+        except Exception:  # noqa: BLE001 — control store unreachable
+            return pending, 0
+        placeable = count_placeable(shape, nodes, pending)
+        return placeable, pending - placeable
+
+    async def _report_replica_demand(self, name: str, d: dict,
+                                     unplaceable: int):
+        """Publish (or withdraw) pending-replica shapes on the
+        report_demand plane. Re-published every reconcile tick while
+        non-empty so the TTL stays fresh; withdrawn ONCE when the backlog
+        clears (tracked per deployment) instead of spamming empty writes."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        if not GLOBAL_CONFIG.get("serve_autoscale_demand_report"):
+            return
+        if unplaceable <= 0 and not d.get("demand_published"):
+            return
+        from ray_tpu.serve._autoscaling import (demand_key, demand_shapes,
+                                                replica_shape)
+
+        shape = replica_shape(d["config"].get("actor_options") or {})
+        try:
+            from ray_tpu._private.core_worker import get_core_worker
+
+            cw = get_core_worker()
+            await cw.control.call("report_demand", {
+                "key": demand_key(name),
+                "shapes": demand_shapes(shape, unplaceable),
+            }, timeout=2)
+            d["demand_published"] = unplaceable > 0
+        except Exception:  # noqa: BLE001 — best-effort; TTL ages out stale
+            pass
 
 
 def _create_controller(placement: Optional[dict] = None):

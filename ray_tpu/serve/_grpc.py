@@ -34,7 +34,8 @@ DEPLOYMENT_KEY = "rt-serve-deployment"
 TIMEOUT_KEY = "rt-serve-timeout-s"
 
 
-@ray_tpu.remote
+# 0-CPU infrastructure actor, matching HttpProxy
+@ray_tpu.remote(num_cpus=0)
 class GrpcProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 9000):
         self.host = host

@@ -127,15 +127,18 @@ class _RetryBudget:
 class _CallSpec:
     """Everything needed to resubmit a request on another replica."""
 
-    __slots__ = ("method", "args", "kwargs", "model_id", "deadline")
+    __slots__ = ("method", "args", "kwargs", "model_id", "deadline",
+                 "affinity_key")
 
     def __init__(self, method: Optional[str], args, kwargs,
-                 model_id: str = "", deadline: float = 0.0):
+                 model_id: str = "", deadline: float = 0.0,
+                 affinity_key: str = ""):
         self.method = method
         self.args = args
         self.kwargs = kwargs
         self.model_id = model_id
         self.deadline = deadline
+        self.affinity_key = affinity_key
 
 
 class DeploymentHandle:
@@ -167,6 +170,16 @@ class DeploymentHandle:
         # multiplexing: model id -> replica actor-id that loaded it last
         # (reference: multiplex-aware routing in pow_2_router.py)
         self._model_affinity: Dict[str, bytes] = {}
+        # prefix affinity (reference: ray.llm kv_aware routing): session /
+        # prompt-prefix key -> replica whose PagedEngine likely still holds
+        # the prefix's KV blocks. SOFT, unlike model affinity: a saturated
+        # or vanished sticky replica falls back to pow-2 and the key remaps
+        # — prefix reuse is a latency optimization, never worth queueing a
+        # request behind a hot replica for.
+        import collections
+
+        self._prefix_affinity: "collections.OrderedDict[str, bytes]" = (
+            collections.OrderedDict())
         # outlier ejection state
         self._fail_streak: Dict[bytes, int] = {}
         self._ejected: Dict[bytes, float] = {}  # rid -> eject-until (monotonic)
@@ -188,18 +201,23 @@ class DeploymentHandle:
 
     def options(self, *, multiplexed_model_id: str = "",
                 stream: bool = False,
-                timeout_s: Optional[float] = None) -> "_ConfiguredCaller":
+                timeout_s: Optional[float] = None,
+                affinity_key: str = "") -> "_ConfiguredCaller":
         """Per-request options (reference: handle.options):
         multiplexed_model_id routes to a replica that already holds the
         model; stream=True calls the replica's streaming path and returns a
         result iterator; timeout_s sets the request's END-TO-END deadline —
         it propagates to the replica and bounds queue wait, execution, and
-        every stream chunk."""
+        every stream chunk; affinity_key is a SOFT routing hint (session /
+        prompt-prefix id) steering same-key requests to the replica that
+        served the key last — its prefix-cached KV blocks make the repeat
+        prefill cheap — while saturation overflows to power-of-two."""
         if multiplexed_model_id and stream:
             raise ValueError(
                 "stream=True with multiplexed_model_id is not supported yet")
         return _ConfiguredCaller(self, model_id=multiplexed_model_id,
-                                 stream=stream, timeout_s=timeout_s)
+                                 stream=stream, timeout_s=timeout_s,
+                                 affinity_key=affinity_key)
 
     def _resolve_controller(self):
         if self._controller is None:
@@ -436,10 +454,14 @@ class DeploymentHandle:
             self._qlen_cache[rid] = (
                 self._capacity, self._sent.get(rid, 0), time.monotonic())
 
-    def _pick(self, model_id: str = "", deadline: float = 0.0) -> tuple:
+    _PREFIX_AFFINITY_MAX = 4096
+
+    def _pick(self, model_id: str = "", deadline: float = 0.0,
+              affinity_key: str = "") -> tuple:
         """Power-of-two-choices on probed queue lengths + local deltas
         (reference: router.py:556 + request_router/pow_2_router.py:27),
-        with sticky model affinity, outlier filtering, and ingress shed."""
+        with sticky model affinity, soft prefix affinity, outlier
+        filtering, and ingress shed."""
         self._refresh(deadline=deadline)
         with self._lock:
             sampled = shed_scope = None
@@ -455,6 +477,21 @@ class DeploymentHandle:
                             # requests can ONLY go here, so this replica's
                             # saturation alone justifies the shed.
                             sampled = shed_scope = [(arid, r)]
+                            i = 0
+                            break
+            if sampled is None and affinity_key:
+                arid = self._prefix_affinity.get(affinity_key)
+                if arid is not None and arid not in self._ejected and (
+                        self._capacity is None
+                        or self._load(arid) < self._capacity):
+                    for r in self._replicas:
+                        if r._actor_id.binary() == arid:
+                            # SOFT sticky: prefer the replica holding the
+                            # prefix's KV blocks, but judge shedding on the
+                            # FULL eligible set — an affinity miss routes
+                            # elsewhere instead of shedding or queueing
+                            sampled = [(arid, r)]
+                            shed_scope = self._eligible_locked()
                             i = 0
                             break
             if sampled is None:
@@ -496,6 +533,15 @@ class DeploymentHandle:
                 self._sent[rid] = self._sent.get(rid, 0) + 1
                 if model_id:
                     self._model_affinity[model_id] = rid
+                if affinity_key:
+                    # remap on every pick (a saturation overflow moves the
+                    # key with the blocks that are about to be cached);
+                    # LRU-capped so one handle can't grow without bound
+                    self._prefix_affinity[affinity_key] = rid
+                    self._prefix_affinity.move_to_end(affinity_key)
+                    while len(self._prefix_affinity) > \
+                            self._PREFIX_AFFINITY_MAX:
+                        self._prefix_affinity.popitem(last=False)
         # probe BOTH sampled candidates: refreshing only the winner lets a
         # stale-high entry starve a drained replica forever (it would never
         # be picked, so never re-probed). Sheds probe too, or the
@@ -581,7 +627,8 @@ class DeploymentHandle:
 
         with tracing.span(f"handle:pick:{self.deployment_name}"):
             rid, replica = self._pick(model_id=spec.model_id,
-                                      deadline=spec.deadline)
+                                      deadline=spec.deadline,
+                                      affinity_key=spec.affinity_key)
         kwargs = dict(spec.kwargs)
         if spec.model_id:
             kwargs["__serve_model_id"] = spec.model_id
@@ -608,7 +655,8 @@ class DeploymentHandle:
         from ray_tpu.util import tracing
 
         with tracing.span(f"handle:pick:{self.deployment_name}"):
-            rid, replica = self._pick(deadline=spec.deadline)
+            rid, replica = self._pick(deadline=spec.deadline,
+                                      affinity_key=spec.affinity_key)
         kwargs = dict(spec.kwargs)
         if spec.deadline:
             kwargs[DEADLINE_KWARG] = spec.deadline
@@ -639,26 +687,31 @@ class _ConfiguredCaller:
     timeout) and an optional method name. Chainable: unset fields keep
     their current values across options() calls."""
 
-    __slots__ = ("_handle", "_method", "_model_id", "_stream", "_timeout_s")
+    __slots__ = ("_handle", "_method", "_model_id", "_stream", "_timeout_s",
+                 "_affinity_key")
 
     def __init__(self, handle: DeploymentHandle, method: Optional[str] = None,
                  model_id: str = "", stream: bool = False,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 affinity_key: str = ""):
         self._handle = handle
         self._method = method
         self._model_id = model_id
         self._stream = stream
         self._timeout_s = timeout_s
+        self._affinity_key = affinity_key
 
     def options(self, *, multiplexed_model_id: Optional[str] = None,
                 stream: Optional[bool] = None,
-                timeout_s: Optional[float] = None) -> "_ConfiguredCaller":
+                timeout_s: Optional[float] = None,
+                affinity_key: Optional[str] = None) -> "_ConfiguredCaller":
         merged = _ConfiguredCaller(
             self._handle, self._method,
             self._model_id if multiplexed_model_id is None
             else multiplexed_model_id,
             self._stream if stream is None else stream,
             self._timeout_s if timeout_s is None else timeout_s,
+            self._affinity_key if affinity_key is None else affinity_key,
         )
         if merged._model_id and merged._stream:
             raise ValueError(
@@ -667,13 +720,15 @@ class _ConfiguredCaller:
 
     def method(self, method_name: str) -> "_ConfiguredCaller":
         return _ConfiguredCaller(self._handle, method_name, self._model_id,
-                                 self._stream, self._timeout_s)
+                                 self._stream, self._timeout_s,
+                                 self._affinity_key)
 
     def remote(self, *args, **kwargs):
         h = self._handle
         spec = _CallSpec(self._method, args, kwargs,
                          model_id=self._model_id,
-                         deadline=h._deadline_for(self._timeout_s))
+                         deadline=h._deadline_for(self._timeout_s),
+                         affinity_key=self._affinity_key)
         if self._stream:
             if self._method is not None:
                 raise ValueError(

@@ -38,6 +38,7 @@ from ray_tpu.serve._errors import (
 PROXY_NAME = "serve-http-proxy"
 SERVE_NAMESPACE = "_serve"
 TIMEOUT_HEADER = "X-Serve-Timeout-S"
+AFFINITY_HEADER = "X-Serve-Affinity-Key"
 _SENTINEL = object()
 
 
@@ -56,7 +57,10 @@ def _error_response(e: Exception):
     return 500, {}, {"error": str(err), "type": "internal"}
 
 
-@ray_tpu.remote
+# 0-CPU like Ray Serve's proxies: ingress is infrastructure, not workload —
+# the every_node fleet must place one on a node whose CPUs replicas already
+# hold, or busy nodes silently lose their ingress
+@ray_tpu.remote(num_cpus=0)
 class HttpProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         # NB: actor constructors run on an executor thread — the server is
@@ -167,6 +171,14 @@ class HttpProxy:
         timeout_s = self._timeout_from(request)
         caller = (handle if timeout_s is None
                   else handle.options(timeout_s=timeout_s))
+        # prefix-affinity hint (session / prompt-prefix id): same-key
+        # requests steer to the replica whose engine likely still holds
+        # the prefix's KV blocks; saturation overflows to pow-2
+        affinity = request.headers.get(AFFINITY_HEADER, "") or (
+            payload.get("affinity_key", "")
+            if isinstance(payload, dict) else "")
+        if affinity:
+            caller = caller.options(affinity_key=str(affinity))
         from ray_tpu.util import tracing
 
         if stream:

@@ -51,7 +51,7 @@ class ServeReplica:
         self._ongoing = 0        # admitted: queued + running
         self._running = 0        # holding a concurrency slot
         self._peak_ongoing = 0   # high-water since last stats() poll
-        self._peak_queued = 0    # high-water queue depth, monotonic
+        self._peak_queued = 0    # high-water queue depth since last poll
         self._total = 0
         # overload-plane counters (asserted by tests and scraped by
         # bench_serve): `started` moves only when user code is invoked, so
@@ -257,12 +257,17 @@ class ServeReplica:
         # time-windowed request metrics for the same reason)
         peak = self._peak_ongoing
         self._peak_ongoing = self._ongoing
-        return {
+        # peak_queued resets on poll too: a monotonic high-water would keep
+        # feeding the spike-era queue depth to the autoscaler as live load,
+        # pinning the fleet at max_replicas after traffic drains
+        peak_q = self._peak_queued
+        self._peak_queued = max(0, self._ongoing - self._max_concurrent)
+        out = {
             "replica_id": self.replica_id,
             "ongoing": self._ongoing,
             "queued": max(0, self._ongoing - self._max_concurrent),
             "peak_ongoing": peak,
-            "peak_queued": self._peak_queued,
+            "peak_queued": peak_q,
             "total": self._total,
             "started": self._started,
             "shed": self._shed,
@@ -272,6 +277,21 @@ class ServeReplica:
             "max_queued": self._max_queued,
             "uptime_s": time.time() - self._started_at,
         }
+        # autoscaling-signal passthrough: a callable exposing
+        # autoscaling_stats() (LLM engines: ttft_p50_s, tokens_per_s) rides
+        # the controller's existing stats probe — serve-layer keys win
+        hook = getattr(self._callable, "autoscaling_stats", None)
+        if hook is not None:
+            try:
+                extra = hook()
+                if asyncio.iscoroutine(extra):
+                    extra = await extra
+                if isinstance(extra, dict):
+                    for k, v in extra.items():
+                        out.setdefault(k, v)
+            except Exception:  # noqa: BLE001 — signals are optional
+                pass
+        return out
 
     async def queue_len(self) -> int:
         """Current in-flight count for the routers' cross-handle load cache

@@ -1,0 +1,58 @@
+"""bench_llm.py smokes: the tier-1 quick suite (tiny-shape prefix A/B +
+autoscaling policy simulation, no cluster boots) and a mid-marked run of
+the live spike/proxy scenarios at quick sizes."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def test_bench_llm_quick_suite():
+    import bench_llm
+
+    records = bench_llm.run_suite(quick=True)
+    by_bench = {}
+    for r in records:
+        by_bench.setdefault(r["bench"], []).append(r)
+
+    # prefix A/B: both modes ran, warm tokens matched cold, hits observed
+    ab = {r["mode"]: r for r in by_bench["llm_prefix_ttft"]}
+    assert set(ab) == {"cache_off", "cache_on"}
+    for r in ab.values():
+        assert r["unit"] == "ms" and r["ttft_p50_ms"] > 0
+        assert r["blocks_in_use_after"] == 0  # nothing leaks
+    on = ab["cache_on"]
+    assert on["tokens_match_cache_off"] is True
+    assert on["prefix_block_hits"] > 0 and on["prefix_hits"] >= 1
+    assert on["speedup_p50"] > 0
+    assert ab["cache_off"]["prefix_block_hits"] == 0
+
+    # policy sim: 4x spike pulls the fleet to the clamp, drain shrinks it
+    (sim,) = by_bench["serve_autoscale_sim"]
+    assert sim["peak_target"] == 6
+    assert sim["final_target"] == 1
+    ts = [row["target"] for row in sim["transcript"]]
+    assert ts[0] == 1 and max(ts) == 6 and ts[-1] == 1
+
+
+@pytest.mark.mid
+def test_bench_llm_live_scenarios_quick_shapes():
+    """The cluster-booting scenarios at quick sizes: the autoscaled spike
+    must actually ramp replicas AND nodes, and the proxy fleet must serve
+    SSE with zero protocol errors from >1 proxies."""
+    import bench_llm
+
+    rec = bench_llm._run_spike_mode("autoscaled", quick=True)
+    assert rec["peak_replicas"] > 1, rec
+    assert rec["peak_nodes"] > 1, rec
+    for st in rec["phases"].values():
+        assert st["protocol_errors"] == 0
+
+    records = bench_llm.run_proxy_fleet(quick=True)
+    by_mode = {r["mode"]: r for r in records}
+    assert by_mode["fleet"]["proxies"] > 1
+    for r in records:
+        assert r["protocol_errors"] == 0
+        assert r["achieved_rps"] > 0

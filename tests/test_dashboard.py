@@ -114,6 +114,19 @@ def test_web_frontend_and_metrics_export(ray_init):
 
     assert ray_tpu.get(hop_probe.remote(), timeout=60) == 1
 
+    # the LLM serving / autoscaler panels (ids 14-16) query these series:
+    # emit them driver-side so the panel-vs-export check below covers them
+    from ray_tpu.util.metrics import Counter, Gauge
+
+    Gauge("rt_llm_kv_blocks_in_use",
+          "paged-KV blocks held by admitted requests").set(3)
+    Gauge("rt_llm_batch_occupancy",
+          "active decode slots / max_num_seqs").set(0.5)
+    Counter("rt_llm_prefix_hits_total",
+            "prefix-cache block hits at admission").inc(4)
+    Gauge("rt_serve_target_replicas", "autoscaler target replica count",
+          ("deployment",)).set(2, {"deployment": "dash-d"})
+
     page = httpx.get(f"{url}/", timeout=30).text
     # nav + renderers for every view the SPA declares
     for view in ("overview", "nodes", "actors", "jobs", "tasks",
@@ -133,7 +146,8 @@ def test_web_frontend_and_metrics_export(ray_init):
         metrics = httpx.get(f"{url}/metrics", timeout=30).text
         if ("rt_task_hop_seconds_bucket" in metrics
                 and "rt_task_events_dropped_total" in metrics
-                and "rt_metrics_series_dropped_total" in metrics):
+                and "rt_metrics_series_dropped_total" in metrics
+                and "rt_llm_kv_blocks_in_use" in metrics):
             break
         time.sleep(0.5)
     assert "rt_nodes_alive 1" in metrics
@@ -141,6 +155,11 @@ def test_web_frontend_and_metrics_export(ray_init):
     assert "rt_actors_total{" in metrics
     assert "rt_task_hop_seconds_bucket" in metrics
     assert "rt_task_events_store_dropped_total" in metrics
+    # LLM serving / autoscaler series render with values and labels intact
+    assert "rt_llm_kv_blocks_in_use 3" in metrics
+    assert "rt_llm_batch_occupancy 0.5" in metrics
+    assert "rt_llm_prefix_hits_total 4" in metrics
+    assert 'rt_serve_target_replicas{deployment="dash-d"} 2' in metrics
 
     # the bundled Grafana dashboard parses and its panels query only
     # series the endpoint exports

@@ -1,0 +1,269 @@
+"""Serve autoscaling plane unit tests (reference: test_autoscaling_policy
+in serve's test suite): pure policy math + placement/demand helpers —
+no cluster, no RPC (plus one cluster-backed delta-plane regression).
+"""
+
+import time
+
+from ray_tpu._private.protocol import ResourceSet
+from ray_tpu.serve._autoscaling import (
+    AutoscalingPolicy,
+    count_placeable,
+    demand_key,
+    demand_shapes,
+    replica_load,
+    replica_shape,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+def _policy(clock=None, **cfg) -> AutoscalingPolicy:
+    cfg.setdefault("min_replicas", 1)
+    cfg.setdefault("max_replicas", 8)
+    cfg.setdefault("target_ongoing_requests", 2)
+    cfg.setdefault("upscale_delay_s", 0.0)
+    cfg.setdefault("downscale_delay_s", 10.0)
+    return AutoscalingPolicy(cfg, clock=clock or FakeClock())
+
+
+def _st(ongoing=0, queued=0, peak_ongoing=0, peak_queued=0, **extra):
+    st = dict(ongoing=ongoing, queued=queued, peak_ongoing=peak_ongoing,
+              peak_queued=peak_queued)
+    st.update(extra)
+    return st
+
+
+# -- demand -----------------------------------------------------------------
+
+
+def test_replica_load_uses_peak_of_window():
+    # a burst that queued and drained entirely between probes still counts
+    assert replica_load(_st(ongoing=1, queued=0,
+                            peak_ongoing=4, peak_queued=6)) == 10.0
+    assert replica_load(_st(ongoing=3, queued=2)) == 5.0
+
+
+def test_scale_up_on_queue_depth():
+    p = _policy()
+    # 2 replicas, 8 in-flight + 8 queued, target 2/replica -> 8 replicas
+    stats = [_st(ongoing=4, queued=4), _st(ongoing=4, queued=4)]
+    assert p.desired_from_stats(stats, running=2) == 8
+
+
+def test_probe_blackout_holds_current_fleet():
+    p = _policy()
+    # every probe failed: hold, never invent a scale-to-min
+    assert p.desired_from_stats([], running=5) == 5
+
+
+def test_ttft_signal_scales_proportionally():
+    p = _policy(target_ttft_s=0.5)
+    # light queue load, but the WORST replica's TTFT is 3x over target
+    stats = [_st(ongoing=1, ttft_p50_s=0.1), _st(ongoing=1, ttft_p50_s=1.5)]
+    assert p.desired_from_stats(stats, running=2) == 6
+
+
+def test_tokens_per_s_signal_adds_replicas_when_saturated():
+    p = _policy(target_tokens_per_s=100)
+    stats = [_st(ongoing=2, tokens_per_s=25.0)]
+    # 25 tok/s observed vs 100 target -> 4x the fleet
+    assert p.desired_from_stats(stats, running=1) == 4
+
+
+# -- smoothing --------------------------------------------------------------
+
+
+def test_upscale_is_immediate_by_default():
+    clk = FakeClock()
+    p = _policy(clock=clk)
+    assert p.update(6, 2) == 6
+
+
+def test_upscale_delay_requires_sustained_demand():
+    clk = FakeClock()
+    p = _policy(clock=clk, upscale_delay_s=5.0)
+    assert p.update(6, 2) == 2      # demand just appeared: hold
+    clk.advance(3.0)
+    assert p.update(6, 2) == 2      # still inside the delay
+    clk.advance(2.5)
+    assert p.update(6, 2) == 6      # sustained past the delay: adopt
+
+
+def test_downscale_cooldown_hysteresis():
+    clk = FakeClock()
+    p = _policy(clock=clk, downscale_delay_s=10.0)
+    assert p.update(1, 4) == 4      # low reading starts the window
+    clk.advance(6.0)
+    assert p.update(1, 4) == 4      # cooldown not elapsed
+    clk.advance(5.0)
+    assert p.update(1, 4) == 1      # sustained-low: shrink
+
+
+def test_downscale_sized_to_window_peak_not_last_sample():
+    """Sawtooth load holds its high-water fleet instead of thrashing."""
+    clk = FakeClock()
+    p = _policy(clock=clk, downscale_delay_s=10.0)
+    assert p.update(1, 6) == 6
+    clk.advance(4.0)
+    assert p.update(3, 6) == 6      # mid-window spike (still < current)
+    clk.advance(7.0)
+    # window elapsed: shrink to the PEAK seen inside it (3), not 1
+    assert p.update(1, 6) == 3
+
+
+def test_demand_spike_resets_downscale_window():
+    clk = FakeClock()
+    p = _policy(clock=clk, downscale_delay_s=10.0)
+    assert p.update(1, 4) == 4
+    clk.advance(8.0)
+    assert p.update(4, 4) == 4      # demand back at target: window resets
+    clk.advance(8.0)
+    assert p.update(1, 4) == 4      # NEW window just started
+    clk.advance(3.0)
+    assert p.update(1, 4) == 4      # 3s into the new window: still held
+    clk.advance(8.0)
+    assert p.update(1, 4) == 1      # 11s sustained-low: shrink
+
+
+def test_scale_to_zero_guarded_by_min_replicas():
+    clk = FakeClock()
+    p = _policy(clock=clk, min_replicas=1, downscale_delay_s=0.0)
+    # idle fleet with min_replicas=1 floors at 1, never 0
+    assert p.desired_from_stats([_st()], running=1) == 1
+    assert p.update(0, 1) == 1
+    # opting in via min_replicas=0 allows reaching zero
+    p0 = _policy(clock=clk, min_replicas=0, downscale_delay_s=0.0)
+    assert p0.update(0, 1) == 0
+
+
+def test_clamp_respects_max_replicas():
+    p = _policy(max_replicas=4)
+    stats = [_st(ongoing=50, queued=50)]
+    assert p.desired_from_stats(stats, running=1) == 4
+    assert p.update(100, 1) == 4
+
+
+# -- placement / demand -----------------------------------------------------
+
+
+def _node(avail, state="ALIVE"):
+    return {"state": state, "available": ResourceSet(avail).to_wire()}
+
+
+def test_replica_shape_matches_scheduler_mapping():
+    assert replica_shape({"num_cpus": 2}) == {"CPU": 2.0}
+    assert replica_shape({"num_tpus": 4, "num_cpus": 1}) == {
+        "TPU": 4.0, "CPU": 1.0}
+    # the implicit 1-CPU scheduling default applies to replicas too
+    assert replica_shape({}) == {"CPU": 1.0}
+
+
+def test_count_placeable_first_fit_across_nodes():
+    nodes = [_node({"CPU": 2}), _node({"CPU": 3})]
+    assert count_placeable({"CPU": 1.0}, nodes, pending=10) == 5
+    assert count_placeable({"CPU": 2.0}, nodes, pending=10) == 2
+    assert count_placeable({"CPU": 4.0}, nodes, pending=10) == 0
+
+
+def test_count_placeable_skips_dead_nodes_and_zero_pending():
+    nodes = [_node({"CPU": 8}, state="DEAD"), _node({"CPU": 1})]
+    assert count_placeable({"CPU": 1.0}, nodes, pending=3) == 1
+    assert count_placeable({"CPU": 1.0}, nodes, pending=0) == 0
+
+
+def test_demand_published_only_for_unplaceable():
+    """The controller publishes shapes ONLY for replicas that fit nowhere:
+    placeable ones start immediately instead of waiting on new nodes."""
+    shape = {"CPU": 2.0, "TPU": 1.0}
+    nodes = [_node({"CPU": 4, "TPU": 2})]
+    pending = 5
+    placeable = count_placeable(shape, nodes, pending)
+    assert placeable == 2
+    shapes = demand_shapes(shape, pending - placeable)
+    assert shapes == [shape, shape, shape]
+    # everything fits -> empty payload (published as a withdrawal)
+    assert demand_shapes(shape, 0) == []
+    assert demand_key("llm") == "serve:llm"
+
+
+def test_replica_peak_counters_reset_on_poll():
+    """Regression: peak_queued must be peak-SINCE-LAST-POLL like
+    peak_ongoing — a monotonic high-water keeps feeding the spike-era
+    queue depth to the autoscaler as live load forever, so the fleet
+    never drains back to min_replicas after traffic stops."""
+    import asyncio
+
+    import cloudpickle
+
+    from ray_tpu.serve._replica import ServeReplica
+
+    async def fn(payload=None):
+        return payload
+
+    r = ServeReplica._cls("d", 0, cloudpickle.dumps(fn),
+                          cloudpickle.dumps(((), {})),
+                          max_concurrent=1, max_queued=8)
+    # a burst's high-water marks, as left behind by concurrent admissions
+    r._peak_ongoing = 7
+    r._peak_queued = 6
+    first = asyncio.run(r.stats())
+    assert first["peak_ongoing"] == 7 and first["peak_queued"] == 6
+    second = asyncio.run(r.stats())
+    assert second["peak_ongoing"] == 0 and second["peak_queued"] == 0
+    assert replica_load(second) == 0.0
+
+
+def test_actor_placement_reaches_cursor_readers():
+    """Regression: the control store's optimistic availability deduction on
+    actor placement must land in the availability CHANGE LOG, not just the
+    table — otherwise cursor readers (the node autoscaler's delta poll)
+    keep the pre-placement row forever and bin-pack pending demand into
+    phantom free capacity, so demand-driven scale-up never launches."""
+    import ray_tpu
+    from ray_tpu._private.core_worker import get_core_worker
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        cw = get_core_worker()
+
+        def load(cursor):
+            return cw.run_sync(
+                cw.control.call("get_cluster_load", {"cursor": cursor}), 10)
+
+        full = load(None)
+        cursor = full["version"]
+        assert [n["available"] for n in full["nodes"]] == [{"CPU": 40000}]
+
+        @ray_tpu.remote(num_cpus=2)
+        class Holder:
+            def ping(self):
+                return 1
+
+        h = Holder.remote()
+        assert ray_tpu.get(h.ping.remote(), timeout=60) == 1
+
+        # the delta poll from the pre-placement cursor must surface the
+        # head row with the deducted availability
+        deadline = time.time() + 20
+        rows = []
+        while time.time() < deadline:
+            reply = load(cursor)
+            assert reply.get("delta") is True
+            rows = reply["nodes"]
+            if any(n["available"].get("CPU") == 20000 for n in rows):
+                break
+            time.sleep(0.2)
+        assert any(n["available"].get("CPU") == 20000 for n in rows), rows
+        ray_tpu.kill(h)
+    finally:
+        ray_tpu.shutdown()
